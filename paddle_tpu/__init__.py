@@ -1,0 +1,81 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capability surface of PaddlePaddle (reference:
+/root/reference, v1.8/2.0-era) designed for TPU hardware: jax/XLA for
+compilation, pjit/shard_map over device meshes for distribution, Pallas for
+hot kernels.  The tensor type is ``jax.Array``; models are ``nn.Layer`` trees
+with a functional bridge for jit; parallelism is mesh-axis sharding rather
+than NCCL rings (SURVEY.md §7 design stance).
+"""
+from __future__ import annotations
+
+from . import core
+from .core import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_guard,
+    get_device,
+    get_flags,
+    is_compiled_with_tpu,
+    seed,
+    set_device,
+    set_flags,
+)
+from .core.dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .ops import *  # noqa: F401,F403 — tensor op library at top level (paddle.* parity)
+from .ops import __all__ as _ops_all
+
+from . import ops as tensor  # paddle.tensor namespace alias
+
+__version__ = "0.1.0"
+
+
+def is_tensor(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+# Subpackages imported lazily to keep `import paddle_tpu` light and to avoid
+# import cycles; `paddle_tpu.nn` etc. resolve on first attribute access.
+_LAZY_SUBMODULES = (
+    "nn",
+    "optimizer",
+    "amp",
+    "autograd",
+    "distributed",
+    "static",
+    "io",
+    "hapi",
+    "metric",
+    "vision",
+    "text",
+    "utils",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,128 @@
+"""Autograd bridge between the Layer tree and JAX's functional transforms.
+
+Reference parity: the imperative engine (imperative/basic_engine.cc tape
+backward, SURVEY.md §1 L1.5b) and ``append_backward`` (fluid/backward.py:1215).
+TPU-native design: there is no tape.  A Layer tree is *organizational*; this
+module extracts its trainable parameters as a pytree, re-binds them under
+trace (``functional_call``), and differentiates whole steps with
+``jax.value_and_grad`` — XLA then sees one fused program instead of per-op
+kernel launches (the reason the reference needed core.ops + dygraph_to_static
+to go fast; SURVEY.md §7 "hard parts": dygraph per-op dispatch latency).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..core import random as _random
+from ..nn.layer.base import Layer, Parameter
+
+ParamDict = Dict[str, Any]
+
+
+def parameters_dict(layer: Layer, trainable_only: bool = True) -> ParamDict:
+    """Extract {qualified_name: jax.Array} for the layer tree."""
+    return {name: p.value for name, p in layer.named_parameters()
+            if (p.trainable or not trainable_only)}
+
+
+def buffers_dict(layer: Layer) -> ParamDict:
+    return {name: b for name, b in layer.named_buffers()}
+
+
+def load_parameters(layer: Layer, params: ParamDict) -> None:
+    """Write a parameter pytree back into the Layer tree (post-update)."""
+    for name, p in layer.named_parameters():
+        if name in params:
+            p.value = params[name]
+
+
+def _buffer_holders(layer: Layer, prefix: str = ""):
+    for name, b in layer._buffers.items():
+        yield (f"{prefix}.{name}" if prefix else name), b
+    for lname, sub in layer._sub_layers.items():
+        yield from _buffer_holders(sub, f"{prefix}.{lname}" if prefix else lname)
+
+
+def load_buffers(layer: Layer, bufs: ParamDict) -> None:
+    for name, holder in _buffer_holders(layer):
+        if name in bufs:
+            holder.value = bufs[name]
+
+
+@contextlib.contextmanager
+def _swapped(layer: Layer, params: ParamDict, buffers: Optional[ParamDict] = None):
+    """Temporarily bind (possibly traced) values into the Parameter/buffer
+    holders so ``layer.forward`` reads them."""
+    old_p = {}
+    for name, p in layer.named_parameters():
+        if name in params:
+            old_p[name] = p.value
+            p.value = params[name]
+    old_b = {}
+    holders = dict(_buffer_holders(layer))
+    if buffers:
+        for name, value in buffers.items():
+            if name in holders:
+                old_b[name] = holders[name].value
+                holders[name].value = value
+    try:
+        yield
+    finally:
+        for name, p in layer.named_parameters():
+            if name in old_p:
+                p.value = old_p[name]
+        for name, value in old_b.items():
+            holders[name].value = value
+
+
+def functional_call(layer: Layer, params: ParamDict, args: Tuple = (),
+                    kwargs: Optional[dict] = None, rng=None,
+                    buffers: Optional[ParamDict] = None):
+    """Call ``layer(*args, **kwargs)`` with ``params`` bound in place of its
+    parameters — pure w.r.t. ``params`` so it can be traced/differentiated.
+
+    ``rng``: base PRNG key for dropout etc. inside the call (pushed as a
+    core.random scope so draws are trace-stable).
+    """
+    kwargs = kwargs or {}
+    ctx = _random.rng_scope(rng) if rng is not None else contextlib.nullcontext()
+    with _swapped(layer, params, buffers), ctx:
+        return layer(*args, **kwargs)
+
+
+def value_and_grad(layer: Layer, loss_fn: Callable, has_aux: bool = False):
+    """Build ``step(params, batch_args, rng) -> ((loss, aux?), grads)``.
+
+    ``loss_fn(*outputs_of_layer_call_args)``-style closures are the caller's
+    concern; here ``loss_fn(params, *args)`` is evaluated with params bound.
+    """
+
+    def compute(params: ParamDict, *args, rng=None):
+        def inner(p):
+            ctx = _random.rng_scope(rng) if rng is not None else contextlib.nullcontext()
+            with _swapped(layer, p), ctx:
+                return loss_fn(*args)
+
+        return jax.value_and_grad(inner, has_aux=has_aux)(params)
+
+    return compute
+
+
+def grad(layer: Layer, loss_fn: Callable):
+    vag = value_and_grad(layer, loss_fn)
+
+    def compute(params, *args, rng=None):
+        _, grads = vag(params, *args, rng=rng)
+        return grads
+
+    return compute
+
+
+@contextlib.contextmanager
+def no_grad():
+    """API-parity context (ref: paddle.no_grad).  Gradients in this framework
+    are explicit functional transforms, so this is a no-op marker."""
+    yield

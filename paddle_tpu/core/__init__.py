@@ -1,0 +1,20 @@
+"""Core runtime: dtypes, devices, RNG, flags.
+
+This package is the rebuild's L0 (SURVEY.md §1 L0a/L0b): the reference's
+16K-LoC platform layer (Place/DeviceContext/allocators/dynload) collapses
+onto JAX's PJRT client, leaving only thin typed handles here.
+"""
+from . import dtype, flags, random
+from .device import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_guard,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype
+from .flags import get_flags, set_flags
+from .random import get_rng_state, seed, set_rng_state

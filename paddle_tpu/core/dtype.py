@@ -1,0 +1,91 @@
+"""Dtype registry with Paddle-style string names, mapped onto JAX dtypes.
+
+Reference parity: paddle/fluid/framework/framework.proto:104-127 (VarType.Type
+enum — FP16/FP32/FP64/INT8/INT16/INT32/INT64/UINT8/BOOL/BF16/COMPLEX64/128).
+TPU-native design: dtypes are plain ``jnp.dtype`` objects; bfloat16 is the
+preferred low-precision type on TPU (MXU-native) rather than float16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_X64 = bool(jax.config.jax_enable_x64)
+
+# Canonical dtype objects (exported at the top-level package).
+#
+# TPU-native stance: 64-bit types are emulated and slow on TPU, so x64 stays
+# disabled and "int64"/"float64" requests resolve to their effective 32-bit
+# dtypes (mirroring what JAX itself does, but without the downcast warnings).
+# The reference uses int64 pervasively for indices (framework.proto VarType
+# INT64); all index ops here produce 32-bit indices instead.
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64 if _X64 else jnp.int32
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64 if _X64 else jnp.float32
+complex64 = jnp.complex64
+complex128 = jnp.complex128 if _X64 else jnp.complex64
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOAT_DTYPES = (float16, bfloat16, float32, float64)
+_INT_DTYPES = (uint8, int8, int16, int32, int64)
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / numpy / jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}") from None
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def get_default_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flag("default_dtype"))
+
+
+def set_default_dtype(dtype):
+    from . import flags
+
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    flags.set_flags({"default_dtype": np.dtype(d).name if d != bfloat16 else "bfloat16"})

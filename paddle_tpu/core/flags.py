@@ -1,0 +1,75 @@
+"""Typed global configuration flags.
+
+Reference parity: platform/flags.cc (29 gflags DEFINE_*), pybind's
+``core.globals()`` dict and the ``FLAGS_*`` env passthrough in
+python/paddle/fluid/__init__.py:140.  TPU-native design: a single typed
+registry with env-var passthrough (``PDTPU_FLAGS_<name>``) instead of global
+mutable C++ gflags; XLA-level knobs are surfaced through jax.config instead.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+_FLAGS: Dict[str, Any] = {}
+_DEFS: Dict[str, tuple] = {}  # name -> (default, type, help)
+
+_ENV_PREFIX = "PDTPU_FLAGS_"
+
+
+def define_flag(name: str, default, help: str = "", type_: Callable = None):
+    type_ = type_ or type(default)
+    _DEFS[name] = (default, type_, help)
+    env = os.environ.get(_ENV_PREFIX + name)
+    if env is not None:
+        if type_ is bool:
+            value = env.lower() in ("1", "true", "yes", "on")
+        else:
+            value = type_(env)
+    else:
+        value = default
+    _FLAGS[name] = value
+
+
+def get_flag(name: str):
+    try:
+        return _FLAGS[name]
+    except KeyError:
+        raise KeyError(f"Unknown flag {name!r}; known: {sorted(_FLAGS)}") from None
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for name, value in flags.items():
+            if name not in _FLAGS:
+                raise KeyError(f"Unknown flag {name!r}; known: {sorted(_FLAGS)}")
+            default, type_, _ = _DEFS[name]
+            if type_ is not None and not isinstance(value, type_) and value is not None:
+                value = type_(value)
+            _FLAGS[name] = value
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    if names is None:
+        return dict(_FLAGS)
+    if isinstance(names, str):
+        names = [names]
+    return {n: get_flag(n) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# Core flag definitions (analogues of the reference's most-used gflags).
+# ---------------------------------------------------------------------------
+define_flag("default_dtype", "float32", "Default floating dtype for new tensors.")
+define_flag("check_nan_inf", False, "Post-check every op output for NaN/Inf "
+            "(ref: platform/flags.cc:44 FLAGS_check_nan_inf).")
+define_flag("use_flash_attention", True, "Use the Pallas flash-attention kernel "
+            "on TPU where applicable.")
+define_flag("matmul_precision", "default", "jax.lax precision for matmuls: "
+            "default|high|highest.")
+define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "
+            "profiler is enabled (ref: platform/profiler.h:208).")
+define_flag("eager_log_level", 0, "VLOG-style verbosity for framework logging "
+            "(ref: glog VLOG levels).")

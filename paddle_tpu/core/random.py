@@ -1,0 +1,76 @@
+"""RNG stream management.
+
+Reference parity: framework/generator.h (global + per-device Generator RNG
+streams), python/paddle/framework/random.py (``paddle.seed``).  TPU-native
+design: JAX's splittable threefry keys.  Eager code draws subkeys from a
+process-global stream; jit-traced code (``functional_call`` / hapi train
+steps) pushes a *traced* base key onto a context stack so that dropout etc.
+stay pure under tracing — each draw folds a python-level counter into the base
+key, which is trace-stable.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+
+class KeyStream:
+    """A deterministic stream of subkeys derived from one base key."""
+
+    def __init__(self, key):
+        self._key = key
+        self._counter = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self._key, self._counter)
+        self._counter += 1
+        return k
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.global_stream = KeyStream(jax.random.key(0))
+        self.stack: List[KeyStream] = []
+
+
+_state = _State()
+
+
+def seed(value: int) -> None:
+    """Reseed the global stream (ref: paddle.seed / fluid.default_startup_program random seed)."""
+    _state.global_stream = KeyStream(jax.random.key(int(value)))
+
+
+def next_key():
+    """Draw the next subkey from the innermost active stream."""
+    if _state.stack:
+        return _state.stack[-1].next_key()
+    return _state.global_stream.next_key()
+
+
+class rng_scope:
+    """Push a base key for the duration of a traced region."""
+
+    def __init__(self, key):
+        self._stream = KeyStream(key)
+
+    def __enter__(self):
+        _state.stack.append(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+def get_rng_state():
+    return (_state.global_stream._key, _state.global_stream._counter)
+
+
+def set_rng_state(state):
+    key, counter = state
+    s = KeyStream(key)
+    s._counter = counter
+    _state.global_stream = s

@@ -1,0 +1,68 @@
+"""Functional neural-net ops — the ``paddle.nn.functional`` equivalent
+(ref: python/paddle/nn/functional/; kernels from paddle/fluid/operators/).
+"""
+from .activation import (
+    celu,
+    elu,
+    gelu,
+    glu,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    leaky_relu,
+    log_sigmoid,
+    log_softmax,
+    mish,
+    prelu,
+    relu,
+    relu6,
+    selu,
+    sigmoid,
+    silu,
+    softmax,
+    softplus,
+    softshrink,
+    softsign,
+    swish,
+    tanhshrink,
+)
+from .common import (
+    cosine_similarity,
+    dropout,
+    dropout2d,
+    interpolate,
+    linear,
+    pad,
+    unfold,
+    upsample,
+)
+from .conv import conv1d, conv2d, conv2d_transpose, conv3d
+from .norm import batch_norm, group_norm, instance_norm, layer_norm, normalize, rms_norm
+from .pooling import (
+    adaptive_avg_pool2d,
+    adaptive_max_pool2d,
+    avg_pool1d,
+    avg_pool2d,
+    max_pool1d,
+    max_pool2d,
+)
+from .loss import (
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    cross_entropy,
+    hinge_loss,
+    kl_div,
+    l1_loss,
+    log_loss,
+    margin_ranking_loss,
+    mse_loss,
+    nll_loss,
+    smooth_l1_loss,
+    softmax_with_cross_entropy,
+    square_error_cost,
+)
+from .input import embedding, one_hot
+from ...ops.attention import flash_attention, scaled_dot_product_attention
+
+__all__ = [n for n in dir() if not n.startswith("_")]

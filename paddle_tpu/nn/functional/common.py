@@ -1,0 +1,124 @@
+"""Common functional ops: linear, dropout, pad, interpolate (ref: python/
+paddle/nn/functional/common.py; operators/dropout_op.cc, pad_op.cc,
+interpolate_v2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _random
+
+
+def linear(x, weight, bias=None):
+    """ref: mul/matmul+elementwise_add fusion (fc op). weight: (in, out)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    """ref: operators/dropout_op.cc — two modes match the reference:
+    upscale_in_train (default, inverted dropout) and downscale_in_infer."""
+    if p == 0.0:
+        return x
+    if not training:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+def dropout2d(x, p=0.5, training=True):
+    """Channel-wise dropout for NCHW."""
+    if p == 0.0 or not training:
+        return x
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p,
+                                x.shape[:2] + (1,) * (x.ndim - 2))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """ref: pad/pad2d/pad3d ops. ``pad`` is [l, r] per trailing dim (paddle
+    order: last dim first) or a full per-dim spec."""
+    if len(pad) == 2 * x.ndim:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * (x.ndim - n_spatial) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        if data_format.endswith("C"):  # channels-last: spatial dims before C
+            cfg = ([(0, 0)] + cfg[2:] + [(0, 0)])[: x.ndim]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """ref: operators/interpolate_v2_op.cc (nearest/bilinear)."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (tuple, list)) else (
+            scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = size
+    if mode == "nearest":
+        ridx = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        cidx = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        out = x[:, :, ridx][:, :, :, cidx]
+    elif mode in ("bilinear", "linear"):
+        if align_corners and oh > 1 and ow > 1:
+            rs = jnp.linspace(0, h - 1, oh)
+            cs = jnp.linspace(0, w - 1, ow)
+        else:
+            rs = jnp.clip((jnp.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+            cs = jnp.clip((jnp.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+        r0 = jnp.floor(rs).astype(jnp.int32)
+        c0 = jnp.floor(cs).astype(jnp.int32)
+        r1 = jnp.clip(r0 + 1, 0, h - 1)
+        c1 = jnp.clip(c0 + 1, 0, w - 1)
+        wr = (rs - r0)[None, None, :, None]
+        wc = (cs - c0)[None, None, None, :]
+        g = lambda ri, ci: x[:, :, ri][:, :, :, ci]
+        out = (g(r0, c0) * (1 - wr) * (1 - wc) + g(r1, c0) * wr * (1 - wc) +
+               g(r0, c1) * (1 - wr) * wc + g(r1, c1) * wr * wc).astype(x.dtype)
+    else:
+        raise NotImplementedError(f"interpolate mode {mode!r}")
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """ref: operators/unfold_op.cc (im2col).  x: (N, C, H, W) ->
+    (N, C*kh*kw, L)."""
+    from jax import lax
+
+    kh, kw = (kernel_sizes if isinstance(kernel_sizes, (list, tuple))
+              else (kernel_sizes, kernel_sizes))
+    sh, sw = (strides if isinstance(strides, (list, tuple)) else (strides, strides))
+    ph, pw = (paddings if isinstance(paddings, (list, tuple)) else (paddings, paddings))
+    dh, dw = (dilations if isinstance(dilations, (list, tuple)) else (dilations, dilations))
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)], rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)

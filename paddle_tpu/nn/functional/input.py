@@ -1,0 +1,23 @@
+"""Embedding / one-hot (ref: operators/lookup_table_v2_op.cc, one_hot_op.cc).
+
+TPU-native: embedding lookup is a gather; sparse-gradient SelectedRows
+(reference lookup_table sparse path) maps to dense segment-sum gradients,
+which XLA handles as scatter-add (SURVEY.md §7 hard-parts note).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    del sparse  # gradient representation is XLA's concern
+    out = jnp.take(weight, x.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes)

@@ -1,0 +1,123 @@
+"""Loss functions (ref: operators/softmax_with_cross_entropy_op.cc,
+cross_entropy_op.cc, smooth_l1_loss, bce ops; python/paddle/nn/functional/
+loss.py).  Cross-entropy computes logsumexp in float32 for bf16 stability."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               axis=-1):
+    """ref: operators/softmax_with_cross_entropy_op.cc — fused, numerically
+    stable.  Returns per-example loss (no reduction)."""
+    logits32 = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits32, axis=axis)
+    if soft_label:
+        return -jnp.sum(label.astype(jnp.float32) * log_probs, axis=axis)
+    label = label.squeeze(axis) if (label.ndim == logits.ndim and
+                                    label.shape[axis] == 1) else label
+    picked = jnp.take_along_axis(log_probs, label[..., None].astype(jnp.int32),
+                                 axis=axis)[..., 0]
+    loss = -picked
+    return jnp.where(label == ignore_index, 0.0, loss)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1):
+    loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
+                                      ignore_index=ignore_index, axis=axis)
+    if weight is not None and not soft_label:
+        w = jnp.take(weight, jnp.clip(label.astype(jnp.int32), 0, None), axis=0)
+        loss = loss * w
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(label == ignore_index, 0.0, w))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    if reduction == "mean" and not soft_label:
+        # mean over non-ignored positions (ref cross_entropy semantics)
+        valid = jnp.sum((label != ignore_index).astype(jnp.float32))
+        return jnp.sum(loss) / jnp.maximum(valid, 1.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(log_probs, label, weight=None, ignore_index=-100, reduction="mean"):
+    picked = jnp.take_along_axis(log_probs, label[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    loss = -picked
+    loss = jnp.where(label == ignore_index, 0.0, loss)
+    if weight is not None:
+        loss = loss * jnp.take(weight, jnp.clip(label.astype(jnp.int32), 0, None))
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def square_error_cost(input, label):
+    """ref: operators/squared_l2_distance — per-element squared error."""
+    return jnp.square(input - label)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, None)) +
+             (1 - label) * jnp.log(jnp.clip(1 - input, eps, None)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    logit32 = logit.astype(jnp.float32)
+    label32 = label.astype(jnp.float32)
+    max_val = jnp.clip(-logit32, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label32 + 1
+        loss = (1 - label32) * logit32 + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit32))) + max_val)
+    else:
+        loss = (1 - label32) * logit32 + max_val + jnp.log1p(
+            jnp.exp(-jnp.abs(logit32)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss.astype(logit.dtype), reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -(label * jnp.log(input + epsilon) +
+             (1 - label) * jnp.log(1 - input + epsilon))
+
+
+def hinge_loss(input, label):
+    return jnp.clip(1 - input * (2 * label - 1), 0, None)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _reduce(jnp.clip(-label * (input - other) + margin, 0, None), reduction)

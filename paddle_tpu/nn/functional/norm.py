@@ -1,0 +1,98 @@
+"""Normalization ops (ref: operators/batch_norm_op.cc, layer_norm_op.cc,
+group_norm_op.cc, instance_norm_op.cc; python/paddle/nn/functional/norm.py).
+
+batch_norm takes/returns running stats functionally — the Layer wrapper owns
+the mutable buffers (TPU-native: state is explicit, never hidden in kernels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    """Returns (out, new_running_mean, new_running_var)."""
+    if data_format in ("NCHW", "NCL", "NC"):
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = [1, -1] + [1] * (x.ndim - 2)
+    else:  # NHWC-style: channel last
+        axes = tuple(range(x.ndim - 1))
+        shape = [1] * (x.ndim - 1) + [-1]
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_rm, new_rv
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # compute in float32 for bf16 stability (TPU-native AMP practice)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """TPU-native addition (no reference equivalent): RMSNorm for modern LLMs."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf / jnp.sqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    """x: (N, C, *spatial)."""
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = x.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mean) / jnp.sqrt(var + epsilon)
+    out = g.reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)

@@ -1,0 +1,89 @@
+"""Pooling ops (ref: operators/pool_op.cc; python/paddle/nn/functional/
+pooling.py).  lax.reduce_window lowers to XLA ReduceWindow (VPU-friendly)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _pair(v, n=2):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+def _pool2d(x, kernel, stride, padding, init, op, norm=None):
+    kernel = _pair(kernel)
+    stride = _pair(stride if stride is not None else kernel)
+    pads = _pair(padding)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding_cfg = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    out = lax.reduce_window(x, init, op, window, strides, padding_cfg)
+    if norm is not None:
+        out = norm(out, kernel, stride, pads, x.shape)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False):
+    out = _pool2d(x, kernel_size, stride, padding, -jnp.inf, lax.max)
+    if return_mask:
+        # index mask (ref: max_pool2d_with_index) computed via broadcast compare
+        raise NotImplementedError("return_mask is not supported yet")
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    kernel = _pair(kernel_size)
+    if padding == 0 or not exclusive:
+        out = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
+        if padding != 0 and not exclusive:
+            return out / float(np.prod(kernel))
+        return out / float(np.prod(kernel))
+    # exclusive: divide by actual window size (count non-pad elements)
+    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
+    ones = jnp.ones_like(x)
+    cnt = _pool2d(ones, kernel_size, stride, padding, 0.0, lax.add)
+    return s / cnt
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    out = max_pool2d(x[..., None], (_pair(kernel_size, 1)[0], 1),
+                     None if stride is None else (_pair(stride, 1)[0], 1),
+                     (_pair(padding, 1)[0], 0))
+    return out[..., 0]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    out = avg_pool2d(x[..., None], (_pair(kernel_size, 1)[0], 1),
+                     None if stride is None else (_pair(stride, 1)[0], 1),
+                     (_pair(padding, 1)[0], 0), exclusive=exclusive)
+    return out[..., 0]
+
+
+def adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    # general case: average over per-output-bin slices
+    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :] for i in range(oh)]
+    out_rows = []
+    for r in rows:
+        cols = [jnp.mean(r[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)],
+                         axis=(2, 3)) for j in range(ow)]
+        out_rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(out_rows, axis=-2)
+
+
+def adaptive_max_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :] for i in range(oh)]
+    out_rows = []
+    for r in rows:
+        cols = [jnp.max(r[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)],
+                        axis=(2, 3)) for j in range(ow)]
+        out_rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(out_rows, axis=-2)

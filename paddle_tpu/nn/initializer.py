@@ -1,0 +1,148 @@
+"""Weight initializers (ref: python/paddle/fluid/initializer.py — Constant,
+Uniform, Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, Assign).
+
+Each initializer is a callable ``(shape, dtype) -> jax.Array`` drawing from
+the core RNG stream.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(_random.next_key(), shape,
+                                                        dtype=dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        x = jax.random.truncated_normal(_random.next_key(), -2.0, 2.0, shape,
+                                        dtype=dtype)
+        return self.mean + self.std * x
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(_random.next_key(), shape, dtype=dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "relu":
+            return math.sqrt(2.0)
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return 1.0
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        return std * jax.random.normal(_random.next_key(), shape, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        arr = jnp.asarray(self.value, dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), (arr.shape, shape)
+        return arr
+
+
+class Bilinear(Initializer):
+    """For transposed-conv upsampling kernels (ref: initializer.py Bilinear)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        weight = np.zeros(shape, dtype=np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv kernel")
+        f = math.ceil(shape[-1] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape[-2:]))):
+            x, y = i % shape[-1], i // shape[-1]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[..., y, x] = v
+        return jnp.asarray(weight, dtype=dtype)

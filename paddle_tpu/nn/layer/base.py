@@ -1,0 +1,378 @@
+"""The Layer (module) system.
+
+Reference parity: python/paddle/fluid/dygraph/layers.py:675 (``Layer`` —
+sublayers/parameters/buffers registries, __call__, train/eval, state_dict,
+apply, to_static hooks).  TPU-native design: a Layer is an *organizational*
+tree of named ``Parameter`` leaves; execution is eager jnp by default, and the
+``functional`` module extracts the parameter pytree so whole training steps
+jit/pjit as pure functions (the reference instead needs a C++ tracer + d2s
+AST transpiler for this — SURVEY.md §1 L1.5b/L4).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as _dtype_mod
+
+
+class Parameter:
+    """A named, trainable tensor holder.
+
+    Mutable wrapper (reference: framework.py:5033 ``Parameter`` /:5135
+    ``ParamBase``): optimizers write updated values back via ``set_value`` so
+    eager code sees updates, while jitted steps treat the extracted pytree as
+    the source of truth.
+    """
+
+    __slots__ = ("value", "trainable", "name", "is_distributed", "sharding_axes")
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+        self.name = name
+        self.is_distributed = False
+        # Optional per-axis mesh-axis annotation used by the parallel engine
+        # (e.g. ("tp", None) for a column-parallel weight).
+        self.sharding_axes: Optional[Tuple] = None
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def set_value(self, value):
+        self.value = jnp.asarray(value, dtype=self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def astype(self, dtype):
+        return self.value.astype(_dtype_mod.convert_dtype(dtype))
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name!r}, shape={tuple(self.shape)}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")
+
+    # Arithmetic convenience: parameters act like their value in expressions.
+    def __jax_array__(self):
+        return self.value
+
+
+class Layer:
+    """Base class for all network layers (ref: dygraph/layers.py:675)."""
+
+    def __init__(self):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_forward_pre_hooks", collections.OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", collections.OrderedDict())
+
+    # -- attribute routing ---------------------------------------------------
+    def __setattr__(self, name: str, value: Any):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if name in self._parameters:
+                del self._parameters[name]
+            if name in self._sub_layers:
+                del self._sub_layers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for registry in (self._parameters, self._sub_layers, self._buffers):
+            if name in registry:
+                del registry[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- registration --------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter, name=name)
+        if parameter is not None:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        """Non-trainable state (ref: layers.py register_buffer), e.g. BN
+        running stats.  Stored as jnp arrays; included in state_dict when
+        persistable."""
+        self._buffers[name] = _Buffer(jnp.asarray(tensor), persistable)
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         attr=None, is_bias: bool = False):
+        """ref: layers.py create_parameter + LayerHelper param creation."""
+        from . import initializer as init
+
+        dtype = _dtype_mod.convert_dtype(dtype) or _dtype_mod.get_default_dtype()
+        if default_initializer is None:
+            default_initializer = init.Constant(0.0) if is_bias else init.XavierUniform()
+        name = getattr(attr, "name", None) or ""
+        value = default_initializer(shape, dtype)
+        return Parameter(value, name=name)
+
+    # -- traversal -----------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_parameters(prefix=sub_prefix)
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(prefix=sub_prefix)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_buffers(self, prefix: str = "", persistable_only: bool = False
+                      ) -> Iterator[Tuple[str, Any]]:
+        for name, b in self._buffers.items():
+            if persistable_only and not b.persistable:
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), b.value
+        for lname, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            yield from layer.named_buffers(prefix=sub_prefix,
+                                           persistable_only=persistable_only)
+
+    def buffers(self) -> List[Any]:
+        return [b for _, b in self.named_buffers()]
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", True)
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", False)
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, include_non_persistable_buffer: bool = False
+                   ) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, p in self.named_parameters():
+            out[name] = p.value
+        for name, b in self.named_buffers(
+                persistable_only=not include_non_persistable_buffer):
+            out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        del use_structured_name
+        missing, unexpected = [], set(state_dict)
+        for name, p in self.named_parameters():
+            if name in state_dict:
+                p.set_value(jnp.asarray(state_dict[name], dtype=p.dtype))
+                unexpected.discard(name)
+            else:
+                missing.append(name)
+        # buffers: walk and assign
+        def _set_buffer(layer, path):
+            for bname, buf in layer._buffers.items():
+                full = f"{path}.{bname}" if path else bname
+                if full in state_dict:
+                    buf.value = jnp.asarray(state_dict[full], dtype=buf.value.dtype)
+                    unexpected.discard(full)
+                elif buf.persistable:
+                    missing.append(full)
+            for lname, sub in layer._sub_layers.items():
+                _set_buffer(sub, f"{path}.{lname}" if path else lname)
+
+        _set_buffer(self, "")
+        return missing, sorted(unexpected)
+
+    load_dict = set_state_dict
+
+    # -- dtype / device ------------------------------------------------------
+    def to(self, dtype=None):
+        if dtype is not None:
+            dtype = _dtype_mod.convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p.value.dtype, jnp.floating):
+                    p.value = p.value.astype(dtype)
+            for layer in self.sublayers(include_self=True):
+                for b in layer._buffers.values():
+                    if jnp.issubdtype(b.value.dtype, jnp.floating):
+                        b.value = b.value.astype(dtype)
+        return self
+
+    def float(self):
+        return self.to(dtype=jnp.float32)
+
+    def bfloat16(self):
+        return self.to(dtype=jnp.bfloat16)
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, len(self._forward_pre_hooks))
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks, len(self._forward_post_hooks))
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}"]
+        for name, layer in self._sub_layers.items():
+            sub = repr(layer).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub}")
+        return "\n".join(lines) + ")"
+
+
+class _Buffer:
+    __slots__ = ("value", "persistable")
+
+    def __init__(self, value, persistable):
+        self.value = value
+        self.persistable = persistable
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, registry, _):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._registry = registry
+
+    def remove(self):
+        self._registry.pop(self.id, None)
+
+
+class LayerList(Layer):
+    """ref: dygraph/container.py LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(idx if idx >= 0 else len(self) + idx)]
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self)), sublayer)
+        return self
+
+
+class Sequential(Layer):
+    """ref: dygraph/container.py Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and layers and \
+                isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self)), parameter)
+        return self

@@ -1,0 +1,106 @@
+"""Conv layers (ref: python/paddle/nn/layer/conv.py; fluid/dygraph/nn.py
+Conv2D:112)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import dtype as _dtype_mod
+from .. import functional as F
+from .. import initializer as init
+from .base import Layer, Parameter
+
+
+def _pair(v, n=2):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride, padding,
+                 dilation, groups, bias_attr, weight_attr, ndim, transpose=False,
+                 output_padding=0):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size, ndim)
+        self.stride = _pair(stride, ndim)
+        self.padding = padding
+        self.dilation = _pair(dilation, ndim)
+        self.groups = groups
+        self.output_padding = output_padding
+        if transpose:
+            wshape = (in_channels, out_channels // groups) + self.kernel_size
+        else:
+            wshape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = (in_channels // groups) * int(np.prod(self.kernel_size))
+        w_init = getattr(weight_attr, "initializer", None) or init.KaimingUniform(
+            fan_in=fan_in, nonlinearity="leaky_relu", negative_slope=np.sqrt(5.0))
+        dtype = _dtype_mod.get_default_dtype()
+        self.weight = Parameter(w_init(wshape, dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = getattr(bias_attr, "initializer", None) or init.Constant(0.0)
+            self.bias = Parameter(b_init((out_channels,), dtype))
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        del padding_mode
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, bias_attr, weight_attr, ndim=2)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight.value,
+                        None if self.bias is None else self.bias.value,
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, k={self.kernel_size}, "
+                f"s={self.stride}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, bias_attr, weight_attr, ndim=1)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight.value,
+                        None if self.bias is None else self.bias.value,
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, bias_attr, weight_attr, ndim=3)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight.value,
+                        None if self.bias is None else self.bias.value,
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, bias_attr, weight_attr, ndim=2,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight.value,
+                                  None if self.bias is None else self.bias.value,
+                                  stride=self.stride, padding=self.padding,
+                                  output_padding=self.output_padding,
+                                  dilation=self.dilation, groups=self.groups)

@@ -1,0 +1,189 @@
+"""Norm layers (ref: python/paddle/nn/layer/norm.py; fluid/dygraph/nn.py
+BatchNorm:1149).  BatchNorm running stats live in layer buffers; SyncBatchNorm
+computes cross-replica statistics with a mesh psum when called inside a
+sharded context (the reference needs a dedicated CUDA op + graph pass —
+operators/sync_batch_norm_op.cu + ir/sync_batch_norm_pass.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dtype as _dtype_mod
+from .. import functional as F
+from .. import initializer as init
+from .base import Layer, Parameter
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        dtype = _dtype_mod.get_default_dtype()
+        if weight_attr is False:
+            self.weight = None
+        else:
+            w_init = getattr(weight_attr, "initializer", None) or init.Constant(1.0)
+            self.weight = Parameter(w_init((num_features,), dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = getattr(bias_attr, "initializer", None) or init.Constant(0.0)
+            self.bias = Parameter(b_init((num_features,), dtype))
+        self.register_buffer("_mean", jnp.zeros((num_features,), dtype))
+        self.register_buffer("_variance", jnp.ones((num_features,), dtype))
+
+    def forward(self, x):
+        training = self.training and not (self.use_global_stats is True)
+        out, new_rm, new_rv = F.batch_norm(
+            x, self._buffers["_mean"].value, self._buffers["_variance"].value,
+            None if self.weight is None else self.weight.value,
+            None if self.bias is None else self.bias.value,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if training:
+            # eager-mode stat update; jitted training steps use
+            # nn.functional.batch_norm directly and carry stats explicitly
+            import jax
+
+            if not isinstance(new_rm, jax.core.Tracer):
+                self._buffers["_mean"].value = new_rm
+                self._buffers["_variance"].value = new_rv
+        return out
+
+
+class BatchNorm(_BatchNormBase):
+    """2.0-era alias accepting any rank (ref: fluid/dygraph/nn.py BatchNorm)."""
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN (ref: operators/sync_batch_norm_op.cu).  Inside a
+    shard_map'd step with a data-parallel axis, statistics are averaged over
+    that axis via psum; standalone it degrades to regular BN."""
+
+    def forward(self, x):
+        from ...distributed import env as dist_env
+
+        axis = dist_env.current_data_axis()
+        if axis is None or not self.training:
+            return super().forward(x)
+        reduce_axes = (0,) + tuple(range(2, x.ndim))
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        import jax
+
+        n_local = x.size // x.shape[1]
+        mean = jax.lax.pmean(jnp.mean(x, axis=reduce_axes), axis)
+        mean_sq = jax.lax.pmean(jnp.mean(jnp.square(x), axis=reduce_axes), axis)
+        var = mean_sq - jnp.square(mean)
+        del n_local
+        out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.epsilon)
+        if self.weight is not None:
+            out = out * self.weight.value.reshape(shape)
+        if self.bias is not None:
+            out = out + self.bias.value.reshape(shape)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """ref: SyncBatchNorm.convert_sync_batchnorm — swap BN layers in a tree."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer.num_features, layer.momentum, layer.epsilon,
+                                data_format=layer.data_format)
+            if layer.weight is not None:
+                new.weight.set_value(layer.weight.value)
+            if layer.bias is not None:
+                new.bias.set_value(layer.bias.value)
+            new._buffers["_mean"].value = layer._buffers["_mean"].value
+            new._buffers["_variance"].value = layer._buffers["_variance"].value
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        dtype = _dtype_mod.get_default_dtype()
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = Parameter(jnp.zeros(self.normalized_shape, dtype))
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape,
+                            None if self.weight is None else self.weight.value,
+                            None if self.bias is None else self.bias.value,
+                            epsilon=self.epsilon)
+
+    def extra_repr(self):
+        return f"{self.normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """TPU-native addition for LLM blocks."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((hidden_size,),
+                                         _dtype_mod.get_default_dtype()))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight.value, epsilon=self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        dtype = _dtype_mod.get_default_dtype()
+        self.weight = None if weight_attr is False else Parameter(
+            jnp.ones((num_channels,), dtype))
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((num_channels,), dtype))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups,
+                            None if self.weight is None else self.weight.value,
+                            None if self.bias is None else self.bias.value,
+                            epsilon=self.epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5):
+        super().__init__()
+        self.epsilon = epsilon
+        dtype = _dtype_mod.get_default_dtype()
+        self.weight = Parameter(jnp.ones((num_features,), dtype))
+        self.bias = Parameter(jnp.zeros((num_features,), dtype))
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight.value, self.bias.value,
+                               epsilon=self.epsilon)

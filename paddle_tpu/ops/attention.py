@@ -1,0 +1,66 @@
+"""Attention ops: reference jnp implementation + TPU flash-attention dispatch.
+
+Reference parity: fused/multihead_matmul (inference-only fusion in the
+reference, SURVEY.md §5.7); here attention is a first-class training op.
+Inputs follow the (batch, num_heads, seq, head_dim) convention.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_tpu() -> bool:
+    return jax.default_backend() not in ("cpu", "gpu")
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None, training=True):
+    """Reference attention: (b, h, s, d) -> (b, h, s, d).
+
+    ``attn_mask`` is additive (float, broadcastable to (b, h, sq, sk)) or
+    boolean (True = keep).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if is_causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        s = jnp.where(causal, s, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            s = jnp.where(attn_mask, s, -1e30)
+        else:
+            s = s + attn_mask.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from ..core import random as _random
+
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def flash_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                    scale=None, training=True):
+    """Dispatch to the Pallas flash-attention kernel when the backend/shape
+    allow; otherwise fall back to the jnp reference implementation."""
+    from ..core import flags
+    from .pallas import flash_attention as fa
+
+    b, h, s, d = q.shape
+    use_kernel = (
+        flags.get_flag("use_flash_attention")
+        and _is_tpu()
+        and attn_mask is None
+        and dropout_p == 0.0
+        and fa.supported(s, d)
+    )
+    if use_kernel:
+        return fa.flash_attention(q, k, v, sm_scale=scale, causal=is_causal)
+    return scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                        dropout_p=dropout_p, is_causal=is_causal,
+                                        scale=scale, training=training)

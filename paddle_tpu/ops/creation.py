@@ -1,0 +1,105 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py; operators/
+fill_constant_op.cc, assign_op.cc, eye_op.cc, linspace_op.cc …)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype_mod
+
+
+def _default_float():
+    return _dtype_mod.get_default_dtype()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """Create a tensor from python/numpy data (ref: paddle.to_tensor).
+
+    ``place``/``stop_gradient`` are accepted for API parity; placement is
+    governed by jax's default device, and gradients are functional (jax.grad)
+    rather than tape-attached, so ``stop_gradient`` has no effect here.
+    """
+    del place, stop_gradient
+    dtype = _dtype_mod.convert_dtype(dtype)
+    arr = jnp.asarray(data, dtype=dtype)
+    if dtype is None and arr.dtype == jnp.float64 and _default_float() != jnp.float64:
+        arr = arr.astype(_default_float())
+    return arr
+
+
+def full(shape, fill_value, dtype=None):
+    dtype = _dtype_mod.convert_dtype(dtype)
+    if dtype is None:
+        dtype = jnp.result_type(fill_value)
+        if jnp.issubdtype(dtype, jnp.floating):
+            dtype = _default_float()
+    return jnp.full(shape, fill_value, dtype=dtype)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=_dtype_mod.convert_dtype(dtype) or _default_float())
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=_dtype_mod.convert_dtype(dtype) or _default_float())
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dtype_mod.convert_dtype(dtype) or _default_float())
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    out = jnp.diag(x, k=offset)
+    if x.ndim == 1 and padding_value != 0:
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(padding_value, dtype=out.dtype))
+    return out
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def assign(x, output=None):
+    del output
+    return jnp.asarray(x)

@@ -1,0 +1,81 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py; operators/
+cholesky_op.cc, svd helpers, matrix_power, inverse_op.cc, norm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import int64 as _i64
+
+
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list)) else None,
+                               axis=tuple(axis) if isinstance(axis, list) else axis,
+                               keepdims=keepdim)
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def cholesky(x, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                unit_diagonal=unitriangular)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=axis if axis is not None else -1)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        lo, hi = float(jnp.min(x)), float(jnp.max(x))
+    else:
+        lo, hi = float(min), float(max)
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist.astype(_i64)

@@ -1,0 +1,77 @@
+"""Comparison / logical / bitwise ops (ref: python/paddle/tensor/logic.py;
+operators/controlflow/compare_op.cc, logical_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        import numpy as np
+
+        return tuple(jnp.asarray(i) for i in np.nonzero(np.asarray(condition)))
+    return jnp.where(condition, x, y)
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)

@@ -1,0 +1,187 @@
+"""Shape / layout manipulation ops (ref: python/paddle/tensor/manipulation.py;
+operators/reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+gather_op.cc, scatter_op.cc, …).  All static-shape; XLA requires it."""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype_mod
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=perm)
+
+
+def cast(x, dtype):
+    return jnp.asarray(x).astype(_dtype_mod.convert_dtype(dtype))
+
+
+def concat(x, axis=0):
+    return jnp.concatenate(x, axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack(x, axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    num = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, num, axis=axis)]
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    """ref: operators/split_op.cc — sections may contain one -1."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = builtins.sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1 :]
+    return jnp.reshape(x, shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    """ref: expand_v2 — -1 keeps the original dim."""
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s == -1 else s for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def slice(x, axes, starts, ends):
+    """ref: operators/slice_op.cc."""
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(jnp.asarray(values, dtype=x.dtype), indices.shape)
+    dim_idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+               for d, s in enumerate(indices.shape)]
+    dim_idx[axis] = indices
+    idx = tuple(jnp.broadcast_to(i, indices.shape) for i in dim_idx)
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce == "add":
+        return x.at[idx].add(values)
+    if reduce == "multiply":
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def scatter(x, index, updates, overwrite=True):
+    """ref: operators/scatter_op.cc — row-wise scatter along axis 0."""
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(updates))
+    return base.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def masked_select(x, mask):
+    """Note: output shape is data-dependent — host-only (not jittable)."""
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    """Note: data-dependent output shape — host-only (not jittable)."""
+    res = np.unique(
+        np.asarray(x), return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)

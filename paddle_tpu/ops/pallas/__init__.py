@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the rebuild's equivalent of the reference's hand-tuned
+CUDA kernels (operators/fused/, operators/math/) and CPU JIT codegen
+(operators/jit/, obsoleted by XLA for everything non-attention)."""

@@ -1,0 +1,137 @@
+"""Pallas TPU flash-attention (forward) kernel.
+
+The reference has no flash attention (SURVEY.md §5.7 — its transformer is
+plain full attention, python/paddle/nn/layer/transformer.py); this is a new
+TPU-native capability.  Design: block-wise online-softmax forward in VMEM with
+float32 accumulators (MXU matmuls via jnp.dot with preferred_element_type),
+grid over (batch*heads, q_blocks); K/V stream through a fori_loop of VMEM
+dynamic slices.  Backward is provided via recompute (jax.custom_vjp whose bwd
+re-runs a jnp reference attention under grad) — a dedicated backward kernel is
+a later-round optimisation.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, block_k,
+                      seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    num_kv = seq_len // block_k
+    if causal:
+        # Only iterate over kv blocks at or before this q block's diagonal.
+        num_kv_iter = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+        num_kv_iter = jnp.minimum(num_kv_iter, num_kv)
+    else:
+        num_kv_iter = num_kv
+
+    def body(kv_idx, carry):
+        acc, m_prev, l_prev = carry
+        k = pl.load(k_ref, (0, pl.dslice(kv_idx * block_k, block_k), slice(None)))
+        v = pl.load(v_ref, (0, pl.dslice(kv_idx * block_k, block_k), slice(None)))
+        s = jnp.dot(q, k.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_kv_iter, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k):
+    """q,k,v: (bh, seq, d) — batch and heads pre-flattened."""
+    bh, seq_len, d = q.shape
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    grid = (bh, seq_len // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=seq_len)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, sm_scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k):
+    return _flash_forward(q, k, v, sm_scale, causal, block_q, block_k)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference_attention(q_, k_, v_, sm_scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention_bhsd.defvjp(_fwd, _bwd)
+
+
+def supported(seq_len: int, head_dim: int) -> bool:
+    """Shapes the kernel handles: lane-aligned head_dim, block-divisible seq."""
+    return head_dim % 128 == 0 and seq_len % 128 == 0 and seq_len >= 128
+
+
+def flash_attention(q, k, v, sm_scale=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention over (batch, heads, seq, head_dim) inputs."""
+    b, h, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    while s % bq:
+        bq //= 2
+    while s % bk:
+        bk //= 2
+    merged = lambda x: x.reshape(b * h, s, d)
+    out = _flash_attention_bhsd(merged(q), merged(k), merged(v), sm_scale, causal, bq, bk)
+    return out.reshape(b, h, s, d)

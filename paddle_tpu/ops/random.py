@@ -1,0 +1,70 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py; operators/
+uniform_random_op.cc, gaussian_random_op.cc, randint, bernoulli, multinomial).
+
+Keys come from the active ``core.random`` stream, so these are reproducible
+after ``paddle_tpu.seed(n)`` and pure under ``functional_call`` tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype_mod
+from ..core.dtype import int64 as _i64
+from ..core import random as _random
+
+
+def _dt(dtype):
+    return _dtype_mod.convert_dtype(dtype) or _dtype_mod.get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=None):
+    key = jax.random.key(seed) if seed else _random.next_key()
+    return jax.random.uniform(key, shape, dtype=_dt(dtype), minval=min, maxval=max)
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def standard_normal(shape, dtype=None):
+    return jax.random.normal(_random.next_key(), shape, dtype=_dt(dtype))
+
+
+def randn(shape, dtype=None):
+    return standard_normal(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    return mean + std * jax.random.normal(_random.next_key(), tuple(shape),
+                                          dtype=_dtype_mod.get_default_dtype())
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_random.next_key(), shape, low, high,
+                              dtype=_dtype_mod.convert_dtype(dtype))
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(_random.next_key(), n).astype(
+        _dtype_mod.convert_dtype(dtype))
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(_random.next_key(), p=x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = _random.next_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=x.shape[:-1] + (num_samples,)).astype(_i64)
+    # Gumbel top-k trick for sampling without replacement.
+    g = jax.random.gumbel(key, x.shape, dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(_i64)

@@ -1,0 +1,93 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py; operators/
+argsort_op.cc, top_k_op.cc/top_k_v2, arg_max_op.cc, where_index_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.dtype import int64 as _i64
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtype import convert_dtype
+
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtype import convert_dtype
+
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis, descending=descending)
+    return out.astype(_i64)
+
+
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    """ref: operators/top_k_v2_op.cc. Returns (values, indices)."""
+    del sorted
+    if axis != -1 and axis != x.ndim - 1:
+        x_m = jnp.moveaxis(x, axis, -1)
+        v, i = topk(x_m, k, axis=-1, largest=largest)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        v, i = lax.top_k(x, k)
+    else:
+        v, i = lax.top_k(-x, k)
+        v = -v
+    return v, i.astype(_i64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vals = jnp.take(v, k - 1, axis=axis)
+    idxs = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False):
+    # O(n^2) comparison-matrix count; fine for API-parity use cases.
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("mode only supports the last axis")
+    counts = jnp.sum(jnp.expand_dims(x, -1) == jnp.expand_dims(x, -2), axis=-1)
+    idx = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals, idx = vals[..., None], idx[..., None]
+    return vals, idx.astype(_i64)
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent output shape — host-only (not jittable)."""
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else _i64)
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)

@@ -1,0 +1,23 @@
+"""paddle_tpu.optimizer (ref: python/paddle/optimizer/ + fluid/optimizer.py)."""
+from . import lr
+from .grad_clip import (
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+    GradientClipByGlobalNorm,
+    GradientClipByNorm,
+    GradientClipByValue,
+)
+from .optimizer import Optimizer
+from .optimizers import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,53 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py — GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm).  Each is a callable over the
+grad pytree, composable inside jitted steps."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max),
+                                      grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor L2 norm clip (ref: clip.py GradientClipByNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            norm = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree_util.tree_map(clip, grads)
+
+
+class ClipGradByGlobalNorm:
+    """Global L2 norm clip (ref: clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                   for g in leaves))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+# Reference-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

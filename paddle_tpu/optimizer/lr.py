@@ -1,0 +1,230 @@
+"""LR schedulers (ref: python/paddle/optimizer/lr.py + fluid/layers/
+learning_rate_scheduler.py: noam, exponential, natural_exp, inverse_time,
+polynomial, piecewise, cosine, linear warmup...).
+
+Each scheduler computes lr from an integer step — pure, so it traces into
+jitted train steps (``get_lr_at`` accepts a traced step).  The stateful
+``step()``/``get_lr()`` mirror the reference's epoch-driven API.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()
+
+    def get_lr_at(self, step):
+        raise NotImplementedError
+
+    def get_lr(self):
+        return self.last_lr
+
+    def step(self, epoch=None):
+        self.last_epoch = (self.last_epoch + 1) if epoch is None else epoch
+        self.last_lr = float(self.get_lr_at(max(self.last_epoch, 0)))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, d):
+        self.last_epoch = d["last_epoch"]
+        self.last_lr = d["last_lr"]
+
+
+class NoamDecay(LRScheduler):
+    """ref: learning_rate_scheduler.py noam_decay — the transformer schedule."""
+
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1,
+                 verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        return self.base_lr * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(step, 1.0) / self.decay_steps)
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, decay_steps)
+        return ((self.base_lr - self.end_lr) *
+                (1 - step / decay_steps) ** self.power + self.end_lr)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries, jnp.float32), step,
+                               side="right")
+        return jnp.asarray(self.values, jnp.float32)[idx]
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1,
+                 verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + jnp.cos(math.pi * jnp.minimum(step, self.T_max) / self.T_max))
+
+
+class LinearWarmup(LRScheduler):
+    """ref: fluid/layers/learning_rate_scheduler.py linear_lr_warmup."""
+
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.peak = learning_rate if not isinstance(learning_rate, LRScheduler) else None
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step, self.warmup_steps) / self.warmup_steps
+        if self.inner is not None:
+            after = self.inner.get_lr_at(jnp.maximum(step - self.warmup_steps, 0))
+        else:
+            after = jnp.asarray(self.peak, jnp.float32)
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / self.step_size)
+        return self.base_lr * self.gamma ** k
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        k = jnp.searchsorted(jnp.asarray(self.milestones, jnp.float32), step,
+                             side="right")
+        return self.base_lr * self.gamma ** k
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven; host-side only (not traceable by design — ref
+    optimizer/lr.py ReduceOnPlateau)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, cooldown=0, min_lr=0, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._num_bad = 0
+        self._cooldown_counter = 0
+        self._current = learning_rate
+        super().__init__(learning_rate, -1, verbose)
+
+    def get_lr_at(self, step):
+        return jnp.asarray(self._current, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            self.last_epoch += 1
+            self.last_lr = float(self._current)
+            return
+        value = float(metrics)
+        better = (self._best is None or
+                  (self.mode == "min" and value < self._best - self.threshold) or
+                  (self.mode == "max" and value > self._best + self.threshold))
+        if better:
+            self._best = value
+            self._num_bad = 0
+        elif self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+        else:
+            self._num_bad += 1
+            if self._num_bad > self.patience:
+                self._current = max(self._current * self.factor, self.min_lr)
+                self._cooldown_counter = self.cooldown
+                self._num_bad = 0
+        self.last_lr = float(self._current)

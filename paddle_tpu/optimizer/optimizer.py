@@ -1,0 +1,142 @@
+"""Optimizer base.
+
+Reference parity: python/paddle/fluid/optimizer.py:56 ``Optimizer`` (5.2K LoC,
+_create_optimization_pass emitting per-param update *ops*) and the fused CUDA
+optimizer kernels (operators/optimizers/, SURVEY.md N30).  TPU-native design:
+each optimizer is a pure pair ``init(params) -> state`` /
+``update(grads, state, params, lr) -> (new_params, new_state)`` over pytrees —
+inside a jitted train step XLA fuses the whole update into the backward pass
+(the reference needs hand-fused adam_op kernels for this).  The stateful
+facade binds a Layer's parameters so eager code can call ``step(grads)``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.base import Layer, Parameter
+from .lr import LRScheduler
+
+
+def _tree_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+class Optimizer:
+    """Base: subclasses implement ``init_param_state`` and ``param_update``.
+
+    Can be used two ways:
+    * Stateful (paddle dygraph style): ``opt = Adam(0.001, parameters=model.
+      parameters())``; after computing ``grads`` (a dict or list aligned with
+      the parameters), call ``opt.step(grads)``.
+    * Functional (jit style): ``state = opt.init(params)``;
+      ``params, state = opt.update(grads, state, params)`` inside a jitted
+      step.
+    """
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters: Optional[list] = list(parameters) if parameters else None
+        self._layer: Optional[Layer] = None
+        self.weight_decay = weight_decay or 0.0
+        self.grad_clip = grad_clip
+        self._state = None
+        self._step_count = 0
+        self.name = name
+
+    # -- learning rate -------------------------------------------------------
+    def get_lr(self, step: Optional[int] = None):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr.get_lr_at(self._step_count if step is None else step)
+        return self._lr
+
+    def set_lr(self, lr):
+        self._lr = lr
+
+    def set_state_dict(self, state):
+        self._state = state.get("state", self._state)
+        self._step_count = state.get("step", self._step_count)
+
+    def state_dict(self):
+        return {"state": self._state, "step": self._step_count}
+
+    # -- functional core -----------------------------------------------------
+    def init(self, params) -> Any:
+        """params: pytree of arrays -> optimizer state.
+
+        Per-parameter slot state is kept as a list aligned with the flattened
+        parameter leaves (robust to any pytree structure, itself a valid
+        pytree for jit carry).
+        """
+        leaves = jax.tree_util.tree_leaves(params)
+        return {"per_param": [self.init_param_state(p) for p in leaves],
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr=None):
+        """Returns (new_params, new_state).  Pure; jit-safe."""
+        step = state["step"] + 1
+        if lr is None:
+            if isinstance(self._lr, LRScheduler):
+                lr = self._lr.get_lr_at(step)
+            else:
+                lr = self._lr
+        lr = jnp.asarray(lr, jnp.float32)
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        if self.weight_decay:
+            wd = jnp.asarray(self.weight_decay, jnp.float32)
+            g_leaves = [g + wd * p.astype(g.dtype) if self._decay_applies(p) else g
+                        for g, p in zip(g_leaves, p_leaves)]
+        new_p, new_s = [], []
+        for g, p, s in zip(g_leaves, p_leaves, state["per_param"]):
+            np_, ns_ = self.param_update(g, p, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"per_param": new_s, "step": step})
+
+    def _decay_applies(self, g):
+        return True
+
+    # -- subclass interface --------------------------------------------------
+    def init_param_state(self, p) -> Any:
+        return ()
+
+    def param_update(self, g, p, s, lr, step):
+        raise NotImplementedError
+
+    # -- stateful facade -----------------------------------------------------
+    def _param_list(self):
+        if self._parameters is None:
+            raise ValueError("Optimizer created without parameters; pass "
+                             "parameters= or use the functional init/update API")
+        return self._parameters
+
+    def step(self, grads=None):
+        """Apply ``grads`` (dict keyed like enumerate order, list, or pytree
+        matching the parameter list) to the bound parameters in place."""
+        params = self._param_list()
+        if grads is None:
+            raise ValueError(
+                "step() needs explicit grads: this framework has no global "
+                "tape; compute grads via paddle_tpu.autograd.value_and_grad")
+        if isinstance(grads, dict):
+            grads = list(grads.values())
+        values = [p.value for p in params]
+        if self._state is None:
+            self._state = self.init(values)
+        new_values, self._state = self.update(list(grads), self._state, values)
+        for p, v in zip(params, new_values):
+            p.value = v
+        self._step_count += 1
+
+    def clear_grad(self):
+        """API parity no-op (grads are not stored on parameters)."""
+
+    def minimize(self, loss_and_grads):
+        raise NotImplementedError("use step(grads) or the functional API")

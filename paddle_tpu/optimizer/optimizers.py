@@ -1,0 +1,237 @@
+"""Concrete optimizers.
+
+Reference parity: operators/optimizers/ fused kernels (sgd_op, momentum_op +
+LARS variant, adam_op, lamb_op, adagrad, adadelta, rmsprop, adamax) and the
+python optimizer classes (fluid/optimizer.py SGD:947, Momentum, Adam:1821,
+Lamb:2930, LarsMomentum:1591; paddle/optimizer/*).  Formulas follow the
+reference ops' documented math; XLA fuses each update into the step program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    """ref: operators/optimizers/sgd_op.cc."""
+
+    def param_update(self, g, p, s, lr, step):
+        return p - lr.astype(p.dtype) * g, s
+
+
+class Momentum(Optimizer):
+    """ref: operators/optimizers/momentum_op.h (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def init_param_state(self, p):
+        return jnp.zeros_like(p)
+
+    def param_update(self, g, p, v, lr, step):
+        lr = lr.astype(p.dtype)
+        v_new = self.momentum * v + g
+        if self.use_nesterov:
+            p_new = p - lr * (g + self.momentum * v_new)
+        else:
+            p_new = p - lr * v_new
+        return p_new, v_new
+
+
+class Adam(Optimizer):
+    """ref: operators/optimizers/adam_op.h — bias-corrected Adam; moments kept
+    in float32 even for bf16 params (TPU master-weight practice)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        del lazy_mode  # sparse rows path is dense on XLA
+
+    def init_param_state(self, p):
+        return (jnp.zeros(p.shape, jnp.float32), jnp.zeros(p.shape, jnp.float32))
+
+    def param_update(self, g, p, s, lr, step):
+        m, v = s
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), (m, v)
+
+
+class AdamW(Adam):
+    """ref: paddle/optimizer/adamw.py — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, grad_clip=None, name=None,
+                 apply_decay_param_fun=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name)
+        self._decoupled_wd = weight_decay
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+    def param_update(self, g, p, s, lr, step):
+        p_new, s_new = super().param_update(g, p, s, lr, step)
+        decay = lr.astype(p.dtype) * jnp.asarray(self._decoupled_wd, p.dtype)
+        p_new = p_new - decay * p
+        return p_new, s_new
+
+
+class Adamax(Optimizer):
+    """ref: operators/optimizers/adamax_op.h."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_param_state(self, p):
+        return (jnp.zeros(p.shape, jnp.float32), jnp.zeros(p.shape, jnp.float32))
+
+    def param_update(self, g, p, s, lr, step):
+        m, u = s
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g32) + self.epsilon)
+        t = step.astype(jnp.float32)
+        upd = lr / (1 - self.beta1 ** t) * m / u
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), (m, u)
+
+
+class Adagrad(Optimizer):
+    """ref: operators/optimizers/adagrad_op.h."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def init_param_state(self, p):
+        return jnp.full(p.shape, self.initial_accumulator_value, jnp.float32)
+
+    def param_update(self, g, p, acc, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = acc + jnp.square(g32)
+        upd = lr * g32 / (jnp.sqrt(acc) + self.epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), acc
+
+
+class Adadelta(Optimizer):
+    """ref: operators/optimizers/adadelta_op.h."""
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.epsilon, self.rho = epsilon, rho
+
+    def init_param_state(self, p):
+        return (jnp.zeros(p.shape, jnp.float32), jnp.zeros(p.shape, jnp.float32))
+
+    def param_update(self, g, p, s, lr, step):
+        avg_sq_g, avg_sq_u = s
+        g32 = g.astype(jnp.float32)
+        avg_sq_g = self.rho * avg_sq_g + (1 - self.rho) * jnp.square(g32)
+        upd = jnp.sqrt(avg_sq_u + self.epsilon) / jnp.sqrt(
+            avg_sq_g + self.epsilon) * g32
+        avg_sq_u = self.rho * avg_sq_u + (1 - self.rho) * jnp.square(upd)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), (avg_sq_g, avg_sq_u)
+
+
+class RMSProp(Optimizer):
+    """ref: operators/optimizers/rmsprop_op.h (centered option)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.rho, self.epsilon, self.momentum, self.centered = (
+            rho, epsilon, momentum, centered)
+
+    def init_param_state(self, p):
+        return (jnp.zeros(p.shape, jnp.float32), jnp.zeros(p.shape, jnp.float32),
+                jnp.zeros(p.shape, jnp.float32))
+
+    def param_update(self, g, p, s, lr, step):
+        ms, mg, mom = s
+        g32 = g.astype(jnp.float32)
+        ms = self.rho * ms + (1 - self.rho) * jnp.square(g32)
+        if self.centered:
+            mg = self.rho * mg + (1 - self.rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * mom + lr * g32 / denom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), (ms, mg, mom)
+
+
+class Lamb(Optimizer):
+    """ref: operators/optimizers/lamb_op.h + fluid/optimizer.py:2930 — Adam
+    update rescaled by trust ratio ||p|| / ||update||."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.lamb_weight_decay = lamb_weight_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def init_param_state(self, p):
+        return (jnp.zeros(p.shape, jnp.float32), jnp.zeros(p.shape, jnp.float32))
+
+    def param_update(self, g, p, s, lr, step):
+        m, v = s
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + self.lamb_weight_decay * p32
+        p_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), (m, v)
+
+
+class LarsMomentum(Optimizer):
+    """ref: operators/optimizers/lars_momentum_op.cc + fluid/optimizer.py:1591
+    — layer-wise adaptive rate scaling for large-batch SGD."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def init_param_state(self, p):
+        return jnp.zeros(p.shape, jnp.float32)
+
+    def param_update(self, g, p, vel, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self.lars_coeff * p_norm /
+            (g_norm + self.lars_weight_decay * p_norm + self.epsilon),
+            1.0)
+        v_new = self.momentum * vel + lr * local_lr * (
+            g32 + self.lars_weight_decay * p32)
+        return (p32 - v_new).astype(p.dtype), v_new

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU platform so distributed
+tests exercise real mesh shardings without TPU hardware (SURVEY.md §4 note:
+the reference simulates multi-node with multi-process on localhost; we
+simulate a pod with a virtual device mesh).
+
+Note: the environment's sitecustomize imports jax at interpreter startup to
+register the TPU-tunnel PJRT plugin, so JAX_PLATFORMS set here via os.environ
+is too late — we must go through jax.config before any backend initializes.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

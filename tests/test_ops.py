@@ -1,0 +1,247 @@
+"""Op library numeric tests against numpy references — the rebuild's analogue
+of the reference's OpTest pattern (unittests/op_test.py:170 check_output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = pd.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == (2, 2)
+        assert x.dtype == pd.float32
+        np.testing.assert_allclose(_np(x), [[1, 2], [3, 4]])
+
+    def test_full_zeros_ones(self):
+        assert _np(pd.full([2, 3], 7)).tolist() == [[7] * 3] * 2
+        assert pd.zeros([4]).dtype == pd.float32
+        assert pd.ones([2, 2], dtype="int32").dtype == pd.int32
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(_np(pd.arange(5)), np.arange(5))
+        np.testing.assert_allclose(_np(pd.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(_np(pd.eye(3)), np.eye(3, dtype=np.float32))
+
+    def test_tril_triu_diag(self):
+        x = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_array_equal(_np(pd.tril(pd.to_tensor(x))), np.tril(x))
+        np.testing.assert_array_equal(_np(pd.triu(pd.to_tensor(x), 1)), np.triu(x, 1))
+        d = pd.diag(pd.to_tensor([1.0, 2.0]), padding_value=-1.0)
+        np.testing.assert_array_equal(_np(d), [[1, -1], [-1, 2]])
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = pd.to_tensor(a), pd.to_tensor(b)
+        np.testing.assert_allclose(_np(pd.add(ta, tb)), a + b, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.subtract(ta, tb)), a - b, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.multiply(ta, tb)), a * b, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.divide(ta, tb)), a / b, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.maximum(ta, tb)), np.maximum(a, b))
+        np.testing.assert_allclose(_np(pd.pow(ta, 2.0)), a ** 2, rtol=1e-5)
+
+    def test_unary(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        t = pd.to_tensor(a)
+        np.testing.assert_allclose(_np(pd.sqrt(t)), np.sqrt(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.exp(t)), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.log(t)), np.log(a), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(_np(pd.rsqrt(t)), 1 / np.sqrt(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.tanh(t)), np.tanh(a), rtol=1e-5)
+        import math
+
+        np.testing.assert_allclose(_np(pd.erf(t)), [math.erf(v) for v in a], rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.rand(4, 5).astype(np.float32)
+        t = pd.to_tensor(a)
+        np.testing.assert_allclose(_np(pd.sum(t)), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.mean(t, axis=1)), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.max(t, axis=0)), a.max(0))
+        np.testing.assert_allclose(_np(pd.std(t)), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.logsumexp(t)), np.log(np.exp(a).sum()), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.prod(t, axis=1)), a.prod(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.cumsum(t, axis=0)), a.cumsum(0), rtol=1e-5)
+
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(_np(pd.matmul(pd.to_tensor(a), pd.to_tensor(b))),
+                                   a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(pd.matmul(pd.to_tensor(a), pd.to_tensor(b.T), transpose_y=True)),
+            a @ b, rtol=1e-5)
+        c = np.random.rand(2, 3, 4).astype(np.float32)
+        d = np.random.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(_np(pd.bmm(pd.to_tensor(c), pd.to_tensor(d))),
+                                   c @ d, rtol=1e-5)
+
+    def test_scale_clip(self):
+        a = np.linspace(-2, 2, 9).astype(np.float32)
+        t = pd.to_tensor(a)
+        np.testing.assert_allclose(_np(pd.scale(t, 2.0, 1.0)), a * 2 + 1, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.scale(t, 2.0, 1.0, bias_after_scale=False)),
+                                   (a + 1) * 2, rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.clip(t, -1, 1)), np.clip(a, -1, 1))
+
+    def test_add_n_einsum(self):
+        xs = [np.random.rand(2, 2).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(_np(pd.add_n([pd.to_tensor(x) for x in xs])),
+                                   sum(xs), rtol=1e-5)
+        a, b = xs[0], xs[1]
+        np.testing.assert_allclose(_np(pd.einsum("ij,jk->ik", pd.to_tensor(a), pd.to_tensor(b))),
+                                   a @ b, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat_split(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = pd.to_tensor(a)
+        assert pd.reshape(t, [4, 6]).shape == (4, 6)
+        np.testing.assert_array_equal(_np(pd.transpose(t, [2, 0, 1])), a.transpose(2, 0, 1))
+        c = pd.concat([t, t], axis=1)
+        assert c.shape == (2, 6, 4)
+        parts = pd.split(t, [1, -1], axis=1)
+        assert parts[0].shape == (2, 1, 4) and parts[1].shape == (2, 2, 4)
+
+    def test_squeeze_unsqueeze_flatten(self):
+        a = np.zeros((2, 1, 3), np.float32)
+        t = pd.to_tensor(a)
+        assert pd.squeeze(t, 1).shape == (2, 3)
+        assert pd.unsqueeze(t, [0, 3]).shape == (1, 2, 1, 1, 3)
+        assert pd.flatten(t, 1, 2).shape == (2, 3)
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([2, 0])
+        np.testing.assert_array_equal(_np(pd.gather(pd.to_tensor(a), pd.to_tensor(idx))),
+                                      a[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = pd.scatter(pd.to_tensor(a), pd.to_tensor(idx), pd.to_tensor(upd))
+        expect = a.copy()
+        expect[idx] = upd
+        np.testing.assert_array_equal(_np(out), expect)
+
+    def test_expand_tile_stack(self):
+        a = np.ones((1, 3), np.float32)
+        assert pd.expand(pd.to_tensor(a), [4, 3]).shape == (4, 3)
+        assert pd.tile(pd.to_tensor(a), [2, 2]).shape == (2, 6)
+        s = pd.stack([pd.to_tensor(a), pd.to_tensor(a)], axis=0)
+        assert s.shape == (2, 1, 3)
+
+    def test_gather_nd_take_along(self):
+        a = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+        idx = np.array([[0, 1], [1, 0]])
+        np.testing.assert_array_equal(_np(pd.gather_nd(pd.to_tensor(a), pd.to_tensor(idx))),
+                                      np.stack([a[0, 1], a[1, 0]]))
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        a = np.array([1, 2, 3])
+        b = np.array([2, 2, 2])
+        np.testing.assert_array_equal(_np(pd.less_than(pd.to_tensor(a), pd.to_tensor(b))),
+                                      a < b)
+        assert bool(pd.equal_all(pd.to_tensor(a), pd.to_tensor(a)))
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        y = np.zeros(3, np.float32)
+        np.testing.assert_array_equal(_np(pd.where(pd.to_tensor(c), pd.to_tensor(x),
+                                                   pd.to_tensor(y))), np.where(c, x, y))
+
+    def test_argmax_topk_sort(self):
+        a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        t = pd.to_tensor(a)
+        np.testing.assert_array_equal(_np(pd.argmax(t, axis=1)), a.argmax(1))
+        v, i = pd.topk(t, 2, axis=1)
+        np.testing.assert_array_equal(_np(v), np.sort(a, 1)[:, ::-1][:, :2])
+        np.testing.assert_array_equal(_np(pd.sort(t, axis=1)), np.sort(a, 1))
+        np.testing.assert_array_equal(_np(pd.argsort(t, axis=1)), a.argsort(1))
+
+    def test_masked_fill_searchsorted(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        m = np.array([True, False, True])
+        np.testing.assert_array_equal(
+            _np(pd.masked_fill(pd.to_tensor(a), pd.to_tensor(m), 0.0)), [0, 2, 0])
+        ss = pd.searchsorted(pd.to_tensor(np.array([1.0, 3.0, 5.0])), pd.to_tensor(a))
+        np.testing.assert_array_equal(_np(ss), np.searchsorted([1.0, 3.0, 5.0], a))
+
+
+class TestRandom:
+    def test_reproducible_after_seed(self):
+        pd.seed(7)
+        a = pd.uniform([4, 4])
+        pd.seed(7)
+        b = pd.uniform([4, 4])
+        np.testing.assert_array_equal(_np(a), _np(b))
+
+    def test_shapes_ranges(self):
+        u = pd.uniform([100], min=2.0, max=3.0)
+        assert float(pd.min(u)) >= 2.0 and float(pd.max(u)) <= 3.0
+        r = pd.randint(0, 10, [100])
+        assert r.dtype == pd.int64
+        assert int(pd.min(r)) >= 0 and int(pd.max(r)) < 10
+        p = pd.randperm(16)
+        assert sorted(_np(p).tolist()) == list(range(16))
+
+    def test_normal_stats(self):
+        x = pd.randn([10000])
+        assert abs(float(pd.mean(x))) < 0.1
+        assert abs(float(pd.std(x)) - 1.0) < 0.1
+
+
+class TestLinalg:
+    def test_norm_inverse_solve(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        t = pd.to_tensor(a)
+        np.testing.assert_allclose(_np(pd.norm(t)), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(pd.inverse(t)), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        b = np.random.rand(4).astype(np.float32)
+        np.testing.assert_allclose(_np(pd.solve(t, pd.to_tensor(b))),
+                                   np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+
+    def test_cholesky_det(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        L = _np(pd.cholesky(pd.to_tensor(spd)))
+        np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(pd.det(pd.to_tensor(spd))), np.linalg.det(spd),
+                                   rtol=1e-4)
+
+
+class TestAttention:
+    def test_sdpa_matches_manual(self):
+        b, h, s, d = 2, 2, 8, 4
+        q = np.random.rand(b, h, s, d).astype(np.float32)
+        k = np.random.rand(b, h, s, d).astype(np.float32)
+        v = np.random.rand(b, h, s, d).astype(np.float32)
+        out = _np(pd.scaled_dot_product_attention(pd.to_tensor(q), pd.to_tensor(k),
+                                                  pd.to_tensor(v)))
+        logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, w @ v, rtol=1e-4, atol=1e-5)
+
+    def test_causal_mask(self):
+        q = np.random.rand(1, 1, 6, 4).astype(np.float32)
+        out = pd.scaled_dot_product_attention(
+            pd.to_tensor(q), pd.to_tensor(q), pd.to_tensor(q), is_causal=True)
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(_np(out)[0, 0, 0], q[0, 0, 0], rtol=1e-5)
+
+    def test_flash_fallback_matches_sdpa(self):
+        # On CPU this exercises the fallback path end-to-end.
+        q = np.random.rand(1, 2, 16, 8).astype(np.float32)
+        a = pd.flash_attention(pd.to_tensor(q), pd.to_tensor(q), pd.to_tensor(q))
+        b = pd.scaled_dot_product_attention(pd.to_tensor(q), pd.to_tensor(q),
+                                            pd.to_tensor(q))
+        np.testing.assert_allclose(_np(a), _np(b), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,205 @@
+"""Optimizer math tests against hand-computed references (analogue of
+unittests/test_sgd_op.py, test_adam_op.py, test_momentum_op.py ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    AdamW,
+    Adamax,
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    RMSProp,
+    lr as lr_sched,
+)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def run_steps(opt, p0, grads_seq):
+    params = [pd.to_tensor(p0)]
+    state = opt.init(params)
+    for g in grads_seq:
+        params, state = opt.update([pd.to_tensor(g)], state, params)
+    return _np(params[0])
+
+
+class TestOptimizerMath:
+    def test_sgd(self):
+        p = np.array([1.0, 2.0], np.float32)
+        g = np.array([0.5, -0.5], np.float32)
+        out = run_steps(SGD(learning_rate=0.1), p, [g])
+        np.testing.assert_allclose(out, p - 0.1 * g, rtol=1e-6)
+
+    def test_momentum_two_steps(self):
+        p = np.array([1.0], np.float32)
+        g = np.array([1.0], np.float32)
+        out = run_steps(Momentum(learning_rate=0.1, momentum=0.9), p, [g, g])
+        # v1=1, p1=1-0.1; v2=0.9+1=1.9, p2=p1-0.19
+        np.testing.assert_allclose(out, [1 - 0.1 - 0.19], rtol=1e-5)
+
+    def test_nesterov_momentum(self):
+        p = np.array([1.0], np.float32)
+        g = np.array([1.0], np.float32)
+        out = run_steps(Momentum(learning_rate=0.1, momentum=0.9,
+                                 use_nesterov=True), p, [g])
+        np.testing.assert_allclose(out, [1 - 0.1 * (1 + 0.9)], rtol=1e-5)
+
+    def test_adam_first_step_equals_lr(self):
+        # with bias correction, |update_1| == lr regardless of grad scale
+        p = np.array([1.0], np.float32)
+        out = run_steps(Adam(learning_rate=0.01, epsilon=1e-12), p,
+                        [np.array([123.0], np.float32)])
+        np.testing.assert_allclose(out, [1.0 - 0.01], rtol=1e-4)
+
+    def test_adam_matches_manual_two_steps(self):
+        lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+        p = np.array([0.5], np.float64)
+        m = v = 0.0
+        grads = [np.array([0.3]), np.array([-0.2])]
+        pp = p.copy()
+        for t, g in enumerate(grads, 1):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mh = m / (1 - b1 ** t)
+            vh = v / (1 - b2 ** t)
+            pp = pp - lr * mh / (np.sqrt(vh) + eps)
+        out = run_steps(Adam(learning_rate=lr), np.array([0.5], np.float32),
+                        [g.astype(np.float32) for g in grads])
+        np.testing.assert_allclose(out, pp, rtol=1e-4)
+
+    def test_adamw_decoupled_decay(self):
+        p = np.array([1.0], np.float32)
+        g = np.array([0.0], np.float32)
+        out = run_steps(AdamW(learning_rate=0.1, weight_decay=0.5), p, [g])
+        # zero grad -> pure decay: p - lr*wd*p
+        np.testing.assert_allclose(out, [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+    def test_adagrad(self):
+        p = np.array([1.0], np.float32)
+        g = np.array([2.0], np.float32)
+        out = run_steps(Adagrad(learning_rate=0.1, epsilon=1e-6), p, [g])
+        np.testing.assert_allclose(out, [1 - 0.1 * 2 / (2 + 1e-6)], rtol=1e-5)
+
+    def test_rmsprop(self):
+        p = np.array([1.0], np.float32)
+        g = np.array([1.0], np.float32)
+        out = run_steps(RMSProp(learning_rate=0.1, rho=0.9, epsilon=1e-6), p, [g])
+        ms = 0.1 * 1.0
+        np.testing.assert_allclose(out, [1 - 0.1 / np.sqrt(ms + 1e-6)], rtol=1e-4)
+
+    def test_lamb_trust_ratio(self):
+        p = np.array([3.0, 4.0], np.float32)  # norm 5
+        g = np.array([0.1, 0.1], np.float32)
+        out = run_steps(Lamb(learning_rate=0.01, lamb_weight_decay=0.0), p, [g])
+        assert np.all(np.isfinite(out)) and np.all(out < p)
+
+    def test_lars(self):
+        p = np.ones([4], np.float32)
+        g = np.full([4], 0.5, np.float32)
+        out = run_steps(LarsMomentum(learning_rate=0.1, momentum=0.9), p, [g])
+        assert np.all(np.isfinite(out)) and np.all(out < p)
+
+    def test_adadelta_adamax_finite(self):
+        p = np.ones([3], np.float32)
+        g = np.full([3], 0.2, np.float32)
+        for opt in (Adadelta(learning_rate=1.0), Adamax(learning_rate=0.1)):
+            out = run_steps(opt, p, [g, g, g])
+            assert np.all(np.isfinite(out))
+            assert np.all(out < p)
+
+    def test_weight_decay_l2(self):
+        p = np.array([2.0], np.float32)
+        g = np.array([0.0], np.float32)
+        out = run_steps(SGD(learning_rate=0.1, weight_decay=0.1), p, [g])
+        np.testing.assert_allclose(out, [2.0 - 0.1 * 0.1 * 2.0], rtol=1e-5)
+
+
+class TestStatefulFacade:
+    def test_step_updates_layer_params(self):
+        m = nn.Linear(2, 2, bias_attr=False)
+        before = _np(m.weight.value).copy()
+        opt = SGD(learning_rate=0.5, parameters=m.parameters())
+        g = np.ones((2, 2), np.float32)
+        opt.step([pd.to_tensor(g)])
+        np.testing.assert_allclose(_np(m.weight.value), before - 0.5, rtol=1e-5)
+
+
+class TestGradClip:
+    def test_by_value(self):
+        g = {"a": pd.to_tensor(np.array([-3.0, 0.5, 3.0], np.float32))}
+        out = ClipGradByValue(1.0)(g)
+        np.testing.assert_allclose(_np(out["a"]), [-1, 0.5, 1])
+
+    def test_by_norm(self):
+        g = {"a": pd.to_tensor(np.array([3.0, 4.0], np.float32))}  # norm 5
+        out = ClipGradByNorm(1.0)(g)
+        np.testing.assert_allclose(_np(out["a"]), [0.6, 0.8], rtol=1e-5)
+
+    def test_by_global_norm(self):
+        g = {"a": pd.to_tensor(np.array([3.0], np.float32)),
+             "b": pd.to_tensor(np.array([4.0], np.float32))}
+        out = ClipGradByGlobalNorm(1.0)(g)
+        total = np.sqrt(_np(out["a"])[0] ** 2 + _np(out["b"])[0] ** 2)
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_no_clip_when_small(self):
+        g = {"a": pd.to_tensor(np.array([0.1], np.float32))}
+        out = ClipGradByGlobalNorm(1.0)(g)
+        np.testing.assert_allclose(_np(out["a"]), [0.1], rtol=1e-6)
+
+
+class TestLRSchedulers:
+    def test_noam_peak_at_warmup(self):
+        s = lr_sched.NoamDecay(d_model=512, warmup_steps=100)
+        vals = [float(s.get_lr_at(t)) for t in [1, 50, 100, 200, 1000]]
+        assert vals[2] == max(vals)
+
+    def test_exponential(self):
+        s = lr_sched.ExponentialDecay(0.1, 0.5)
+        np.testing.assert_allclose(float(s.get_lr_at(2)), 0.025, rtol=1e-5)
+
+    def test_piecewise(self):
+        s = lr_sched.PiecewiseDecay([10, 20], [1.0, 0.5, 0.1])
+        assert float(s.get_lr_at(5)) == 1.0
+        assert float(s.get_lr_at(15)) == 0.5
+        assert float(s.get_lr_at(25)) == pytest.approx(0.1)
+
+    def test_cosine(self):
+        s = lr_sched.CosineAnnealingDecay(1.0, T_max=100)
+        assert float(s.get_lr_at(0)) == pytest.approx(1.0)
+        assert float(s.get_lr_at(100)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup_wrapping_scheduler(self):
+        inner = lr_sched.ExponentialDecay(0.1, 0.9)
+        s = lr_sched.LinearWarmup(inner, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        assert float(s.get_lr_at(5)) == pytest.approx(0.05)
+        assert float(s.get_lr_at(10)) == pytest.approx(0.1)
+
+    def test_scheduler_in_optimizer(self):
+        sched = lr_sched.StepDecay(0.1, step_size=1, gamma=0.5)
+        opt = SGD(learning_rate=sched)
+        p = [pd.to_tensor(np.array([1.0], np.float32))]
+        state = opt.init(p)
+        g = [pd.to_tensor(np.array([1.0], np.float32))]
+        # step 1 -> lr = 0.1*0.5^1 = 0.05 (step counts from 1)
+        p1, state = opt.update(g, state, p)
+        np.testing.assert_allclose(_np(p1[0]), [1.0 - 0.05], rtol=1e-5)
+
+    def test_reduce_on_plateau(self):
+        s = lr_sched.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s.last_lr < 0.1
