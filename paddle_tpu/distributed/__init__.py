@@ -1,0 +1,5 @@
+"""paddle_tpu.distributed — mesh-based parallelism (ref: python/paddle/
+distributed/).  Collectives/fleet populate in distributed.collective and
+distributed.fleet; env holds rank/world/mesh context."""
+from . import env
+from .env import ParallelEnv, get_rank, get_world_size

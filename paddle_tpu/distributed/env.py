@@ -1,0 +1,73 @@
+"""Process/mesh environment (ref: dygraph/parallel.py:62 ``ParallelEnv`` env-var
+topology + fleet role_maker).  TPU-native: rank/world come from
+jax.distributed (multi-host) or default to single-process; the device mesh is
+a process-global ``jax.sharding.Mesh`` managed by distributed.mesh."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_data_axis_stack = []
+
+
+def get_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def current_data_axis() -> Optional[str]:
+    """The mesh axis name data-parallel collectives should reduce over when
+    called inside a shard_map'd region (set by parallelize/shard_map wrappers)."""
+    return _data_axis_stack[-1] if _data_axis_stack else None
+
+
+class _DataAxisScope:
+    def __init__(self, axis: str):
+        self.axis = axis
+
+    def __enter__(self):
+        _data_axis_stack.append(self.axis)
+        return self
+
+    def __exit__(self, *exc):
+        _data_axis_stack.pop()
+        return False
+
+
+def data_axis_scope(axis: str) -> _DataAxisScope:
+    return _DataAxisScope(axis)
+
+
+class ParallelEnv:
+    """ref: dygraph/parallel.py:62."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0

@@ -1,0 +1,3 @@
+"""hapi — high-level Model API (ref: python/paddle/hapi/model.py:788)."""
+from . import callbacks
+from .model import Model

@@ -1,0 +1,20 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader (ref: paddle/io/ which
+re-exports fluid/dataloader; C++ side ref: operators/reader/ +
+framework/data_feed.* whose role host-side numpy threading covers here)."""
+from .dataloader import DataLoader, default_collate_fn
+from .dataset import (
+    ChainDataset,
+    ComposeDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+)

@@ -1,0 +1,145 @@
+"""DataLoader.
+
+Reference parity: fluid/reader.py:123 ``DataLoader`` + fluid/dataloader/
+(multiprocess workers over shared-memory mmap queues, operators/reader/
+buffered_reader.cc double-buffering to device).  TPU-native design: worker
+*threads* feed a bounded prefetch queue (numpy batching releases the GIL for
+the heavy copies; the reference needs processes because its Python workers do
+per-op python dispatch); device staging happens once per step inside the
+jitted train step, and double-buffering falls out of JAX's async dispatch.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (ref: fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int32)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return np.asarray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = 1,
+                 shuffle: bool = False, drop_last: bool = False,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 collate_fn: Optional[Callable] = None, num_workers: int = 0,
+                 prefetch_factor: int = 2, return_list: bool = True,
+                 use_shared_memory: bool = False, timeout: int = 0):
+        del return_list, use_shared_memory, timeout  # API-parity knobs
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size or 1,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no length")
+        return len(self.batch_sampler)
+
+    # -- iteration -----------------------------------------------------------
+    def _batches(self):
+        if self._iterable:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if self.batch_size and len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers <= 0 or self._iterable:
+            yield from self._batches()
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Index batches are dealt to worker threads round-robin; results are
+        re-ordered so output order matches the sampler order."""
+        index_q: "queue.Queue" = queue.Queue()
+        out: dict = {}
+        out_cond = threading.Condition()
+        n_batches = 0
+        for i, indices in enumerate(self.batch_sampler):
+            index_q.put((i, indices))
+            n_batches += 1
+        stop = object()
+        for _ in range(self.num_workers):
+            index_q.put(stop)
+
+        max_ahead = self.num_workers * self.prefetch_factor
+        next_out = [0]
+
+        def worker():
+            while True:
+                item = index_q.get()
+                if item is stop:
+                    return
+                i, indices = item
+                try:
+                    batch = self.collate_fn([self.dataset[j] for j in indices])
+                except Exception as e:  # propagate to consumer
+                    batch = _WorkerError(e)
+                with out_cond:
+                    while i - next_out[0] > max_ahead:
+                        out_cond.wait(timeout=1.0)
+                    out[i] = batch
+                    out_cond.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(n_batches):
+                with out_cond:
+                    while i not in out:
+                        out_cond.wait(timeout=10.0)
+                    batch = out.pop(i)
+                    next_out[0] = i + 1
+                    out_cond.notify_all()
+                if isinstance(batch, _WorkerError):
+                    raise batch.exc
+                yield batch
+        finally:
+            for t in threads:
+                t.join(timeout=0.1)
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.exc = exc

@@ -1,0 +1,140 @@
+"""Metric implementations (ref: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label):
+        """Optional preprocessing run on-device inside the step; default
+        passthrough."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (ref: metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        import jax.numpy as jnp
+
+        maxk = max(self.topk)
+        pred_idx = jnp.argsort(pred, axis=-1)[..., ::-1][..., :maxk]
+        label = label.reshape(label.shape[0], -1)[:, :1]
+        correct = (pred_idx == label).astype(jnp.float32)
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[:, :k].sum()
+            self.count[i] += correct.shape[0]
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else acc
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return float(acc[0]) if len(self.topk) == 1 else acc.tolist()
+
+
+class Precision(Metric):
+    """Binary precision (ref: metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).round().astype(np.int32).reshape(-1)
+        labels = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).round().astype(np.int32).reshape(-1)
+        labels = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC by thresholded confusion accumulation (ref: metrics.py Auc /
+    operators/metrics/auc_op.cc)."""
+
+    def __init__(self, num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        # integrate TPR over FPR with trapezoids from high threshold to low
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") else \
+            float(np.trapz(tpr, fpr))

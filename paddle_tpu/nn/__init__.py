@@ -61,6 +61,14 @@ from .layer.norm import (
     RMSNorm,
     SyncBatchNorm,
 )
+from .layer.transformer import (
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
 from .layer.pooling import (
     AdaptiveAvgPool2D,
     AdaptiveMaxPool2D,

@@ -128,7 +128,7 @@ class Layer:
     def create_parameter(self, shape, dtype=None, default_initializer=None,
                          attr=None, is_bias: bool = False):
         """ref: layers.py create_parameter + LayerHelper param creation."""
-        from . import initializer as init
+        from .. import initializer as init
 
         dtype = _dtype_mod.convert_dtype(dtype) or _dtype_mod.get_default_dtype()
         if default_initializer is None:
@@ -138,14 +138,22 @@ class Layer:
         return Parameter(value, name=name)
 
     # -- traversal -----------------------------------------------------------
-    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True,
+                         _memo: Optional[set] = None
                          ) -> Iterator[Tuple[str, Parameter]]:
+        # shared (tied) Parameters are yielded once, under their first name —
+        # critical for the functional bridge: one pytree key per tensor
+        if _memo is None:
+            _memo = set()
         for name, p in self._parameters.items():
+            if id(p) in _memo:
+                continue
+            _memo.add(id(p))
             yield (f"{prefix}.{name}" if prefix else name), p
         if include_sublayers:
             for lname, layer in self._sub_layers.items():
                 sub_prefix = f"{prefix}.{lname}" if prefix else lname
-                yield from layer.named_parameters(prefix=sub_prefix)
+                yield from layer.named_parameters(prefix=sub_prefix, _memo=_memo)
 
     def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
         return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]

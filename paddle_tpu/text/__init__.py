@@ -1,0 +1,12 @@
+"""paddle_tpu.text — NLP model zoo (ref: python/paddle/text/ + the
+PaddleNLP-era ERNIE family targeted by BASELINE.json)."""
+from .ernie import (
+    BertConfig,
+    BertForPretraining,
+    BertModel,
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ErniePretrainingCriterion,
+)

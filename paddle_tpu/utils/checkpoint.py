@@ -1,0 +1,49 @@
+"""Checkpoint save/load.
+
+Reference parity: fluid/io.py save/load_persistables (:598), dygraph
+save_dygraph/load_dygraph state-dict pickles, save_op/load_op tensor
+serialization.  TPU-native: state dicts (arbitrary pytrees of arrays) are
+written as .npz plus a structure pickle — host-side, no device involvement;
+async/sharded checkpointing (orbax-style) can layer on top later.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"arr_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    return arrays, treedef
+
+
+def save(state: Any, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    arrays, treedef = _flatten(state)
+    np.savez(path + ".npz" if not path.endswith(".npz") else path, **arrays)
+    with open(path + ".tree", "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load(path: str) -> Any:
+    npz_path = path + ".npz" if not path.endswith(".npz") else path
+    if not os.path.exists(npz_path):
+        raise FileNotFoundError(npz_path)
+    data = np.load(npz_path, allow_pickle=False)
+    with open(path + ".tree", "rb") as f:
+        treedef = pickle.load(f)
+    leaves = [data[f"arr_{i}"] for i in range(len(data.files))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str) -> None:
+    save(state_dict, path)
+
+
+def load_state_dict(path: str) -> Dict[str, Any]:
+    return load(path)

@@ -1,0 +1,3 @@
+"""paddle_tpu.vision (ref: python/paddle/vision/ — models, transforms,
+datasets)."""
+from . import datasets, models, transforms

