@@ -61,6 +61,7 @@ _LAZY_SUBMODULES = (
     "amp",
     "autograd",
     "distributed",
+    "parallel",
     "static",
     "io",
     "hapi",

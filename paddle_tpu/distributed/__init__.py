@@ -1,5 +1,38 @@
-"""paddle_tpu.distributed — mesh-based parallelism (ref: python/paddle/
-distributed/).  Collectives/fleet populate in distributed.collective and
-distributed.fleet; env holds rank/world/mesh context."""
+"""paddle_tpu.distributed — user-facing distributed API (ref: python/paddle/
+distributed/).  Thin parity namespace over paddle_tpu.parallel: collectives
+(collective.py:59–:419 of the reference), ParallelEnv, init_parallel_env, and
+the fleet facade."""
 from . import env
 from .env import ParallelEnv, get_rank, get_world_size
+
+from ..parallel.mesh import init_parallel_env
+from ..parallel.collective import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    ppermute,
+    reduce,
+    reduce_scatter,
+    scatter,
+)
+from ..parallel.fleet import DistributedStrategy, fleet
+
+alltoall = all_to_all
+
+
+def spawn(func, args=(), nprocs=1, **kwargs):
+    """ref: distributed/spawn.py:231.  On TPU, multi-process launch is one
+    process per *host* handled by the runtime/launcher, not per device —
+    in-process SPMD over the mesh replaces per-GPU process spawn.  Provided
+    for API parity: runs func once in this process (single-host)."""
+    if nprocs not in (1, None):
+        raise NotImplementedError(
+            "per-device process spawn is a GPU idiom; on TPU use "
+            "init_parallel_env() + mesh sharding (one process per host)")
+    return func(*args)

@@ -104,21 +104,34 @@ class DataLoader:
         max_ahead = self.num_workers * self.prefetch_factor
         next_out = [0]
 
+        shutdown = [False]
+
         def worker():
-            while True:
-                item = index_q.get()
-                if item is stop:
-                    return
-                i, indices = item
-                try:
-                    batch = self.collate_fn([self.dataset[j] for j in indices])
-                except Exception as e:  # propagate to consumer
-                    batch = _WorkerError(e)
+            try:
+                while True:
+                    item = index_q.get()
+                    if item is stop:
+                        return
+                    i, indices = item
+                    try:
+                        batch = self.collate_fn(
+                            [self.dataset[j] for j in indices])
+                    except Exception as e:  # propagate to consumer
+                        batch = _WorkerError(e)
+                    with out_cond:
+                        while (i - next_out[0] > max_ahead
+                               and not shutdown[0]):
+                            out_cond.wait(timeout=1.0)
+                        if shutdown[0]:
+                            return
+                        out[i] = batch
+                        out_cond.notify_all()
+            except BaseException as e:  # never die silently: unblock consumer
                 with out_cond:
-                    while i - next_out[0] > max_ahead:
-                        out_cond.wait(timeout=1.0)
-                    out[i] = batch
+                    out.setdefault("error", _WorkerError(
+                        e if isinstance(e, Exception) else RuntimeError(repr(e))))
                     out_cond.notify_all()
+                raise
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(self.num_workers)]
@@ -128,7 +141,14 @@ class DataLoader:
             for i in range(n_batches):
                 with out_cond:
                     while i not in out:
-                        out_cond.wait(timeout=10.0)
+                        if "error" in out:
+                            raise out["error"].exc
+                        if (not any(t.is_alive() for t in threads)
+                                and i not in out):
+                            raise RuntimeError(
+                                "DataLoader worker threads exited without "
+                                f"producing batch {i}")
+                        out_cond.wait(timeout=1.0)
                     batch = out.pop(i)
                     next_out[0] = i + 1
                     out_cond.notify_all()
@@ -136,8 +156,13 @@ class DataLoader:
                     raise batch.exc
                 yield batch
         finally:
+            # Wake any worker blocked on the back-pressure wait so abandoned
+            # iterators (early break) release their threads promptly.
+            with out_cond:
+                shutdown[0] = True
+                out_cond.notify_all()
             for t in threads:
-                t.join(timeout=0.1)
+                t.join(timeout=1.0)
 
 
 class _WorkerError:

@@ -134,7 +134,9 @@ class Auc(Metric):
             return 0.0
         pos_cum = np.cumsum(self._stat_pos[::-1])
         neg_cum = np.cumsum(self._stat_neg[::-1])
-        tpr = pos_cum / tot_pos
-        fpr = neg_cum / tot_neg
+        # anchor the ROC curve at (0, 0) — without it, mass concentrated in
+        # the top threshold bin integrates to 0 instead of its true area
+        tpr = np.concatenate([[0.0], pos_cum / tot_pos])
+        fpr = np.concatenate([[0.0], neg_cum / tot_neg])
         return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") else \
             float(np.trapz(tpr, fpr))

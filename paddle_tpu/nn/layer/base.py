@@ -29,7 +29,8 @@ class Parameter:
     the source of truth.
     """
 
-    __slots__ = ("value", "trainable", "name", "is_distributed", "sharding_axes")
+    __slots__ = ("value", "trainable", "name", "is_distributed",
+                 "sharding_axes", "initializer")
 
     def __init__(self, value, trainable: bool = True, name: str = ""):
         self.value = jnp.asarray(value)
@@ -39,6 +40,10 @@ class Parameter:
         # Optional per-axis mesh-axis annotation used by the parallel engine
         # (e.g. ("tp", None) for a column-parallel weight).
         self.sharding_axes: Optional[Tuple] = None
+        # The initializer that produced this value, when known — lets cloned
+        # layer stacks (TransformerEncoder deep copies) re-draw fresh values
+        # from the *configured* distribution rather than a hard-coded one.
+        self.initializer = None
 
     @property
     def shape(self):
@@ -135,7 +140,9 @@ class Layer:
             default_initializer = init.Constant(0.0) if is_bias else init.XavierUniform()
         name = getattr(attr, "name", None) or ""
         value = default_initializer(shape, dtype)
-        return Parameter(value, name=name)
+        p = Parameter(value, name=name)
+        p.initializer = default_initializer
+        return p
 
     # -- traversal -----------------------------------------------------------
     def named_parameters(self, prefix: str = "", include_sublayers: bool = True,

@@ -300,10 +300,14 @@ class Transformer(Layer):
 
 
 def _reinit(layer):
-    """Re-randomize parameters of a deep-copied layer tree."""
+    """Re-draw parameters of a deep-copied layer tree from each parameter's
+    own recorded initializer, so a user-configured weight_attr distribution
+    is preserved across the cloned stack."""
     from .. import initializer as init
 
     for p in layer.parameters():
-        if p.value.ndim >= 2:
+        ini = getattr(p, "initializer", None)
+        if ini is not None:
+            p.value = ini(p.value.shape, p.value.dtype)
+        elif p.value.ndim >= 2:
             p.value = init.XavierUniform()(p.value.shape, p.value.dtype)
-        # biases/norm params keep their constant init

@@ -1,0 +1,55 @@
+"""paddle_tpu.parallel — the distributed engine.
+
+TPU-native replacement for the reference's entire multi-device stack
+(SURVEY.md §2.2/§2.3): NCCL rings + SSA graph executors + transpiler program
+rewrites (paddle/fluid/framework/parallel_executor.cc,
+python/paddle/fluid/transpiler/collective.py) collapse into one design —
+a process-global `jax.sharding.Mesh` whose named axes are the parallelism
+dimensions, sharding rules that place parameters/optimizer state on it, and
+XLA collectives (psum/all_gather/ppermute) that GSPMD inserts or that
+shard_map code issues explicitly over ICI.
+
+Axes (any subset, any sizes):
+  dp — data parallel (batch sharding; also ZeRO param/state sharding)
+  pp — pipeline parallel (stage sharding; ppermute microbatch schedule)
+  tp — tensor (model) parallel (Megatron-style weight sharding)
+  sp — sequence/context parallel (ring attention over sequence shards)
+  ep — expert parallel (MoE expert sharding)
+"""
+from . import collective, mesh, sharding
+from .mesh import (
+    DP_AXIS,
+    EP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    MeshConfig,
+    current_mesh,
+    get_mesh,
+    init_parallel_env,
+    mesh_axis_size,
+    set_mesh,
+)
+from .collective import (
+    Group,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .sharding import (
+    ShardingRules,
+    infer_sharding,
+    shard_layer,
+    shard_params,
+    shard_pytree,
+    unshard,
+)

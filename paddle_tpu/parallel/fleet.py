@@ -1,0 +1,325 @@
+"""Fleet — the unified distributed-training facade.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py:41
+(`Fleet.init` :103, `distributed_optimizer` :540, `minimize` :573), the
+protobuf `DistributedStrategy` (framework/distributed_strategy.proto:94) and
+the meta-optimizer chain (meta_optimizers/: amp, recompute, gradient_merge,
+lars, lamb, localsgd, dgc, pipeline, graph_execution).
+
+TPU-native design: `DistributedStrategy` is a typed dataclass (SURVEY.md §5.6
+recommends replacing scattered proto/gflags with one config object); `init`
+builds the hybrid mesh; `distributed_optimizer` composes the strategy into a
+`DistributedOptimizer` whose functional `update` is pure/jit-safe, so the
+whole "meta-optimizer program rewrite" collapses into ordinary function
+composition inside one pjit'd train step:
+  - amp            → bf16 compute dtype policy (+ optional dynamic loss scale
+                     retained for fp16-style parity, amp_configs)
+  - recompute      → jax.checkpoint policy applied by the train-step builder
+  - gradient_merge → k-step gradient accumulation carried in opt state
+  - localsgd       → k local steps then cross-dp param average
+  - lars/lamb      → swap the inner optimizer rule
+  - dgc            → descoped: ICI makes dense allreduce cheaper than top-k
+                     sparsification + momentum correction (documented N/A)
+  - sharding       → ZeRO stage via parallel.sharding.zero_spec
+  - pipeline/tensor/sequence degrees → mesh axes (hybrid_configs)
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import mesh as _mesh
+from . import collective as _coll
+from ..distributed import env as _env
+
+
+@dataclasses.dataclass
+class RecomputeConfig:  # proto :25 RecomputeConfig
+    checkpoints: tuple = ()
+    policy: str = "dots_saveable"  # jax.checkpoint policy name
+
+
+@dataclasses.dataclass
+class GradientMergeConfig:  # proto GradientMergeConfig
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclasses.dataclass
+class LocalSGDConfig:  # proto :39 LocalSGDConfig
+    k_steps: int = 1
+
+
+@dataclasses.dataclass
+class AMPConfig:  # contrib/mixed_precision decorator.py:218 knobs
+    dtype: str = "bfloat16"
+    init_loss_scaling: float = 1.0  # bf16 needs no scaling; >1 enables it
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = False
+
+
+@dataclasses.dataclass
+class PipelineConfig:  # proto :92 PipelineConfig
+    micro_batch: int = 1
+    schedule: str = "gpipe"  # or "1f1b"
+
+
+@dataclasses.dataclass
+class HybridConfig:
+    dp_degree: int = -1
+    mp_degree: int = 1   # tensor parallel ("mp" in fleet naming)
+    pp_degree: int = 1
+    sp_degree: int = 1   # sequence/context parallel
+    ep_degree: int = 1
+
+
+@dataclasses.dataclass
+class ShardingConfig:  # ZeRO; fleet "sharding" strategy
+    stage: int = 1
+
+
+class DistributedStrategy:
+    """Typed strategy object (ref proto distributed_strategy.proto:94)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = AMPConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.localsgd = False
+        self.localsgd_configs = LocalSGDConfig()
+        self.lars = False
+        self.lamb = False
+        self.dgc = False  # accepted, documented no-op on TPU
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.hybrid_configs = HybridConfig()
+        self.sequence_parallel = False
+        self.find_unused_parameters = False  # parity no-op
+        self.fuse_all_reduce_ops = True      # parity no-op (XLA fuses)
+        self.nccl_comm_num = 1               # parity no-op (ICI)
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"
+
+
+class _RoleMaker:
+    """Env-var role maker (ref: fleet/base/role_maker.py:220
+    PaddleCloudRoleMaker — PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM contract;
+    on TPU the process topology comes from jax.distributed)."""
+
+    def worker_index(self) -> int:
+        return _env.get_rank()
+
+    def worker_num(self) -> int:
+        return _env.get_world_size()
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False  # PS mode is host-offloaded/descoped on TPU (SURVEY §2.2)
+
+
+class Fleet:
+    """ref: fleet_base.py:41.  Singleton accessed as paddle_tpu.distributed.fleet."""
+
+    def __init__(self):
+        self._role_maker: Optional[_RoleMaker] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._mesh = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None) -> "Fleet":
+        self._role_maker = role_maker or _RoleMaker()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        if not isinstance(hc, HybridConfig):  # allow dict like fleet does
+            hc = HybridConfig(**{k: v for k, v in dict(hc).items()
+                                 if k in HybridConfig.__dataclass_fields__})
+            self._strategy.hybrid_configs = hc
+        self._mesh = _mesh.init_parallel_env(
+            dp=None if hc.dp_degree == -1 else hc.dp_degree,
+            pp=hc.pp_degree, tp=hc.mp_degree, sp=hc.sp_degree,
+            ep=hc.ep_degree)
+        return self
+
+    @property
+    def mesh(self):
+        return self._mesh or _mesh.current_mesh()
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # -- role queries (ref fleet_base worker_* API) ---------------------------
+    def worker_index(self):
+        return self._role().worker_index()
+
+    def worker_num(self):
+        return self._role().worker_num()
+
+    def is_first_worker(self):
+        return self._role().is_first_worker()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        _coll.barrier()
+
+    def _role(self):
+        if self._role_maker is None:
+            self.init()
+        return self._role_maker
+
+    # -- optimizer -----------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        strategy = strategy or self._strategy or DistributedStrategy()
+        self._strategy = strategy
+        return DistributedOptimizer(optimizer, strategy)
+
+
+class DistributedOptimizer:
+    """Strategy-composed optimizer (the meta-optimizer chain as function
+    composition).  Exposes the same functional init/update contract as
+    optimizer.Optimizer, so train-step builders treat it identically."""
+
+    def __init__(self, inner, strategy: DistributedStrategy):
+        from ..optimizer.optimizers import Lamb, LarsMomentum
+        self.strategy = strategy
+        if strategy.lamb and not isinstance(inner, Lamb):
+            inner = Lamb(learning_rate=inner.get_lr(),
+                         parameters=inner._parameters)
+        elif strategy.lars and not isinstance(inner, LarsMomentum):
+            inner = LarsMomentum(learning_rate=inner.get_lr(),
+                                 parameters=inner._parameters)
+        self.inner = inner
+
+    # passthrough niceties
+    def get_lr(self, step=None):
+        return self.inner.get_lr(step)
+
+    @property
+    def _parameters(self):
+        return self.inner._parameters
+
+    def init(self, params) -> Dict[str, Any]:
+        state = {"inner": self.inner.init(params)}
+        gm = self.strategy.gradient_merge_configs
+        if self.strategy.gradient_merge and gm.k_steps > 1:
+            state["acc"] = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+            state["acc_count"] = jnp.zeros((), jnp.int32)
+        if (self.strategy.amp and
+                self.strategy.amp_configs.use_dynamic_loss_scaling):
+            state["loss_scale"] = jnp.asarray(
+                self.strategy.amp_configs.init_loss_scaling, jnp.float32)
+            state["good_steps"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def update(self, grads, state, params, lr=None):
+        """Pure. Composes: [unscale+skip-on-nonfinite] → [k-step merge] →
+        inner update → [localsgd periodic average]."""
+        new_state = dict(state)
+        cfg = self.strategy
+
+        if "loss_scale" in state:
+            scale = state["loss_scale"]
+            grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+            finite = jnp.array(True)
+            for g in jax.tree_util.tree_leaves(grads):
+                finite &= jnp.all(jnp.isfinite(g))
+            ac = cfg.amp_configs
+            good = jnp.where(finite, state["good_steps"] + 1, 0)
+            scale = jnp.where(
+                finite & (good >= ac.incr_every_n_steps), scale * ac.incr_ratio,
+                jnp.where(finite, scale, scale * ac.decr_ratio))
+            new_state["loss_scale"] = scale
+            new_state["good_steps"] = jnp.where(
+                good >= ac.incr_every_n_steps, 0, good)
+            # zero out non-finite grads (skip-step semantics of
+            # update_loss_scaling, mixed_precision/decorator.py:169)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+
+        if cfg.gradient_merge and "acc" in state:
+            k = cfg.gradient_merge_configs.k_steps
+            acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), state["acc"], grads)
+            count = state["acc_count"] + 1
+            do_step = count >= k
+
+            def merged(g_sum):
+                if cfg.gradient_merge_configs.avg:
+                    return jax.tree_util.tree_map(lambda a: a / k, g_sum)
+                return g_sum
+
+            new_p, inner_state = self.inner.update(
+                merged(acc), state["inner"], params, lr=lr)
+            # cond on pytrees: keep old (params, inner) unless k-th step
+            new_params = jax.tree_util.tree_map(
+                lambda np_, p: jnp.where(do_step, np_, jnp.asarray(p)),
+                new_p, params)
+            new_inner = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(do_step, n, o) if hasattr(n, "shape") or hasattr(o, "shape") else n,
+                inner_state, state["inner"])
+            new_state["acc"] = jax.tree_util.tree_map(
+                lambda a: jnp.where(do_step, jnp.zeros_like(a), a), acc)
+            new_state["acc_count"] = jnp.where(do_step, 0, count)
+            new_state["inner"] = new_inner
+            return new_params, new_state
+
+        new_p, new_state["inner"] = self.inner.update(
+            grads, state["inner"], params, lr=lr)
+
+        if cfg.localsgd and _coll.in_traced_context():
+            k = cfg.localsgd_configs.k_steps
+            step = new_state["inner"]["step"] if isinstance(
+                new_state["inner"], dict) and "step" in new_state["inner"] else None
+            axis = _env.current_data_axis() or _mesh.DP_AXIS
+            if step is not None:
+                do_avg = (step % k) == 0
+                new_p = jax.tree_util.tree_map(
+                    lambda p: jnp.where(do_avg, jax.lax.pmean(p, axis), p), new_p)
+        return new_p, new_state
+
+    # Stateful facade (dygraph-style step) mirrors Optimizer.step.
+    def step(self, grads=None):
+        params = self.inner._param_list()
+        if isinstance(grads, dict):
+            grads = list(grads.values())
+        values = [p.value for p in params]
+        if getattr(self, "_state", None) is None:
+            self._state = self.init(values)
+        new_values, self._state = self.update(list(grads), self._state, values)
+        for p, v in zip(params, new_values):
+            p.value = v
+
+    def clear_grad(self):
+        pass
+
+    def state_dict(self):
+        return {"state": getattr(self, "_state", None)}
+
+
+fleet = Fleet()

@@ -30,6 +30,9 @@ class MNIST(Dataset):
         self.mode = mode
         self.transform = transform
         if image_path and os.path.exists(image_path):
+            if not label_path:
+                raise ValueError(
+                    "MNIST: label_path is required when image_path is given")
             self.images = _read_idx_images(image_path)
             self.labels = _read_idx_labels(label_path)
         else:
