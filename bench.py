@@ -1,0 +1,115 @@
+"""Flagship benchmark: ERNIE-base MLM+NSP pretraining throughput (tok/s/chip).
+
+BASELINE.json config 3 ("PaddleNLP ERNIE-1.0 / BERT-base pretrain") on the
+available chip(s).  No published reference numbers exist (BASELINE.md:
+`"published": {}`), so vs_baseline is reported against the first number this
+harness recorded; until then it is 1.0 (this run *is* the baseline).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from __graft_entry__ import make_train_step
+from paddle_tpu.autograd import parameters_dict
+from paddle_tpu.optimizer import Adam
+from paddle_tpu.text.ernie import (
+    ErnieConfig,
+    ErnieForPretraining,
+    ErniePretrainingCriterion,
+)
+
+# First TPU measurement gets recorded here by hand once known; the driver's
+# BENCH_r{N}.json history is the source of truth.
+BASELINE_TOK_PER_SEC = float(os.environ.get("BENCH_BASELINE_TOKS", "0") or 0)
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    # Full ERNIE-base on an accelerator; scaled-down config on CPU so local
+    # smoke runs finish (the driver records TPU numbers only).
+    if on_tpu:
+        cfg = ErnieConfig()  # L12 H768 A12 V18000
+        batch, seq = int(os.environ.get("BENCH_BATCH", "32")), 512
+        warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "20"))
+    else:
+        cfg = ErnieConfig(vocab_size=1024, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=256, max_position_embeddings=128)
+        batch, seq = 8, 128
+        warmup, iters = 1, 3
+
+    model = ErnieForPretraining(cfg)
+    model.train()
+    criterion = ErniePretrainingCriterion(cfg.vocab_size)
+    opt = Adam(learning_rate=1e-4)
+
+    params = parameters_dict(model)
+    opt_state = opt.init(params)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    step = jax.jit(make_train_step(model, criterion, opt, compute_dtype),
+                   donate_argnums=(0, 1))
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "input_ids": jnp.asarray(
+            rng.integers(1, cfg.vocab_size, (batch, seq)), jnp.int32),
+        "token_type_ids": jnp.zeros((batch, seq), jnp.int32),
+        "mlm_labels": jnp.asarray(
+            np.where(rng.random((batch, seq)) < 0.15,
+                     rng.integers(0, cfg.vocab_size, (batch, seq)), -1),
+            jnp.int32),
+        "nsp_labels": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
+    }
+    key = jax.random.PRNGKey(0)
+
+    # Synchronize on every step via a host read of the (scalar) loss: on the
+    # axon TPU tunnel, block_until_ready does not reliably wait and deep
+    # unsynchronized dispatch chains wedge the device, so per-step sync is
+    # both the safe and the honest measurement (it includes dispatch latency).
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch_data, key)
+        float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, batch_data, key)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.local_device_count() if on_tpu else 1
+    toks_per_sec = batch * seq * iters / dt / n_chips
+
+    # Model FLOPs utilization: 6 * n_params * tokens (fwd+bwd) + attention
+    # 12 * L * H * S^2 * 3 per token-pair term folded in.
+    n_params = sum(int(np.prod(v.shape)) for v in
+                   jax.tree_util.tree_leaves(params))
+    attn_flops_per_tok = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_tok = 6 * n_params + 3 * attn_flops_per_tok
+    peak = {"tpu": 197e12}.get(platform, 1e12)  # v5e bf16 peak per chip
+    mfu = toks_per_sec * flops_per_tok / peak
+
+    vs = toks_per_sec / BASELINE_TOK_PER_SEC if BASELINE_TOK_PER_SEC else 1.0
+    print(json.dumps({
+        "metric": "ernie_base_pretrain_throughput",
+        "value": round(toks_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+        "platform": platform,
+        "batch": batch, "seq_len": seq, "iters": iters,
+        "loss": round(float(loss), 4),
+        "mfu_est": round(mfu, 4) if on_tpu else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
