@@ -32,7 +32,8 @@ class Parameter:
     __slots__ = ("value", "trainable", "name", "is_distributed",
                  "sharding_axes", "initializer")
 
-    def __init__(self, value, trainable: bool = True, name: str = ""):
+    def __init__(self, value, trainable: bool = True, name: str = "",
+                 initializer=None):
         self.value = jnp.asarray(value)
         self.trainable = trainable
         self.name = name
@@ -43,7 +44,7 @@ class Parameter:
         # The initializer that produced this value, when known — lets cloned
         # layer stacks (TransformerEncoder deep copies) re-draw fresh values
         # from the *configured* distribution rather than a hard-coded one.
-        self.initializer = None
+        self.initializer = initializer
 
     @property
     def shape(self):

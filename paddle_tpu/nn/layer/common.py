@@ -22,14 +22,14 @@ class Linear(Layer):
         w_init = getattr(weight_attr, "initializer", None) or init.XavierUniform()
         self.weight = Parameter(w_init((in_features, out_features),
                                        _dtype_mod.get_default_dtype()),
-                                name=f"{name or 'linear'}.w")
+                                name=f"{name or 'linear'}.w", initializer=w_init)
         if bias_attr is False:
             self.bias = None
         else:
             b_init = getattr(bias_attr, "initializer", None) or init.Constant(0.0)
             self.bias = Parameter(b_init((out_features,),
                                          _dtype_mod.get_default_dtype()),
-                                  name=f"{name or 'linear'}.b")
+                                  name=f"{name or 'linear'}.b", initializer=b_init)
 
     def forward(self, x):
         return F.linear(x, self.weight.value,
@@ -52,7 +52,7 @@ class Embedding(Layer):
         w_init = getattr(weight_attr, "initializer", None) or init.Normal(0.0, 1.0)
         self.weight = Parameter(w_init((num_embeddings, embedding_dim),
                                        _dtype_mod.get_default_dtype()),
-                                name=f"{name or 'embedding'}.w")
+                                name=f"{name or 'embedding'}.w", initializer=w_init)
 
     def forward(self, x):
         return F.embedding(x, self.weight.value, padding_idx=self.padding_idx)

@@ -35,12 +35,12 @@ class _ConvNd(Layer):
         w_init = getattr(weight_attr, "initializer", None) or init.KaimingUniform(
             fan_in=fan_in, nonlinearity="leaky_relu", negative_slope=np.sqrt(5.0))
         dtype = _dtype_mod.get_default_dtype()
-        self.weight = Parameter(w_init(wshape, dtype))
+        self.weight = Parameter(w_init(wshape, dtype), initializer=w_init)
         if bias_attr is False:
             self.bias = None
         else:
             b_init = getattr(bias_attr, "initializer", None) or init.Constant(0.0)
-            self.bias = Parameter(b_init((out_channels,), dtype))
+            self.bias = Parameter(b_init((out_channels,), dtype), initializer=b_init)
 
 
 class Conv2D(_ConvNd):

@@ -27,12 +27,12 @@ class _BatchNormBase(Layer):
             self.weight = None
         else:
             w_init = getattr(weight_attr, "initializer", None) or init.Constant(1.0)
-            self.weight = Parameter(w_init((num_features,), dtype))
+            self.weight = Parameter(w_init((num_features,), dtype), initializer=w_init)
         if bias_attr is False:
             self.bias = None
         else:
             b_init = getattr(bias_attr, "initializer", None) or init.Constant(0.0)
-            self.bias = Parameter(b_init((num_features,), dtype))
+            self.bias = Parameter(b_init((num_features,), dtype), initializer=b_init)
         self.register_buffer("_mean", jnp.zeros((num_features,), dtype))
         self.register_buffer("_variance", jnp.ones((num_features,), dtype))
 

@@ -141,18 +141,48 @@ def in_traced_context() -> bool:
         return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
 
 
-def _eager_collective(fn, x, group: Group, out_specs):
-    """Run `fn` (which issues lax collectives over group.axes) eagerly by
+def _eager_axes(group: Group):
+    """(mesh, group axes present in it, lax axis arg) — axes is None when the
+    group is degenerate (absent axes / size 1) and the collective is a no-op."""
+    m = _mesh.current_mesh()
+    axes = tuple(a for a in group.axes if a in m.axis_names)
+    if not axes or _resolve_size(m, axes) == 1:
+        return m, None, None
+    return m, axes, (axes if len(axes) > 1 else axes[0])
+
+
+def _strip_axes(spec: PartitionSpec, axes) -> list:
+    """Spec dims with the given axis names removed (dims that were sharded
+    over a reduced/gathered axis become replicated); other axes keep their
+    placement."""
+    drop = set(axes)
+    out = []
+    for dim in tuple(spec):
+        if dim is None:
+            out.append(None)
+        elif isinstance(dim, tuple):
+            kept = tuple(a for a in dim if a not in drop)
+            out.append(kept if kept else None)
+        else:
+            out.append(None if dim in drop else dim)
+    return out
+
+
+def _eager_collective(fn, x, axes, scatter_dim: Optional[int] = None):
+    """Run `fn` (which issues lax collectives over `axes`) eagerly by
     shard_mapping it over the current mesh.
 
     Semantics are decided by the input's *actual placement*, never by shape
     heuristics: if `x` is already sharded over any of the group's axes, each
     rank's shard is its local tensor (the reference's per-rank view);
-    otherwise `x` is replicated and every rank holds the full value."""
+    otherwise `x` is replicated and every rank holds the full value.
+
+    The output spec is derived from the input spec: the group's axes are
+    consumed by the collective (replicated result along them) while sharding
+    over *other* mesh axes is preserved — per-rank results differ along those
+    axes and must stay sharded.  `scatter_dim` pins the group's axes onto that
+    output dim (reduce_scatter)."""
     m = _mesh.current_mesh()
-    axes = tuple(a for a in group.axes if a in m.axis_names)
-    if not axes:
-        return fn(x)  # single-device degenerate group
     in_spec = PartitionSpec()
     if isinstance(x, jax.Array) and hasattr(x, "sharding"):
         spec = getattr(x.sharding, "spec", None)
@@ -161,8 +191,15 @@ def _eager_collective(fn, x, group: Group, out_specs):
                     for a in (dim if isinstance(dim, tuple) else (dim,))}
             if used & set(axes):
                 in_spec = spec
-    f = shard_map(fn, mesh=m, in_specs=(in_spec,), out_specs=out_specs,
-                  check_rep=False)
+    out = _strip_axes(in_spec, axes)
+    if scatter_dim is not None:
+        while len(out) <= scatter_dim:
+            out.append(None)
+        out[scatter_dim] = axes if len(axes) > 1 else axes[0]
+    while out and out[-1] is None:
+        out.pop()
+    f = shard_map(fn, mesh=m, in_specs=(in_spec,),
+                  out_specs=PartitionSpec(*out), check_rep=False)
     return f(jnp.asarray(x))
 
 
@@ -183,8 +220,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True):
     g = _resolve(group)
     opname = op.lower() if isinstance(op, str) else op
 
-    def _reduce_local(x):
-        ax = g.axes if len(g.axes) > 1 else g.axes[0]
+    def _reduce_local(x, ax):
         if opname == ReduceOp.SUM:
             return lax.psum(x, ax)
         if opname == ReduceOp.MAX:
@@ -200,17 +236,15 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True):
         raise ValueError(f"unknown reduce op {op!r}")
 
     if in_traced_context():
-        return _reduce_local(tensor)
-    m = _mesh.current_mesh()
-    axes = tuple(a for a in g.axes if a in m.axis_names)
-    if not axes or _resolve_size(m, axes) == 1:
+        return _reduce_local(tensor, g.axis)
+    m, axes, ax = _eager_axes(g)
+    if axes is None:
         return jnp.asarray(tensor)
     # Eager global view: each rank's tensor is the same-shaped replica; the
     # global-array equivalent of "every rank ends with the reduction" is just
     # the reduction itself, computed with one jitted psum over shards when the
     # array is sharded, else a no-op sum of one.
-    return _eager_collective(lambda x: _reduce_local(x), tensor, g,
-                             out_specs=PartitionSpec())
+    return _eager_collective(lambda x: _reduce_local(x, ax), tensor, axes)
 
 
 def all_gather(tensor_or_list, tensor=None, group=None, axis: int = 0):
@@ -224,21 +258,19 @@ def all_gather(tensor_or_list, tensor=None, group=None, axis: int = 0):
     else:
         x = tensor_or_list
     g = _resolve(group)
-    ax = g.axes if len(g.axes) > 1 else g.axes[0]
 
     if in_traced_context():
-        out = lax.all_gather(x, ax, axis=axis, tiled=True)
+        out = lax.all_gather(x, g.axis, axis=axis, tiled=True)
     else:
-        m = _mesh.current_mesh()
-        axes = tuple(a for a in g.axes if a in m.axis_names)
-        if not axes or _resolve_size(m, axes) == 1:
+        m, axes, ax = _eager_axes(g)
+        if axes is None:
             out = jnp.asarray(x)
         else:
-            # Eager/global view: every rank ends with the full concatenation,
-            # i.e. the replicated gathered array.
+            # Eager/global view: every rank ends with the full concatenation
+            # along the group's axes (sharding over other axes is preserved).
             out = _eager_collective(
                 lambda v: lax.all_gather(v, ax, axis=axis, tiled=True),
-                x, g, out_specs=PartitionSpec())
+                x, axes)
     if out_list is not None:
         n = g.size()
         out_list.extend(jnp.split(out, n, axis=axis))
@@ -250,20 +282,17 @@ def reduce_scatter(tensor, op: str = ReduceOp.SUM, group=None, axis: int = 0):
     """ref: operators/collective/c_reducescatter_op.cc.  Traced only→eager
     wrapper: psum_scatter over the group axis."""
     g = _resolve(group)
-    ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if op.lower() != ReduceOp.SUM:
         raise NotImplementedError("reduce_scatter supports sum")
     if in_traced_context():
-        return lax.psum_scatter(tensor, ax, scatter_dimension=axis, tiled=True)
-    m = _mesh.current_mesh()
-    axes = tuple(a for a in g.axes if a in m.axis_names)
-    if not axes or _resolve_size(m, axes) == 1:
+        return lax.psum_scatter(tensor, g.axis, scatter_dimension=axis,
+                                tiled=True)
+    m, axes, ax = _eager_axes(g)
+    if axes is None:
         return jnp.asarray(tensor)
-    spec = [None] * jnp.ndim(tensor)
-    spec[axis] = axes if len(axes) > 1 else axes[0]
     return _eager_collective(
         lambda v: lax.psum_scatter(v, ax, scatter_dimension=axis, tiled=True),
-        tensor, g, out_specs=PartitionSpec(*spec))
+        tensor, axes, scatter_dim=axis)
 
 
 def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
@@ -272,7 +301,6 @@ def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
     tensor, get the all-to-all'd tensor (split along split_axis, concat along
     concat_axis) — the Ulysses sequence-parallel primitive."""
     g = _resolve(group)
-    ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if isinstance(in_tensor_list, (list, tuple)):
         x = jnp.concatenate([jnp.asarray(t)[None] for t in in_tensor_list], axis=0)
         split_axis, concat_axis = 0, 0
@@ -281,21 +309,20 @@ def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
         x = in_tensor_list
         listed = False
 
-    def _a2a(v):
+    def _a2a(v, ax):
         return lax.all_to_all(v, ax, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
     if in_traced_context():
-        out = _a2a(x)
+        out = _a2a(x, g.axis)
     else:
-        m = _mesh.current_mesh()
-        axes = tuple(a for a in g.axes if a in m.axis_names)
-        if not axes or _resolve_size(m, axes) == 1:
+        m, axes, ax = _eager_axes(g)
+        if axes is None:
             out = jnp.asarray(x)
         else:
             spec_in = [None] * jnp.ndim(x)
-            spec_in[concat_axis] = axes if len(axes) > 1 else axes[0]
-            out = shard_map(_a2a, mesh=m,
+            spec_in[concat_axis] = ax
+            out = shard_map(lambda v: _a2a(v, ax), mesh=m,
                             in_specs=(PartitionSpec(*spec_in),),
                             out_specs=PartitionSpec(*_moved(spec_in, concat_axis, split_axis)),
                             check_rep=False)(jnp.asarray(x))
@@ -319,19 +346,12 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     Traced: select rank-src's shard and psum-broadcast it.  Eager on a global
     array: returns src's shard replicated (leading dim = shards)."""
     g = _resolve(group)
-    ax = g.axes if len(g.axes) > 1 else g.axes[0]
-
-    def _bcast(x):
-        idx = lax.axis_index(ax)
-        return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), ax)
-
     if in_traced_context():
-        return _bcast(tensor)
-    m = _mesh.current_mesh()
-    axes = tuple(a for a in g.axes if a in m.axis_names)
-    if not axes or _resolve_size(m, axes) == 1:
+        return _bcast_from(tensor, src, g.axis)
+    m, axes, ax = _eager_axes(g)
+    if axes is None:
         return jnp.asarray(tensor)
-    return _eager_collective(_bcast, tensor, g, out_specs=PartitionSpec())
+    return _eager_collective(lambda x: _bcast_from(x, src, ax), tensor, axes)
 
 
 def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None):
@@ -345,28 +365,25 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None):
     """ref: distributed/collective.py:347.  Traced: dynamic-slice this rank's
     chunk of src's tensor."""
     g = _resolve(group)
-    ax = g.axes if len(g.axes) > 1 else g.axes[0]
     if tensor_list is not None:
         stacked = jnp.stack([jnp.asarray(t) for t in tensor_list], axis=0)
     else:
         stacked = tensor
 
-    def _scatter(x):
+    def _scatter(x, ax):
         x = _bcast_from(x, src, ax)
         idx = lax.axis_index(ax)
         return lax.dynamic_index_in_dim(x, idx, axis=0, keepdims=False)
 
     if in_traced_context():
-        return _scatter(stacked)
-    m = _mesh.current_mesh()
-    axes = tuple(a for a in g.axes if a in m.axis_names)
-    if not axes or _resolve_size(m, axes) == 1:
+        return _scatter(stacked, g.axis)
+    m, axes, ax = _eager_axes(g)
+    if axes is None:
         return jnp.asarray(stacked)[0] if tensor_list is not None else jnp.asarray(stacked)
     # Eager global view: the scatter result is the stacked tensor with its
     # leading (rank) dim sharded over the group — each rank owns its chunk.
     return jax.device_put(
-        jnp.asarray(stacked),
-        NamedSharding(m, PartitionSpec(axes if len(axes) > 1 else axes[0])))
+        jnp.asarray(stacked), NamedSharding(m, PartitionSpec(ax)))
 
 
 def _bcast_from(x, src, ax):
@@ -378,12 +395,10 @@ def barrier(group=None):
     """ref: distributed/collective.py:419 (barrier op = allreduce of a scalar).
     On TPU a barrier is a psum of 1 + block_until_ready."""
     g = _resolve(group)
-    m = _mesh.current_mesh()
-    axes = tuple(a for a in g.axes if a in m.axis_names)
-    if not axes or _resolve_size(m, axes) == 1:
+    m, axes, ax = _eager_axes(g)
+    if axes is None:
         return
-    out = _eager_collective(lambda x: lax.psum(x, g.axes if len(g.axes) > 1 else g.axes[0]),
-                            jnp.ones(()), g, out_specs=PartitionSpec())
+    out = _eager_collective(lambda x: lax.psum(x, ax), jnp.ones(()), axes)
     jax.block_until_ready(out)
 
 

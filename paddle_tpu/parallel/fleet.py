@@ -207,11 +207,13 @@ class DistributedOptimizer:
     def __init__(self, inner, strategy: DistributedStrategy):
         from ..optimizer.optimizers import Lamb, LarsMomentum
         self.strategy = strategy
+        # Pass the raw _lr through so an LRScheduler keeps scheduling (get_lr()
+        # would freeze it at its current scalar value).
         if strategy.lamb and not isinstance(inner, Lamb):
-            inner = Lamb(learning_rate=inner.get_lr(),
+            inner = Lamb(learning_rate=inner._lr,
                          parameters=inner._parameters)
         elif strategy.lars and not isinstance(inner, LarsMomentum):
-            inner = LarsMomentum(learning_rate=inner.get_lr(),
+            inner = LarsMomentum(learning_rate=inner._lr,
                                  parameters=inner._parameters)
         self.inner = inner
 
@@ -243,6 +245,7 @@ class DistributedOptimizer:
         new_state = dict(state)
         cfg = self.strategy
 
+        finite = None
         if "loss_scale" in state:
             scale = state["loss_scale"]
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
@@ -257,10 +260,6 @@ class DistributedOptimizer:
             new_state["loss_scale"] = scale
             new_state["good_steps"] = jnp.where(
                 good >= ac.incr_every_n_steps, 0, good)
-            # zero out non-finite grads (skip-step semantics of
-            # update_loss_scaling, mixed_precision/decorator.py:169)
-            grads = jax.tree_util.tree_map(
-                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
 
         if cfg.gradient_merge and "acc" in state:
             k = cfg.gradient_merge_configs.k_steps
@@ -287,10 +286,27 @@ class DistributedOptimizer:
                 lambda a: jnp.where(do_step, jnp.zeros_like(a), a), acc)
             new_state["acc_count"] = jnp.where(do_step, 0, count)
             new_state["inner"] = new_inner
-            return new_params, new_state
+            new_p = new_params
+        else:
+            new_p, new_state["inner"] = self.inner.update(
+                grads, state["inner"], params, lr=lr)
 
-        new_p, new_state["inner"] = self.inner.update(
-            grads, state["inner"], params, lr=lr)
+        if finite is not None:
+            # Skip-step semantics of update_loss_scaling (mixed_precision/
+            # decorator.py:169): a non-finite step leaves parameters AND
+            # optimizer state untouched (zeroing grads would still move
+            # params via weight decay / momentum), keeping only the
+            # loss-scale bookkeeping above.
+            def _keep_old(new, old):
+                if hasattr(new, "shape") or hasattr(old, "shape"):
+                    return jnp.where(finite, new, jnp.asarray(old))
+                return new
+
+            new_p = jax.tree_util.tree_map(_keep_old, new_p, params)
+            for key in new_state:
+                if key not in ("loss_scale", "good_steps"):
+                    new_state[key] = jax.tree_util.tree_map(
+                        _keep_old, new_state[key], state[key])
 
         if cfg.localsgd and _coll.in_traced_context():
             k = cfg.localsgd_configs.k_steps
@@ -306,6 +322,10 @@ class DistributedOptimizer:
     # Stateful facade (dygraph-style step) mirrors Optimizer.step.
     def step(self, grads=None):
         params = self.inner._param_list()
+        if grads is None:
+            raise ValueError(
+                "step() needs explicit grads: this framework has no global "
+                "tape; compute grads via paddle_tpu.autograd.value_and_grad")
         if isinstance(grads, dict):
             grads = list(grads.values())
         values = [p.value for p in params]

@@ -242,3 +242,87 @@ def test_fleet_lamb_swap():
     dist.fleet.init(strategy=strategy)
     dopt = dist.fleet.distributed_optimizer(opt.Adam(learning_rate=0.1), strategy)
     assert isinstance(dopt.inner, Lamb)
+
+
+def test_all_reduce_subaxis_group_preserves_other_sharding():
+    # Regression: reducing over one axis of a multi-axis-sharded input must
+    # keep the result sharded over the untouched axes (per-dp results differ).
+    from jax.sharding import NamedSharding
+    m = dist.init_parallel_env(dp=2, tp=4)
+    x = jax.device_put(jnp.arange(8.0), NamedSharding(m, PartitionSpec(("dp", "tp"))))
+    out = dist.all_reduce(x, group="tp")
+    np.testing.assert_allclose(np.asarray(out), [6.0, 22.0])
+    out_spec = out.sharding.spec
+    assert "dp" in str(out_spec) and "tp" not in str(out_spec)
+
+
+def test_collectives_ignore_absent_group_axes():
+    # Regression: a group naming an axis the mesh omitted (degree-1) must
+    # reduce over the axes that exist, not crash on an unbound axis name.
+    m = dist.init_parallel_env(dp=8)  # no 'tp' axis in the mesh
+    out = dist.all_reduce(jnp.ones(4), group=("dp", "tp"))
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 8.0))
+    out = dist.all_gather(jnp.ones((1, 2)), group=("dp", "tp"))
+    assert out.shape == (8, 2)
+
+
+def test_fleet_skip_step_preserves_momentum_state():
+    # Regression: a non-finite (skipped) step must leave Adam moments and
+    # params untouched — zeroed grads would still move params via momentum.
+    import paddle_tpu.optimizer as opt
+    strategy = dist.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs.use_dynamic_loss_scaling = True
+    strategy.amp_configs.init_loss_scaling = 1.0
+    dist.fleet.init(strategy=strategy)
+    dopt = dist.fleet.distributed_optimizer(opt.Adam(learning_rate=0.1), strategy)
+    params = {"w": jnp.ones((2,))}
+    state = dopt.init(params)
+    p1, state = dopt.update({"w": jnp.ones((2,))}, state, params)  # real step
+    m_before = np.asarray(state["inner"]["per_param"][0][0])
+    step_before = int(state["inner"]["step"])
+    p2, state = dopt.update({"w": jnp.array([jnp.inf, 1.0])}, state, p1)
+    np.testing.assert_allclose(np.asarray(p2["w"]), np.asarray(p1["w"]))
+    np.testing.assert_allclose(
+        np.asarray(state["inner"]["per_param"][0][0]), m_before)
+    assert int(state["inner"]["step"]) == step_before
+    assert float(state["loss_scale"]) == 0.5
+
+
+def test_fleet_lamb_swap_keeps_scheduler():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.optimizer.lr import LRScheduler
+    strategy = dist.DistributedStrategy()
+    strategy.lamb = True
+    dist.fleet.init(strategy=strategy)
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=10)
+    dopt = dist.fleet.distributed_optimizer(
+        opt.Adam(learning_rate=sched), strategy)
+    assert isinstance(dopt.inner._lr, LRScheduler)
+
+
+def test_distributed_optimizer_step_without_grads_raises():
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn as nn
+    dist.fleet.init(strategy=dist.DistributedStrategy())
+    lin = nn.Linear(2, 2)
+    dopt = dist.fleet.distributed_optimizer(
+        opt.SGD(learning_rate=0.1, parameters=lin.parameters()))
+    with pytest.raises(ValueError, match="explicit grads"):
+        dopt.step()
+
+
+def test_cloned_encoder_layers_keep_configured_initializer():
+    import paddle_tpu.nn as nn
+    layer = nn.TransformerEncoderLayer(16, 2, 32)
+    enc = nn.TransformerEncoder(layer, 3)
+    # every clone records an initializer on its projection weights, and
+    # clone values are re-drawn (not copies of layer 0)
+    w0 = None
+    for i, sub in enumerate(enc.layers):
+        p = sub.self_attn.q_proj.weight
+        assert p.initializer is not None
+        if i == 0:
+            w0 = np.asarray(p.value)
+        else:
+            assert not np.allclose(np.asarray(p.value), w0)
