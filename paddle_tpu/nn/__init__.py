@@ -61,6 +61,7 @@ from .layer.norm import (
     RMSNorm,
     SyncBatchNorm,
 )
+from .layer.moe import MoEFFN
 from .layer.transformer import (
     MultiHeadAttention,
     Transformer,

@@ -1,0 +1,148 @@
+"""Mixture-of-experts FFN with expert parallelism over the `ep` mesh axis.
+
+The reference has no MoE (SURVEY.md §2.2: expert parallelism ABSENT — design
+fresh).  TPU-native design is the GShard/Switch formulation: gating +
+capacity-bounded dispatch expressed as dense einsums over one-hot dispatch/
+combine tensors — static shapes, MXU-friendly, and when the expert dim of
+`wi`/`wo` is sharded over `ep` (set via Parameter.sharding_axes, consumed by
+parallel.sharding.infer_sharding) GSPMD lowers the dispatch einsums to
+all-to-all over ICI automatically; no hand-written token routing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as _dtype_mod
+from .. import functional as F
+from .. import initializer as init
+from ..layer.base import Layer, Parameter
+
+__all__ = ["MoEFFN", "switch_gating", "top2_gating"]
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def switch_gating(gates, capacity: int):
+    """Switch-Transformer top-1 gating.
+
+    gates: [B, S, E] softmax outputs.  Returns (dispatch [B,S,E,C] one-hot,
+    combine [B,S,E,C] weights, aux load-balancing loss)."""
+    b, s, e = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)                       # [B,S]
+    mask1 = _one_hot(idx1, e)                               # [B,S,E]
+    # position of each token in its expert's buffer (order = sequence order)
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - mask1        # [B,S,E]
+    keep1 = mask1 * (pos1 < capacity)
+    gate1 = jnp.sum(gates * keep1, axis=-1)                 # [B,S]
+    # aux loss (Switch eq. 4): E * mean_e(frac_tokens_e * mean_gate_e)
+    density = jnp.mean(mask1, axis=(0, 1))
+    density_proxy = jnp.mean(gates, axis=(0, 1))
+    aux = e * jnp.sum(density * density_proxy)
+    pos_in_expert = _one_hot(jnp.sum(pos1, -1).astype(jnp.int32),
+                             capacity)                      # [B,S,C]
+    dispatch = keep1[..., None] * pos_in_expert[:, :, None, :]  # [B,S,E,C]
+    combine = dispatch * gate1[..., None, None]
+    return dispatch, combine, aux
+
+
+def top2_gating(gates, capacity: int):
+    """GShard top-2 gating with capacity overflow drop.
+
+    gates: [B, S, E].  Returns (dispatch, combine, aux) like switch_gating;
+    second-choice tokens queue behind first-choice traffic."""
+    b, s, e = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, e)
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = _one_hot(idx2, e)
+
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - mask1
+    # second-choice tokens start after all first-choice tokens of that expert
+    used1 = jnp.sum(mask1, axis=1, keepdims=True)           # [B,1,E]
+    pos2 = (jnp.cumsum(mask2, axis=1) * mask2 - mask2) + used1 * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    density = jnp.mean(mask1, axis=(0, 1))
+    density_proxy = jnp.mean(gates, axis=(0, 1))
+    aux = e * jnp.sum(density * density_proxy)
+
+    p1 = _one_hot(jnp.sum(pos1, -1).astype(jnp.int32), capacity)
+    p2 = _one_hot(jnp.sum(pos2, -1).astype(jnp.int32), capacity)
+    d1 = keep1[..., None] * p1[:, :, None, :]
+    d2 = keep2[..., None] * p2[:, :, None, :]
+    dispatch = jnp.maximum(d1, d2)
+    combine = d1 * g1[..., None, None] + d2 * g2[..., None, None]
+    return dispatch, combine, aux
+
+
+class MoEFFN(Layer):
+    """Expert-parallel FFN block: y = combine · expert_ffn(dispatch · x).
+
+    Weight layout: wi [E, D, F], wo [E, F, D] with the expert dim annotated
+    for `ep` sharding (and the ff dim for `tp`, Megatron-style, so MoE and
+    tensor parallelism compose)."""
+
+    def __init__(self, d_model: int, d_ff: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", name=None):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
+        self.d_model, self.d_ff = d_model, d_ff
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = getattr(F, activation)
+        dtype = _dtype_mod.get_default_dtype()
+        xavier = init.XavierUniform()
+        self.gate_weight = Parameter(
+            xavier((d_model, num_experts), dtype), initializer=xavier)
+        self.wi = Parameter(xavier((num_experts, d_model, d_ff), dtype),
+                            initializer=xavier)
+        self.wo = Parameter(xavier((num_experts, d_ff, d_model), dtype),
+                            initializer=xavier)
+        from ...parallel.mesh import EP_AXIS, TP_AXIS
+        self.wi.sharding_axes = (EP_AXIS, None, TP_AXIS)
+        self.wo.sharding_axes = (EP_AXIS, TP_AXIS, None)
+        self.aux_loss = jnp.zeros(())  # last computed load-balance loss
+
+    def capacity(self, seq_len: int) -> int:
+        c = int(self.top_k * seq_len * self.capacity_factor /
+                self.num_experts)
+        return max(c, 1)
+
+    def forward(self, x):
+        """x: [B, S, D] -> [B, S, D].  In eager use the load-balancing aux
+        loss is available as `self.aux_loss` afterwards; inside scans/jit use
+        `forward_with_aux` to thread it functionally (a stored tracer must
+        never escape its trace)."""
+        y, _ = self.forward_with_aux(x)
+        return y
+
+    def forward_with_aux(self, x):
+        b, s, dm = x.shape
+        cap = self.capacity(s)
+        logits = jnp.einsum("bsd,de->bse", x, self.gate_weight.value)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gating = switch_gating if self.top_k == 1 else top2_gating
+        dispatch, combine, aux = gating(gates, cap)
+        if not isinstance(aux, jax.core.Tracer):
+            self.aux_loss = aux
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+        # route: [B,S,E,C] x [B,S,D] -> [E, B, C, D]
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        h = self.activation(jnp.einsum("ebcd,edf->ebcf", expert_in,
+                                       self.wi.value))
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, self.wo.value)
+        return jnp.einsum("bsec,ebcd->bsd", combine, expert_out), aux

@@ -1,0 +1,123 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7: its era handled
+long sequences with LoD ragged batching + recompute).  This module is the
+designed-fresh TPU capability: shard the sequence axis over the `sp` mesh
+axis and attend across shards either by
+
+* **ring attention** — K/V blocks rotate around the sp ring with
+  `lax.ppermute` while each rank keeps its Q shard, merging per-block
+  results with the streaming-softmax (log-sum-exp) recurrence, so peak
+  memory is O(S/sp) and communication overlaps compute on ICI; or
+* **Ulysses** — `all_to_all` swaps the sequence shard for a head shard,
+  runs ordinary full attention on full sequences for 1/sp of the heads,
+  and swaps back (cheaper at moderate S, needs heads % sp == 0).
+
+Both are per-rank SPMD functions: call inside `shard_map` with the sequence
+dim of q/k/v sharded over `axis`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import mesh as _mesh
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def ring_attention(q, k, v, *, axis: str = _mesh.SP_AXIS, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise attention over a sequence sharded on `axis`.
+
+    q, k, v: [batch, heads, s_local, head_dim] — this rank's sequence shard.
+    Returns [batch, heads, s_local, head_dim].
+
+    The softmax statistics (running max m and normalizer l) are carried in
+    float32 across ring steps, so the result is within bf16 tolerance of
+    full attention regardless of sp degree.
+    """
+    n = lax.psum(1, axis)
+    me = lax.axis_index(axis)
+    s_local = q.shape[-2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+
+    # send K/V to the *next* rank each step => at step i this rank holds the
+    # block originally owned by rank (me - i) mod n.
+    ring = [(j, (j + 1) % n) for j in range(n)]
+    q_pos = me * s_local + jnp.arange(s_local)
+
+    o0 = jnp.zeros(q.shape[:-1] + (d,), jnp.float32)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+
+    def step(carry, i):
+        o, m, l, kk, vv = carry
+        owner = (me - i) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kk.astype(jnp.float32))
+        if causal:
+            k_pos = owner * s_local + jnp.arange(s_local)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (all -inf): exp(-inf - -inf) -> use a
+        # finite stand-in so p is exactly 0 and the rescale factor is 1
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        if causal:
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
+        kk = lax.ppermute(kk, axis, ring)
+        vv = lax.ppermute(vv, axis, ring)
+        return (o, m_new, l, kk, vv), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis: str = _mesh.SP_AXIS,
+                      causal: bool = False, scale: Optional[float] = None,
+                      attn_fn=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
+
+    q, k, v: [batch, heads, s_local, head_dim] with heads % sp == 0.
+    Swaps seq-shard -> head-shard, runs `attn_fn` (default: exact softmax
+    attention) on the full sequence with heads/sp heads, swaps back.
+    """
+    n = lax.psum(1, axis)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by sp degree {n}")
+
+    def fwd(x):  # [b, h, s/n, d] -> [b, h/n, s, d]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    def bwd(x):  # [b, h/n, s, d] -> [b, h, s/n, d]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = fwd(q), fwd(k), fwd(v)
+    if attn_fn is None:
+        d = qh.shape[-1]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * sc
+        if causal:
+            sq = s.shape[-2]
+            mask = jnp.tril(jnp.ones((sq, sq), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+        out = out.astype(q.dtype)
+    else:
+        out = attn_fn(qh, kh, vh)
+    return bwd(out)

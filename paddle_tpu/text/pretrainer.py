@@ -1,0 +1,272 @@
+"""Hybrid-parallel ERNIE/BERT pretraining trainer.
+
+This is the rebuild's answer to the reference's fleet hybrid stack — the
+composition of the PipelineOptimizer program splitter (fluid/optimizer.py:3661),
+the collective data-parallel rewrites (transpiler/collective.py:178) and the
+(absent-in-reference, designed-fresh) tensor/sequence/expert parallelism —
+as ONE pjit'd train step over a dp×pp×ep×sp×tp mesh:
+
+  dp — batch dim sharding (GSPMD inserts the gradient psum)
+  tp — Megatron param sharding via ShardingRules (GSPMD collectives)
+  sp — activation sequence-dim sharding (GSPMD) — ring attention available
+       separately in parallel.ring_attention for the manual path
+  pp — encoder blocks run through the circular ppermute pipeline inside a
+       partial-manual shard_map (axis_names={'pp'}): pp is manual, all other
+       axes stay GSPMD-automatic inside the body
+  ep — MoE expert dim sharding (nn.MoEFFN every `moe_every` blocks)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import nn
+from ..autograd import functional_call, parameters_dict
+from ..core import random as _random
+from ..parallel import mesh as _mesh
+from ..parallel.collective import shard_map as _shard_map, _VMA_KW, _jax_shard_map
+from ..parallel.pipeline import (
+    blockwise_stage_fn,
+    microbatch,
+    pipeline_apply,
+    stack_block_params,
+    unmicrobatch,
+)
+from ..parallel.sharding import TRANSFORMER_RULES, infer_sharding
+from .ernie import ErnieConfig, ErnieEmbeddings, ErniePretrainingCriterion
+
+
+class _MoEBlock(nn.Layer):
+    """Encoder block whose FFN is expert-parallel (attention + MoEFFN)."""
+
+    def __init__(self, cfg: ErnieConfig, num_experts: int):
+        super().__init__()
+        self.self_attn = nn.MultiHeadAttention(
+            cfg.hidden_size, cfg.num_attention_heads,
+            dropout=cfg.attention_probs_dropout_prob)
+        self.norm1 = nn.LayerNorm(cfg.hidden_size)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size)
+        self.moe = nn.MoEFFN(cfg.hidden_size, cfg.intermediate_size,
+                             num_experts=num_experts, top_k=2,
+                             capacity_factor=2.0)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = self.norm1(x + self.dropout(self.self_attn(x)))
+        x = self.norm2(x + self.dropout(self.moe(x)))
+        return x
+
+
+class HybridPretrainer:
+    """Assembles params + shardings + a pure train step for ERNIE pretraining
+    on the current hybrid mesh.
+
+    Pipeline note: the encoder stack must be uniform, so embeddings/pooler/
+    heads live outside the pipeline (replicated over pp) and the blocks'
+    parameters are stacked [L, ...] with the leading dim sharded over pp.
+    """
+
+    def __init__(self, config: Optional[ErnieConfig] = None, *,
+                 mesh=None, num_micro: int = 1, moe_experts: int = 0,
+                 rules=TRANSFORMER_RULES):
+        self.cfg = config or ErnieConfig()
+        self.mesh = mesh or _mesh.current_mesh()
+        self.num_micro = num_micro
+        self.rules = rules
+        self.moe_experts = moe_experts
+        cfg = self.cfg
+
+        self.embeddings = ErnieEmbeddings(cfg)
+        if moe_experts:
+            block = _MoEBlock(cfg, moe_experts)
+        else:
+            block = nn.TransformerEncoderLayer(
+                cfg.hidden_size, cfg.num_attention_heads,
+                cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+                activation=cfg.hidden_act,
+                attn_dropout=cfg.attention_probs_dropout_prob, act_dropout=0.0)
+        # fresh per-block init via the cloning LayerList (clones re-draw from
+        # each parameter's recorded initializer)
+        self._stack = nn.TransformerEncoder(block, cfg.num_hidden_layers) \
+            if not moe_experts else _CloneList(block, cfg.num_hidden_layers)
+        self.block_template = self._stack.layers[0]
+        self.head = _PretrainHead(cfg, self.embeddings.word_embeddings.weight)
+        self.criterion = ErniePretrainingCriterion(cfg.vocab_size)
+
+    # -- parameters ---------------------------------------------------------
+    _TIED = "cls.predictions.decoder_weight"
+    _EMB = "word_embeddings.weight"
+
+    def init_params(self) -> Dict[str, Any]:
+        blocks = [parameters_dict(l) for l in self._stack.layers]
+        # the MLM decoder weight is TIED to the embedding table: keep one
+        # pytree leaf (under "embed") and bind it into the head at call time,
+        # so its gradient accumulates from both uses and donation never sees
+        # the same buffer twice.
+        head = {k: v for k, v in parameters_dict(self.head).items()
+                if k != self._TIED}
+        return {
+            "embed": parameters_dict(self.embeddings),
+            "blocks": stack_block_params(blocks),
+            "head": head,
+        }
+
+    def param_shardings(self, params) -> Dict[str, Any]:
+        m = self.mesh
+        out = {
+            "embed": infer_sharding(params["embed"], m, self.rules),
+            "head": infer_sharding(params["head"], m, self.rules),
+        }
+        blk = {}
+        for name, v in params["blocks"].items():
+            ann = None
+            p = _find_param(self.block_template, name)
+            if p is not None and getattr(p, "sharding_axes", None) is not None:
+                ann = tuple(p.sharding_axes)
+            if ann is None:
+                match = self.rules.match(name, v.ndim - 1)
+                ann = match if match is not None else (None,) * (v.ndim - 1)
+            spec = (_mesh.PP_AXIS,) + tuple(ann)
+            blk[name] = NamedSharding(m, _clean(spec, m, v.shape))
+        out["blocks"] = blk
+        return out
+
+    def place_params(self, params):
+        sh = self.param_shardings(params)
+        return jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, s), params, sh,
+            is_leaf=lambda x: not isinstance(x, dict))
+
+    # -- forward ------------------------------------------------------------
+    def _encode(self, blocks, h):
+        """Run the encoder stack: pipelined over pp when the axis exists."""
+        pp = _mesh.mesh_axis_size(_mesh.PP_AXIS, self.mesh)
+        template = self.block_template
+
+        def block_fn(blk, x):
+            return functional_call(template, blk, (x,))
+
+        if pp == 1:
+            stage = blockwise_stage_fn(block_fn)
+            return stage(blocks, h)
+
+        xs = microbatch(h, self.num_micro)
+
+        def run(blk, xs_):
+            return pipeline_apply(blockwise_stage_fn(block_fn), blk, xs_,
+                                  axis=_mesh.PP_AXIS)
+
+        blk_specs = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(_mesh.PP_AXIS), blocks)
+        f = _jax_shard_map(
+            run, mesh=self.mesh, in_specs=(blk_specs, PartitionSpec()),
+            out_specs=PartitionSpec(),
+            axis_names={_mesh.PP_AXIS}, **{_VMA_KW: False})
+        return unmicrobatch(f(blocks, xs))
+
+    def loss_fn(self, params, batch, key):
+        cfg = self.cfg
+        with _random.rng_scope(key):
+            h = functional_call(self.embeddings, params["embed"],
+                                (batch["input_ids"], batch["token_type_ids"]))
+            h = self._data_constraint(h)
+            h = self._encode(params["blocks"], h)
+            head_params = dict(params["head"])
+            head_params[self._TIED] = params["embed"][self._EMB]
+            logits, nsp = functional_call(self.head, head_params, (h,))
+        loss = self.criterion(logits.astype(jnp.float32),
+                              nsp.astype(jnp.float32),
+                              batch["mlm_labels"], batch["nsp_labels"])
+        # MoE load-balancing aux loss is not added here: the blocks run under
+        # lax.scan (and the pp shard_map), so the per-block aux values are
+        # trace-local.  Custom loops wanting it should call
+        # MoEFFN.forward_with_aux and thread the aux through the scan carry.
+        return loss
+
+    def _data_constraint(self, h):
+        m = self.mesh
+        spec = [None, None, None]
+        if _mesh.DP_AXIS in m.axis_names:
+            spec[0] = _mesh.DP_AXIS
+        if _mesh.SP_AXIS in m.axis_names:
+            spec[1] = _mesh.SP_AXIS
+        return lax.with_sharding_constraint(h, NamedSharding(m, PartitionSpec(*spec)))
+
+    # -- train step ---------------------------------------------------------
+    def make_train_step(self, optimizer, compute_dtype=jnp.float32):
+        def train_step(params, opt_state, batch, key):
+            def _loss(p):
+                if compute_dtype != jnp.float32:
+                    p = jax.tree_util.tree_map(
+                        lambda x: x.astype(compute_dtype)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+                return self.loss_fn(p, batch, key)
+
+            loss, grads = jax.value_and_grad(_loss)(params)
+            new_params, new_state = optimizer.update(grads, opt_state, params)
+            return new_params, new_state, loss
+
+        return train_step
+
+    def data_shardings(self, mesh=None):
+        m = mesh or self.mesh
+        tok = _mesh.data_sharding(m, seq_axis=_mesh.SP_AXIS)
+        lab = NamedSharding(m, PartitionSpec(
+            _mesh.DP_AXIS if _mesh.DP_AXIS in m.axis_names else None))
+        return {"input_ids": tok, "token_type_ids": tok,
+                "mlm_labels": tok, "nsp_labels": lab}
+
+
+class _PretrainHead(nn.Layer):
+    """Pooler + MLM/NSP heads (pipeline keeps them off the block stack)."""
+
+    def __init__(self, cfg: ErnieConfig, embedding_weight):
+        super().__init__()
+        from .ernie import ErniePooler, ErniePretrainingHeads
+        self.pooler = ErniePooler(cfg.hidden_size)
+        self.cls = ErniePretrainingHeads(cfg, embedding_weight)
+
+    def forward(self, hidden):
+        pooled = self.pooler(hidden)
+        return self.cls(hidden, pooled)
+
+
+class _CloneList(nn.Layer):
+    """num_layers fresh clones of a block (TransformerEncoder's cloning,
+    reused for arbitrary block types)."""
+
+    def __init__(self, block, num_layers):
+        super().__init__()
+        import copy
+        from ..nn.layer.transformer import _reinit
+        clones = []
+        for _ in range(num_layers):
+            c = copy.deepcopy(block)
+            _reinit(c)
+            clones.append(c)
+        self.layers = nn.LayerList(clones)
+
+
+def _find_param(layer, name: str):
+    for n, p in layer.named_parameters():
+        if n == name:
+            return p
+    return None
+
+
+def _clean(spec, mesh, shape):
+    out = []
+    for i, a in enumerate(spec):
+        if a is None or a not in mesh.axis_names:
+            out.append(None)
+        elif shape[i] % mesh.shape[a] != 0:
+            out.append(None)
+        else:
+            out.append(a)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)

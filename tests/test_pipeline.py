@@ -1,0 +1,135 @@
+"""Pipeline parallelism: circular ppermute schedule == sequential execution,
+and gradients flow through the pipeline (SURVEY.md §2.2 "Pipeline
+parallelism" — ref PipelineOptimizer fluid/optimizer.py:3661)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.collective import shard_map
+from paddle_tpu.parallel.pipeline import (
+    PipelineStage,
+    blockwise_stage_fn,
+    microbatch,
+    pipeline_apply,
+    stack_block_params,
+    unmicrobatch,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _block_fn(blk, x):
+    return jnp.tanh(x @ blk["w"] + blk["b"])
+
+
+def _make_blocks(n_blocks, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"w": jnp.asarray(rng.normal(0, 0.5, (d, d)), jnp.float32),
+             "b": jnp.asarray(rng.normal(0, 0.1, (d,)), jnp.float32)}
+            for _ in range(n_blocks)]
+
+
+def _sequential(blocks, x):
+    for blk in blocks:
+        x = _block_fn(blk, x)
+    return x
+
+
+def test_stack_block_params():
+    blocks = _make_blocks(4, 8)
+    stacked = stack_block_params(blocks)
+    assert stacked["w"].shape == (4, 8, 8)
+    with pytest.raises(ValueError, match="identical parameter"):
+        stack_block_params([{"w": jnp.zeros(2)}, {"x": jnp.zeros(2)}])
+
+
+def test_pipeline_matches_sequential():
+    m = dist.init_parallel_env(dp=2, pp=4)
+    blocks = _make_blocks(8, 16)  # 2 blocks per stage
+    stacked = stack_block_params(blocks)
+    x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (8, 16)), jnp.float32)
+    ref = _sequential(blocks, x)
+
+    stage = blockwise_stage_fn(_block_fn)
+
+    def run(p, xs):
+        return pipeline_apply(stage, p, xs, axis="pp")
+
+    f = shard_map(run, mesh=m,
+                  in_specs=({"w": PartitionSpec("pp"), "b": PartitionSpec("pp")},
+                            PartitionSpec()),
+                  out_specs=PartitionSpec(), check_rep=False)
+    out = unmicrobatch(f(stacked, microbatch(x, 4)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    m = dist.init_parallel_env(pp=4)
+    blocks = _make_blocks(4, 8)
+    stacked = stack_block_params(blocks)
+    x = jnp.asarray(np.random.default_rng(2).normal(0, 1, (4, 8)), jnp.float32)
+
+    def seq_loss(p):
+        h = x
+        for i in range(4):
+            h = _block_fn({"w": p["w"][i], "b": p["b"][i]}, h)
+        return jnp.sum(h ** 2)
+
+    stage = blockwise_stage_fn(_block_fn)
+
+    def pipe_loss(p):
+        def run(pp_params, xs):
+            return pipeline_apply(stage, pp_params, xs, axis="pp")
+
+        f = shard_map(run, mesh=m,
+                      in_specs=({"w": PartitionSpec("pp"), "b": PartitionSpec("pp")},
+                                PartitionSpec()),
+                      out_specs=PartitionSpec(), check_rep=False)
+        out = unmicrobatch(f(p, microbatch(x, 2)))
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(seq_loss)(stacked)
+    g_pipe = jax.grad(pipe_loss)(stacked)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_pipe[k]), np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_stage_wrapper():
+    m = dist.init_parallel_env(pp=4)
+    blocks = _make_blocks(4, 8)
+    pipe = PipelineStage(_block_fn, stack_block_params(blocks), num_micro=2)
+    pipe.shard_params()
+    x = jnp.asarray(np.random.default_rng(3).normal(0, 1, (4, 8)), jnp.float32)
+    out = pipe(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_sequential(blocks, x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_stage_degenerate_single_stage():
+    dist.init_parallel_env(dp=8)  # no pp axis -> plain scan
+    blocks = _make_blocks(3, 8)
+    pipe = PipelineStage(_block_fn, stack_block_params(blocks), num_micro=2)
+    x = jnp.ones((4, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(pipe(x)),
+                               np.asarray(_sequential(blocks, x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_microbatch_roundtrip_and_errors():
+    x = jnp.arange(24.0).reshape(6, 4)
+    mb = microbatch(x, 3)
+    assert mb.shape == (3, 2, 4)
+    np.testing.assert_allclose(np.asarray(unmicrobatch(mb)), np.asarray(x))
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch(x, 4)

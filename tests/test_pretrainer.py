@@ -1,0 +1,97 @@
+"""HybridPretrainer: the flagship hybrid-parallel train step (dp/pp/tp/sp/ep)
+compiles, runs, and the pipelined encoder matches the sequential one."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.optimizer import Adam
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.text.ernie import ErnieConfig
+from paddle_tpu.text.pretrainer import HybridPretrainer
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+CFG = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+           num_attention_heads=2, intermediate_size=64,
+           max_position_embeddings=32, hidden_dropout_prob=0.0,
+           attention_probs_dropout_prob=0.0)
+
+
+def _batch(rng, bs, seq, vocab):
+    return {
+        "input_ids": rng.integers(1, vocab, (bs, seq)).astype(np.int32),
+        "token_type_ids": np.zeros((bs, seq), np.int32),
+        "mlm_labels": rng.integers(0, vocab, (bs, seq)).astype(np.int32),
+        "nsp_labels": rng.integers(0, 2, (bs,)).astype(np.int32),
+    }
+
+
+def _run_step(mesh_axes, moe=0, num_micro=2, seed=0):
+    m = dist.init_parallel_env(**mesh_axes)
+    trainer = HybridPretrainer(ErnieConfig(**CFG), mesh=m,
+                               num_micro=num_micro, moe_experts=moe)
+    opt = Adam(learning_rate=1e-3)
+    params = trainer.place_params(trainer.init_params())
+    state = opt.init(params)
+    rng = np.random.default_rng(seed)
+    batch = _batch(rng, 4 * num_micro, 16, trainer.cfg.vocab_size)
+    sh = trainer.data_shardings(m)
+    batch = {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+    step = jax.jit(trainer.make_train_step(opt))
+    with m:
+        new_params, _, loss = step(params, state, batch, jax.random.PRNGKey(0))
+    return trainer, params, new_params, float(loss)
+
+
+def test_dp_tp_step():
+    _, _, _, loss = _run_step(dict(dp=4, tp=2))
+    assert np.isfinite(loss)
+
+
+def test_pp_pipeline_matches_unpipelined():
+    # same init (seeded) run with pp=4 vs single-stage: losses must agree
+    import paddle_tpu
+    paddle_tpu.seed(7)
+    m1 = dist.init_parallel_env(dp=4, pp=2)
+    t1 = HybridPretrainer(ErnieConfig(**CFG), mesh=m1, num_micro=2)
+    p1 = t1.place_params(t1.init_params())
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, 4, 16, t1.cfg.vocab_size)
+    with m1:
+        l_pipe = float(jax.jit(t1.loss_fn)(
+            jax.tree_util.tree_map(jnp.asarray, p1),
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            jax.random.PRNGKey(0)))
+
+    # rebuild identical params on a pp-free mesh by reusing p1's raw values
+    mesh_mod.set_mesh(None)
+    m2 = dist.init_parallel_env(dp=8)
+    t2 = HybridPretrainer(ErnieConfig(**CFG), mesh=m2, num_micro=2)
+    raw = jax.tree_util.tree_map(np.asarray, p1)
+    with m2:
+        l_seq = float(jax.jit(t2.loss_fn)(
+            jax.tree_util.tree_map(jnp.asarray, raw),
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(l_pipe, l_seq, rtol=1e-4)
+
+
+def test_moe_sp_ep_step():
+    _, _, _, loss = _run_step(dict(dp=2, sp=2, ep=2), moe=4)
+    assert np.isfinite(loss)
+
+
+def test_params_change_and_tied_weight_single_leaf():
+    trainer, params, new_params, loss = _run_step(dict(dp=4, tp=2))
+    assert trainer._TIED not in params["head"]
+    # embedding table leaf received gradient (tied MLM decoder contributes)
+    delta = np.abs(np.asarray(new_params["embed"][trainer._EMB]) -
+                   np.asarray(params["embed"][trainer._EMB])).max()
+    assert delta > 0
