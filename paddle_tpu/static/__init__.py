@@ -1,0 +1,35 @@
+"""paddle_tpu.static — the static-graph (Fluid-style) programming model.
+
+Reference parity: the entire Fluid stack — ProgramDesc/Executor
+(python/paddle/fluid/framework.py, executor.py; C++ executor.cc:180) and the
+2.0 `paddle.static` namespace.  TPU-native: programs lower to single jitted
+XLA computations instead of per-op kernel dispatch (see executor.py).
+
+Minimum end-to-end slice (SURVEY.md §7 step 3): build MNIST with
+static.layers, append_backward via an optimizer, train with Executor.run —
+tests/test_static.py demonstrates exactly this.
+"""
+from . import layers, optimizer
+from .backward import append_backward, gradients
+from .executor import Executor, Scope, global_scope, scope_guard
+from .framework import (
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+    unique_name,
+)
+from .io import (
+    load_inference_model,
+    load_persistables,
+    save_inference_model,
+    save_persistables,
+)
+from .registry import register_op, registered_ops
+
+data = layers.data

@@ -1,0 +1,67 @@
+"""append_backward for static programs.
+
+Reference parity: python/paddle/fluid/backward.py:1215 `append_backward`,
+which walks the block emitting one grad-op per forward op via each op's
+GradOpMaker (:862 `_append_backward_ops_`).
+
+TPU-native design: no per-op grad kernels exist — the whole forward region is
+differentiated at lowering time with `jax.grad` (the Executor replays the
+op list as a pure function of the parameters and lets AD produce the
+cotangents; XLA CSEs the replayed forward against the primal one).  The
+program therefore records a single `backward_region` op carrying loss +
+parameter names, plus `<param>@GRAD` variables that downstream optimizer ops
+consume exactly like the reference's grad vars.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .framework import Parameter, Program, Variable, default_main_program
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def append_backward(loss: Variable, parameter_list: Optional[List] = None,
+                    no_grad_set=None, program: Optional[Program] = None
+                    ) -> List[Tuple[Parameter, Variable]]:
+    """Returns [(param, grad_var)] like the reference (backward.py:1215)."""
+    program = program or default_main_program()
+    block = program.global_block()
+    if parameter_list:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    no_grad = {v if isinstance(v, str) else v.name for v in (no_grad_set or ())}
+    params = [p for p in params if p.name not in no_grad]
+
+    grad_vars = []
+    for p in params:
+        g = block.create_var(name=p.name + GRAD_SUFFIX, shape=p.shape,
+                             dtype=p.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    block.append_op(
+        "backward_region",
+        inputs={"Loss": [loss.name], "Params": [p.name for p in params]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={})
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, program: Optional[Program] = None):
+    """ref backward.py:1795 `gradients` — grads of targets wrt inputs."""
+    program = program or default_main_program()
+    block = program.global_block()
+    tgt = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grad_vars = []
+    for v in ins:
+        g = block.create_var(name=v.name + GRAD_SUFFIX, shape=v.shape,
+                             dtype=v.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    block.append_op(
+        "backward_region",
+        inputs={"Loss": [t.name for t in tgt], "Params": [v.name for v in ins]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={"wrt_any": True})
+    return grad_vars

@@ -1,0 +1,225 @@
+"""Static-graph Executor: lower a Program to one jitted XLA computation.
+
+Reference parity: `Executor::Run` (paddle/fluid/framework/executor.cc:180):
+Prepare builds the op list (:378), RunPreparedContext interprets it
+sequentially per op with kernel dispatch + GC (:476); python side
+fluid/executor.py:474/:915 with feed/fetch injection and a prepared-context
+cache (:1272).
+
+TPU-native design (SURVEY.md §7 step 3): the op loop becomes a *trace* — the
+Executor walks the block once inside jax.jit, invoking each op's lowering
+rule to build a single fused XLA program `(feeds, state, key) -> (fetches,
+new_state)`, cached by (program version, feed signature, fetch list).  State
+= every persistable variable (parameters, optimizer slots, BN statistics,
+LR); the "write-back" the reference does through Scope mutation becomes the
+functional state round-trip.  The `backward_region` pseudo-op (see
+backward.py) differentiates a replay of the forward prefix; per-op
+`fold_in`-derived PRNG scopes make the replay's random draws (dropout)
+bit-identical to the primal's, so AD is exact.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from . import ops as _ops  # registers lowerings
+from .backward import GRAD_SUFFIX
+from .framework import Program, Variable, default_main_program
+from .registry import get_lowering
+
+__all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
+
+
+class Scope:
+    """Name -> host array store for persistables (ref framework/scope.h:46 —
+    hierarchical C++ Scope; here a flat dict per program state)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def find_var(self, name: str):
+        return self._vars.get(name)
+
+    def var(self, name: str):
+        return self._vars.setdefault(name, None)
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+    def keys(self):
+        return self._vars.keys()
+
+    def drop(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    """ref fluid/executor.py scope_guard."""
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+
+
+def _run_op_traced(op, env, base_key, idx):
+    """Execute one op's lowering under a per-op PRNG scope (deterministic
+    replay for the backward region)."""
+    lowering = get_lowering(op.type)
+    ins = {slot: [env[n] for n in names] if names else []
+           for slot, names in op.inputs.items()}
+    with _random.rng_scope(jax.random.fold_in(base_key, idx)):
+        outs = lowering(ins, op.attrs, op)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for name, val in zip(names, vals):
+            env[name] = val
+
+
+def _trace_block(program: Program, env: Dict[str, Any], base_key):
+    """Walk block 0 building the computation into env."""
+    ops = program.global_block().ops
+    for idx, op in enumerate(ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        if op.type == "backward_region":
+            _lower_backward(program, ops, idx, env, base_key)
+            continue
+        _run_op_traced(op, env, base_key, idx)
+
+
+def _lower_backward(program, ops, bw_idx, env, base_key):
+    op = ops[bw_idx]
+    loss_names = op.inputs["Loss"]
+    param_names = op.inputs["Params"]
+    grad_names = op.outputs["Grads"]
+    # the replay closes over the *initial* bindings of everything except the
+    # differentiated params — snapshot env entries that ops 0..bw_idx read
+    init_env = dict(env)
+
+    def replay(param_values: Dict[str, Any]):
+        env2 = dict(init_env)
+        env2.update(param_values)
+        for idx2, prev in enumerate(ops[:bw_idx]):
+            if prev.type in ("feed", "fetch", "backward_region"):
+                continue
+            _run_op_traced(prev, env2, base_key, idx2)
+        total = 0.0
+        for ln in loss_names:
+            total = total + jnp.sum(env2[ln].astype(jnp.float32))
+        return total
+
+    pv = {n: env[n] for n in param_names}
+    grads = jax.grad(replay)(pv)
+    for pname, gname in zip(param_names, grad_names):
+        env[gname] = grads[pname]
+
+
+class Executor:
+    """ref fluid/executor.py:474.  `place` is accepted for API parity; XLA
+    owns placement (SURVEY.md L0a TPU mapping)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Any] = {}
+        self._step = 0
+
+    # -- public API ----------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        feed_arrays = {k: np.asarray(v) for k, v in feed.items()}
+
+        state_names = self._state_names(program, scope)
+        missing = [n for n in state_names
+                   if scope.find_var(n) is None and self._needs_value(program, n)]
+        if missing:
+            raise RuntimeError(
+                f"persistable variables {missing} have no value in scope — "
+                "run the startup program first (exe.run(startup_program))")
+
+        key = (id(program), program._version, tuple(fetch_names),
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_arrays.items())))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(program, list(feed_arrays), fetch_names,
+                                   state_names)
+            self._cache[key] = compiled
+
+        state = {n: scope.find_var(n) for n in state_names
+                 if scope.find_var(n) is not None}
+        base_key = jax.random.PRNGKey(
+            (program.random_seed or _random_seed()) + self._step)
+        self._step += 1
+        fetches, new_state = compiled(feed_arrays, state, base_key)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- internals -----------------------------------------------------------
+    def _state_names(self, program: Program, scope: Scope) -> List[str]:
+        names = []
+        for v in program.list_vars():
+            if v.persistable:
+                names.append(v.name)
+        return names
+
+    def _needs_value(self, program: Program, name: str) -> bool:
+        """A persistable var needs a prior value unless some op in this
+        program writes it before any read (init ops in startup programs)."""
+        for op in program.global_block().ops:
+            if name in op.output_names():
+                return False
+            if name in op.input_names():
+                return True
+        return False
+
+    def _build(self, program: Program, feed_names, fetch_names, state_names):
+        def raw(feeds, state, base_key):
+            env: Dict[str, Any] = {}
+            env.update({k: jnp.asarray(v) for k, v in state.items()})
+            env.update({k: jnp.asarray(v) for k, v in feeds.items()})
+            _trace_block(program, env, base_key)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in state_names if n in env}
+            return fetches, new_state
+
+        return jax.jit(raw)
+
+    def close(self):
+        self._cache.clear()
+
+
+def _random_seed() -> int:
+    # derive from the process-wide RNG stream so `paddle_tpu.seed` governs
+    # static-graph randomness too
+    key, counter = _random.get_rng_state()
+    data = np.asarray(jax.random.key_data(key)).ravel()
+    return (int(data[-1]) + int(counter)) & 0x7FFFFFFF

@@ -1,0 +1,171 @@
+"""Static-graph model persistence.
+
+Reference parity: python/paddle/fluid/io.py — save/load_persistables
+(:598/:692) and save/load_inference_model (:1164/:1374), which serialize a
+pruned ProgramDesc + parameter files.
+
+TPU-native format: a directory with `program.json` (the symbolic program:
+vars + ops + attrs — human-readable, replaces the protobuf ProgramDesc) and
+`params.npz` (every persistable's value).  load_inference_model rebuilds the
+Program and returns (program, feed_names, fetch_names) exactly like the
+reference API.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .executor import Executor, Scope, global_scope
+from .framework import Parameter, Program, Variable
+
+__all__ = ["save_persistables", "load_persistables", "save_inference_model",
+           "load_inference_model"]
+
+
+def _persistable_values(program: Program, scope: Scope):
+    out = {}
+    for v in program.list_vars():
+        if v.persistable:
+            val = scope.find_var(v.name)
+            if val is not None:
+                out[v.name] = np.asarray(val)
+    return out
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None):
+    """ref fluid/io.py:598 — all persistables (params + optimizer state)."""
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, "params.npz"),
+             **_persistable_values(program, scope))
+
+
+def load_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None):
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    data = np.load(os.path.join(dirname, "params.npz"))
+    for v in program.list_vars():
+        if v.persistable and v.name in data:
+            scope.set(v.name, data[v.name])
+
+
+def _program_to_json(program: Program) -> dict:
+    blk = program.global_block()
+    return {
+        "vars": [
+            {"name": v.name, "shape": list(v.shape),
+             "dtype": np.dtype(v.dtype).name, "persistable": v.persistable,
+             "is_data": v.is_data, "parameter": isinstance(v, Parameter),
+             "trainable": getattr(v, "trainable", False)}
+            for v in blk.vars.values()],
+        "ops": [
+            {"type": op.type, "inputs": op.inputs, "outputs": op.outputs,
+             "attrs": _jsonable(op.attrs)}
+            for op in blk.ops],
+    }
+
+
+def _jsonable(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, (np.floating,)):
+            v = float(v)
+        elif isinstance(v, (tuple,)):
+            v = list(v)
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out
+
+
+def _program_from_json(d: dict) -> Program:
+    p = Program()
+    b = p.global_block()
+    for v in d["vars"]:
+        if v["parameter"]:
+            b.create_parameter(v["name"], v["shape"], v["dtype"],
+                               trainable=v.get("trainable", True))
+        else:
+            b.create_var(v["name"], v["shape"], v["dtype"],
+                         persistable=v["persistable"], is_data=v["is_data"])
+    for op in d["ops"]:
+        b.append_op(op["type"], op["inputs"], op["outputs"], op["attrs"])
+    return p
+
+
+def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
+    """Backward slice from the fetches, dropping backward/optimizer ops —
+    the reference's prune + inference-transpile step (io.py:1164)."""
+    blk = program.global_block()
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(blk.ops):
+        if op.type in ("backward_region", "sgd", "momentum", "adam", "feed",
+                       "fetch"):
+            continue
+        if set(op.output_names()) & needed:
+            kept.append(op)
+            needed |= set(op.input_names())
+    kept.reverse()
+    pruned = Program()
+    b = pruned.global_block()
+    for name, v in blk.vars.items():
+        if name in needed or name in fetch_names:
+            if isinstance(v, Parameter):
+                b.create_parameter(name, v.shape, v.dtype, v.trainable)
+            else:
+                b.create_var(name, v.shape, v.dtype, persistable=v.persistable,
+                             is_data=v.is_data)
+    for op in kept:
+        attrs = dict(op.attrs)
+        if op.type in ("dropout", "batch_norm"):
+            attrs["is_test"] = True
+        b.append_op(op.type, op.inputs, op.outputs, attrs)
+    return pruned
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor: Executor,
+                         main_program: Optional[Program] = None,
+                         scope: Optional[Scope] = None):
+    """ref fluid/io.py:1164."""
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in target_vars]
+    pruned = _prune_for_inference(program, list(feeded_var_names), fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "program.json"), "w") as f:
+        json.dump({"program": _program_to_json(pruned),
+                   "feeds": list(feeded_var_names),
+                   "fetches": fetch_names}, f, indent=1)
+    np.savez(os.path.join(dirname, "params.npz"),
+             **_persistable_values(pruned, scope))
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         scope: Optional[Scope] = None
+                         ) -> Tuple[Program, List[str], List[str]]:
+    """ref fluid/io.py:1374 — returns (program, feed_names, fetch_names)."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, "program.json")) as f:
+        d = json.load(f)
+    program = _program_from_json(d["program"])
+    data = np.load(os.path.join(dirname, "params.npz"))
+    for name in data.files:
+        scope.set(name, data[name])
+    return program, d["feeds"], d["fetches"]

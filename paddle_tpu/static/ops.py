@@ -1,0 +1,421 @@
+"""Lowering rules for the static-graph op set.
+
+Reference parity: the operator library (paddle/fluid/operators/, SURVEY.md
+N27 — 467 registered ops); this registers the working set the fluid layers
+DSL emits (conv2d, pool2d, batch_norm, mul/fc, elementwise, softmax CE,
+optimizer update ops, fill/random init ops...).  Each rule lowers to
+jax/nn.functional calls under the Executor's trace — XLA does the kernel
+work the reference's .cu files do.
+
+Rule signature: fn(ins: {slot: [arrays]}, attrs: dict, op) -> {slot: [arrays]}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype_mod, random as _random
+from ..nn import functional as F
+from .registry import register_op
+
+
+def _one(ins, slot):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+# -- creation / init ---------------------------------------------------------
+
+@register_op("fill_constant")
+def _fill_constant(ins, attrs, op):
+    shape = tuple(attrs["shape"])
+    dtype = _dtype_mod.convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype)]}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ins, attrs, op):
+    shape = tuple(attrs["shape"])
+    dtype = _dtype_mod.convert_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
+        _random.next_key(), shape, dtype)
+    return {"Out": [out]}
+
+
+@register_op("uniform_random")
+def _uniform_random(ins, attrs, op):
+    shape = tuple(attrs["shape"])
+    dtype = _dtype_mod.convert_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(_random.next_key(), shape, dtype,
+                             attrs.get("min", -1.0), attrs.get("max", 1.0))
+    return {"Out": [out]}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ins, attrs, op):
+    shape = tuple(attrs["shape"])
+    dtype = _dtype_mod.convert_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
+        _random.next_key(), -2.0, 2.0, shape, dtype)
+    return {"Out": [out]}
+
+
+@register_op("assign")
+def _assign(ins, attrs, op):
+    return {"Out": [_one(ins, "X")]}
+
+
+@register_op("cast")
+def _cast(ins, attrs, op):
+    return {"Out": [_one(ins, "X").astype(
+        _dtype_mod.convert_dtype(attrs["out_dtype"]))]}
+
+
+@register_op("scale")
+def _scale(ins, attrs, op):
+    x = _one(ins, "X")
+    s, b = attrs.get("scale", 1.0), attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+# -- math --------------------------------------------------------------------
+
+def _bcast_axis(x, y, axis):
+    """Reference elementwise broadcasting: align y's dims starting at `axis`
+    (operators/elementwise/elementwise_op_function.h semantics)."""
+    if axis is None or axis == -1 or x.ndim == y.ndim:
+        return y
+    shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        shape[axis + i] = s
+    return y.reshape(shape)
+
+
+def _elementwise(fn):
+    def rule(ins, attrs, op):
+        x, y = _one(ins, "X"), _one(ins, "Y")
+        y = _bcast_axis(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return rule
+
+
+for _name, _fn in [("elementwise_add", jnp.add), ("elementwise_sub", jnp.subtract),
+                   ("elementwise_mul", jnp.multiply),
+                   ("elementwise_div", jnp.divide),
+                   ("elementwise_max", jnp.maximum),
+                   ("elementwise_min", jnp.minimum),
+                   ("elementwise_pow", jnp.power)]:
+    register_op(_name)(_elementwise(_fn))
+
+
+@register_op("mul")
+def _mul(ins, attrs, op):
+    """ref mul_op: flatten x to 2-D at x_num_col_dims then matmul."""
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xd])), int(np.prod(xs[xd:])))
+    y2 = y.reshape(int(np.prod(ys[:yd])), int(np.prod(ys[yd:])))
+    out = x2 @ y2
+    return {"Out": [out.reshape(xs[:xd] + ys[yd:])]}
+
+
+@register_op("matmul")
+def _matmul(ins, attrs, op):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y) * attrs.get("alpha", 1.0)]}
+
+
+for _name, _ufn in [("relu", jax.nn.relu), ("sigmoid", jax.nn.sigmoid),
+                    ("tanh", jnp.tanh), ("gelu", jax.nn.gelu),
+                    ("exp", jnp.exp), ("log", jnp.log), ("sqrt", jnp.sqrt),
+                    ("square", jnp.square), ("abs", jnp.abs),
+                    ("floor", jnp.floor), ("ceil", jnp.ceil),
+                    ("softsign", jax.nn.soft_sign)]:
+    def _make_unary(fn):
+        def rule(ins, attrs, op):
+            return {"Out": [fn(_one(ins, "X"))]}
+        return rule
+    register_op(_name)(_make_unary(_ufn))
+
+
+@register_op("softmax")
+def _softmax(ins, attrs, op):
+    return {"Out": [jax.nn.softmax(_one(ins, "X"),
+                                   axis=attrs.get("axis", -1))]}
+
+
+@register_op("mean")
+def _mean(ins, attrs, op):
+    return {"Out": [jnp.mean(_one(ins, "X"))]}
+
+
+def _reduce(fn):
+    def rule(ins, attrs, op):
+        x = _one(ins, "X")
+        dim = attrs.get("dim", None)
+        if attrs.get("reduce_all", False) or dim is None:
+            dim = tuple(range(x.ndim))
+        return {"Out": [fn(x, axis=tuple(dim),
+                           keepdims=attrs.get("keep_dim", False))]}
+
+    return rule
+
+
+for _name, _fn in [("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+                   ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+                   ("reduce_prod", jnp.prod)]:
+    register_op(_name)(_reduce(_fn))
+
+
+@register_op("sum")
+def _sum(ins, attrs, op):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("clip")
+def _clip(ins, attrs, op):
+    return {"Out": [jnp.clip(_one(ins, "X"), attrs.get("min"),
+                             attrs.get("max"))]}
+
+
+# -- shape manipulation ------------------------------------------------------
+
+@register_op("reshape2")
+def _reshape2(ins, attrs, op):
+    x = _one(ins, "X")
+    shape = list(attrs["shape"])
+    # ref reshape semantics: 0 = copy input dim, -1 = infer
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)] \
+        if any(s == 0 for s in shape) else shape
+    return {"Out": [x.reshape(shape)], "XShape": [jnp.zeros((0,))]}
+
+
+@register_op("transpose2")
+def _transpose2(ins, attrs, op):
+    return {"Out": [jnp.transpose(_one(ins, "X"), attrs["axis"])],
+            "XShape": [jnp.zeros((0,))]}
+
+
+@register_op("flatten2")
+def _flatten2(ins, attrs, op):
+    x = _one(ins, "X")
+    ax = attrs.get("axis", 1)
+    out = x.reshape(int(np.prod(x.shape[:ax])) if ax else 1,
+                    int(np.prod(x.shape[ax:])))
+    return {"Out": [out], "XShape": [jnp.zeros((0,))]}
+
+
+@register_op("concat")
+def _concat(ins, attrs, op):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def _split(ins, attrs, op):
+    x = _one(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", None)
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1]
+        outs = jnp.split(x, idx, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ins, attrs, op):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("squeeze2")
+def _squeeze2(ins, attrs, op):
+    x = _one(ins, "X")
+    axes = tuple(attrs.get("axes", ()))
+    return {"Out": [jnp.squeeze(x, axis=axes or None)],
+            "XShape": [jnp.zeros((0,))]}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ins, attrs, op):
+    x = _one(ins, "X")
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x], "XShape": [jnp.zeros((0,))]}
+
+
+# -- nn ----------------------------------------------------------------------
+
+@register_op("conv2d")
+def _conv2d(ins, attrs, op):
+    out = F.conv2d(_one(ins, "Input"), _one(ins, "Filter"),
+                   bias=_one(ins, "Bias"),
+                   stride=attrs.get("strides", 1),
+                   padding=attrs.get("paddings", 0),
+                   dilation=attrs.get("dilations", 1),
+                   groups=attrs.get("groups", 1))
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def _pool2d(ins, attrs, op):
+    x = _one(ins, "X")
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        out = (jnp.max if ptype == "max" else jnp.mean)(
+            x, axis=(2, 3), keepdims=True)
+    elif attrs.get("adaptive", False):
+        fn = (F.adaptive_max_pool2d if ptype == "max"
+              else F.adaptive_avg_pool2d)
+        out = fn(x, attrs["ksize"])
+    else:
+        fn = F.max_pool2d if ptype == "max" else F.avg_pool2d
+        out = fn(x, attrs["ksize"], stride=attrs.get("strides", None),
+                 padding=attrs.get("paddings", 0))
+    return {"Out": [out]}
+
+
+@register_op("batch_norm")
+def _batch_norm(ins, attrs, op):
+    training = not attrs.get("is_test", False)
+    out, new_rm, new_rv = F.batch_norm(
+        _one(ins, "X"), _one(ins, "Mean"), _one(ins, "Variance"),
+        weight=_one(ins, "Scale"), bias=_one(ins, "Bias"),
+        training=training, momentum=attrs.get("momentum", 0.9),
+        epsilon=attrs.get("epsilon", 1e-5))
+    return {"Y": [out], "MeanOut": [new_rm], "VarianceOut": [new_rv]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ins, attrs, op):
+    x = _one(ins, "X")
+    ax = attrs.get("begin_norm_axis", 1)
+    out = F.layer_norm(x, x.shape[ax:], weight=_one(ins, "Scale"),
+                       bias=_one(ins, "Bias"),
+                       epsilon=attrs.get("epsilon", 1e-5))
+    return {"Y": [out]}
+
+
+@register_op("dropout")
+def _dropout(ins, attrs, op):
+    out = F.dropout(_one(ins, "X"), p=attrs.get("dropout_prob", 0.5),
+                    training=not attrs.get("is_test", False),
+                    mode=attrs.get("dropout_implementation",
+                                   "upscale_in_train"))
+    return {"Out": [out]}
+
+
+@register_op("lookup_table_v2")
+def _lookup_table_v2(ins, attrs, op):
+    ids = _one(ins, "Ids")
+    pad = attrs.get("padding_idx", -1)
+    return {"Out": [F.embedding(ids, _one(ins, "W"),
+                                padding_idx=None if pad < 0 else pad)]}
+
+
+# -- loss / metrics ----------------------------------------------------------
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ins, attrs, op):
+    logits = _one(ins, "Logits")
+    label = _one(ins, "Label")
+    loss = F.softmax_with_cross_entropy(
+        logits, label, soft_label=attrs.get("soft_label", False),
+        ignore_index=attrs.get("ignore_index", -100))
+    if loss.ndim < logits.ndim:
+        loss = loss[..., None]
+    return {"Loss": [loss], "Softmax": [jax.nn.softmax(logits, axis=-1)]}
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ins, attrs, op):
+    x = _one(ins, "X")  # probabilities
+    label = _one(ins, "Label")
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        lab = label[..., 0] if label.ndim == x.ndim else label
+        p = jnp.take_along_axis(x, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(p, 1e-20))
+    return {"Y": [loss]}
+
+
+@register_op("accuracy")
+def _accuracy(ins, attrs, op):
+    pred = _one(ins, "Out")
+    label = _one(ins, "Label")
+    top1 = jnp.argmax(pred, axis=-1)
+    lab = label[..., 0] if label.ndim == pred.ndim else label
+    acc = jnp.mean((top1 == lab).astype(jnp.float32))
+    n = jnp.asarray(pred.shape[0], jnp.int32)
+    return {"Accuracy": [acc], "Correct": [(acc * n).astype(jnp.int32)],
+            "Total": [n]}
+
+
+@register_op("top_k")
+def _top_k(ins, attrs, op):
+    vals, idx = jax.lax.top_k(_one(ins, "X"), attrs.get("k", 1))
+    return {"Out": [vals], "Indices": [idx]}
+
+
+@register_op("arg_max")
+def _arg_max(ins, attrs, op):
+    return {"Out": [jnp.argmax(_one(ins, "X"),
+                               axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+# -- optimizer update ops (ref operators/optimizers/, SURVEY.md N30) ---------
+
+@register_op("sgd")
+def _sgd(ins, attrs, op):
+    p, g, lr = _one(ins, "Param"), _one(ins, "Grad"), _one(ins, "LearningRate")
+    return {"ParamOut": [p - lr.astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register_op("momentum")
+def _momentum(ins, attrs, op):
+    p, g = _one(ins, "Param"), _one(ins, "Grad")
+    v, lr = _one(ins, "Velocity"), _one(ins, "LearningRate")
+    mu = attrs.get("mu", 0.9)
+    lr = lr.astype(p.dtype)
+    v_new = mu * v + g.astype(p.dtype)
+    if attrs.get("use_nesterov", False):
+        p_new = p - lr * (g.astype(p.dtype) + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam")
+def _adam(ins, attrs, op):
+    p, g = _one(ins, "Param"), _one(ins, "Grad")
+    m, v = _one(ins, "Moment1"), _one(ins, "Moment2")
+    lr = _one(ins, "LearningRate").astype(jnp.float32)
+    b1p = _one(ins, "Beta1Pow").astype(jnp.float32)
+    b2p = _one(ins, "Beta2Pow").astype(jnp.float32)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+    p_new = p.astype(jnp.float32) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m_new],
+            "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}

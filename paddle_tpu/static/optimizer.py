@@ -1,0 +1,119 @@
+"""Static-graph optimizers: append update ops to the program.
+
+Reference parity: python/paddle/fluid/optimizer.py `Optimizer` (:56) —
+`minimize` = append_backward + `_create_optimization_pass` emitting one
+fused update op per parameter (sgd/momentum/adam ops, operators/optimizers/,
+SURVEY.md N30), with slot ("accumulator") variables created as persistables.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..nn import initializer as I
+from .backward import append_backward
+from .framework import (
+    Parameter,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    unique_name,
+)
+from .layers import create_parameter
+
+__all__ = ["SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+           "Adam", "AdamOptimizer"]
+
+
+class _StaticOptimizer:
+    def __init__(self, learning_rate: float):
+        self._lr_value = float(learning_rate)
+        self._lr_var: Optional[Variable] = None
+
+    def _lr(self) -> Variable:
+        if self._lr_var is None or \
+                not default_main_program().global_block().has_var(self._lr_var.name):
+            self._lr_var = create_parameter(
+                (), "float32", name=unique_name("learning_rate"),
+                default_initializer=I.Constant(self._lr_value),
+                trainable=False)
+        return self._lr_var
+
+    def _slot(self, param: Parameter, suffix: str, init=0.0, shape=None):
+        return create_parameter(
+            shape if shape is not None else param.shape, "float32",
+            name=f"{param.name}_{suffix}",
+            default_initializer=I.Constant(init), trainable=False)
+
+    def minimize(self, loss: Variable, parameter_list=None
+                 ) -> Tuple[None, List[Tuple[Parameter, Variable]]]:
+        p_g = append_backward(loss, parameter_list)
+        self.apply_gradients(p_g)
+        return None, p_g
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        lr = self._lr()
+        for p, g in params_grads:
+            self._append_update(block, p, g, lr)
+
+    def _append_update(self, block, p, g, lr):
+        raise NotImplementedError
+
+
+class SGD(_StaticOptimizer):
+    """ref fluid/optimizer.py:947 SGDOptimizer → sgd op."""
+
+    def _append_update(self, block, p, g, lr):
+        block.append_op("sgd",
+                        {"Param": [p.name], "Grad": [g.name],
+                         "LearningRate": [lr.name]},
+                        {"ParamOut": [p.name]})
+
+
+class Momentum(_StaticOptimizer):
+    """ref fluid/optimizer.py MomentumOptimizer → momentum op."""
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False):
+        super().__init__(learning_rate)
+        self.mu = momentum
+        self.use_nesterov = use_nesterov
+
+    def _append_update(self, block, p, g, lr):
+        vel = self._slot(p, "velocity")
+        block.append_op("momentum",
+                        {"Param": [p.name], "Grad": [g.name],
+                         "Velocity": [vel.name], "LearningRate": [lr.name]},
+                        {"ParamOut": [p.name], "VelocityOut": [vel.name]},
+                        {"mu": self.mu, "use_nesterov": self.use_nesterov})
+
+
+class Adam(_StaticOptimizer):
+    """ref fluid/optimizer.py:1821 AdamOptimizer → adam op (dense path)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _append_update(self, block, p, g, lr):
+        m1 = self._slot(p, "moment1")
+        m2 = self._slot(p, "moment2")
+        b1p = self._slot(p, "beta1_pow", init=1.0, shape=())
+        b2p = self._slot(p, "beta2_pow", init=1.0, shape=())
+        block.append_op(
+            "adam",
+            {"Param": [p.name], "Grad": [g.name], "Moment1": [m1.name],
+             "Moment2": [m2.name], "LearningRate": [lr.name],
+             "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name]},
+            {"ParamOut": [p.name], "Moment1Out": [m1.name],
+             "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+             "Beta2PowOut": [b2p.name]},
+            {"beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon})
+
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam

@@ -1,0 +1,42 @@
+"""Op lowering registry: op type -> jax-emitting rule.
+
+Reference parity: the op registry + kernel dispatch machinery
+(paddle/fluid/framework/op_registry.h:223 REGISTER_OPERATOR,
+operator.cc:944 RunImpl → ChooseKernel :977).  TPU-native design: an op's
+"kernel" is a *lowering rule* called while the Executor traces the block
+under jit — it receives {slot: [jax arrays]} plus attrs and returns
+{slot: [jax arrays]}.  There is no per-place kernel table: XLA owns code
+generation for every backend (SURVEY.md §7 design stance).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+Lowering = Callable[..., Dict[str, List[Any]]]
+
+_REGISTRY: Dict[str, Lowering] = {}
+
+
+def register_op(type_name: str):
+    """Decorator: register `fn(inputs, attrs, op) -> outputs_by_slot`."""
+
+    def deco(fn: Lowering) -> Lowering:
+        if type_name in _REGISTRY:
+            raise ValueError(f"op {type_name!r} registered twice")
+        _REGISTRY[type_name] = fn
+        return fn
+
+    return deco
+
+
+def get_lowering(type_name: str) -> Lowering:
+    try:
+        return _REGISTRY[type_name]
+    except KeyError:
+        raise NotImplementedError(
+            f"no lowering registered for op type {type_name!r}; known: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,213 @@
+"""Static-graph (Fluid-style) path: program construction, Executor lowering,
+append_backward AD, optimizer ops, BN state, save/load — the minimum
+end-to-end slice of SURVEY.md §7 step 3 (MNIST trained by Executor)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+def _mnist_batch(rng, n=16):
+    return (rng.normal(0, 1, (n, 1, 28, 28)).astype(np.float32),
+            rng.integers(0, 10, (n, 1)).astype(np.int64))
+
+
+def test_program_construction_and_repr(_fresh_programs):
+    x = L.data("x", [4])
+    y = L.fc(x, 3, act="relu")
+    main, _ = _fresh_programs
+    assert y.shape == (-1, 3)
+    types = [op.type for op in main.global_block().ops]
+    assert types == ["mul", "elementwise_add", "relu"]
+    assert "mul" in main.to_string()
+
+
+def test_mlp_trains_mnist(_fresh_programs):
+    main, startup = _fresh_programs
+    img = L.data("img", [784])
+    label = L.data("label", [1], dtype="int64")
+    h = L.fc(img, 64, act="relu")
+    logits = L.fc(h, 10)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    opt = static.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (32, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (32, 1)).astype(np.int64)
+    losses = []
+    for _ in range(25):
+        lv, = exe.run(main, feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert losses[-1] < 0.5
+
+
+def test_lenet_conv_bn_pipeline(_fresh_programs):
+    main, startup = _fresh_programs
+    img = L.data("img", [1, 28, 28])
+    label = L.data("label", [1], dtype="int64")
+    c1 = L.conv2d(img, 6, 5, padding=2, act="relu")
+    p1 = L.pool2d(c1, 2)
+    bn = L.batch_norm(p1)
+    c2 = L.conv2d(bn, 16, 5, act="relu")
+    p2 = L.pool2d(c2, 2)
+    flat = L.flatten(p2)
+    logits = L.fc(flat, 10)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    acc = L.accuracy(L.softmax(logits), label)
+    static.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(1)
+    x, y = _mnist_batch(rng, 16)
+    l0 = None
+    scope = static.global_scope()
+    bn_name = [n for n in scope.keys() if n.endswith(".mean")][0]
+    mean_before = np.array(scope.find_var(bn_name))
+    for i in range(10):
+        lv, av = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[loss, acc])
+        l0 = l0 or float(lv)
+    assert float(lv) < l0  # loss decreased
+    # BN running stats were updated through the functional state round-trip
+    mean_after = np.array(scope.find_var(bn_name))
+    assert np.abs(mean_after - mean_before).max() > 0
+
+
+def test_adam_slots_and_lr_are_persistable(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    y = L.data("y", [1])
+    pred = L.fc(x, 1)
+    loss = L.mean(L.elementwise_sub(pred, y) * L.elementwise_sub(pred, y))
+    static.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    assert any("moment1" in k for k in scope.keys())
+    assert any("learning_rate" in k for k in scope.keys())
+    rng = np.random.default_rng(2)
+    xv = rng.normal(size=(8, 4)).astype(np.float32)
+    yv = (xv @ np.array([[1.], [2.], [-1.], [0.5]], np.float32))
+    for _ in range(5):
+        lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    m1 = [v for k in scope.keys() if "moment1" in k
+          for v in [scope.find_var(k)]][0]
+    assert np.abs(np.asarray(m1)).max() > 0  # slots actually accumulate
+
+
+def test_gradients_api(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [3])
+    w = L.create_parameter((3, 1), name="w")
+    y = L.mean(L.matmul(x, w))
+    gx = static.gradients(y, main.global_block().var("x"))[0]
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32)
+    gv, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    wv = static.global_scope().find_var("w")
+    np.testing.assert_allclose(gv, np.tile(np.asarray(wv).T, (2, 1)) / 2,
+                               rtol=1e-5)
+
+
+def test_dropout_deterministic_backward(_fresh_programs):
+    # grads must correspond to the same dropout mask as the forward —
+    # train a layer THROUGH dropout and check loss goes down steadily
+    main, startup = _fresh_programs
+    x = L.data("x", [16])
+    y = L.data("y", [1])
+    h = L.dropout(L.fc(x, 32, act="relu"), dropout_prob=0.3)
+    pred = L.fc(h, 1)
+    d = L.elementwise_sub(pred, y)
+    loss = L.mean(d * d)
+    static.optimizer.SGD(0.1).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(3)
+    xv = rng.normal(size=(64, 16)).astype(np.float32)
+    yv = rng.normal(size=(64, 1)).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_save_load_inference_model(tmp_path, _fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    h = L.fc(x, 8, act="relu", name="fc1")
+    out = L.fc(h, 2, name="fc2")
+    loss = L.mean(out)
+    static.optimizer.SGD(0.1).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.default_rng(4).normal(size=(3, 4)).astype(np.float32)
+    # one training run (runs the whole block incl. sgd, like the reference)
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    d = str(tmp_path / "model")
+    static.save_inference_model(d, ["x"], [out], exe)
+    scope = static.global_scope()
+    ref = np.maximum(xv @ scope.find_var("fc1.w") + scope.find_var("fc1.b"),
+                     0) @ scope.find_var("fc2.w") + scope.find_var("fc2.b")
+
+    with static.scope_guard(static.Scope()):
+        prog, feeds, fetches = static.load_inference_model(d, exe)
+        assert feeds == ["x"]
+        got, = static.Executor().run(prog, feed={"x": xv},
+                                     fetch_list=fetches)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4)
+    # optimizer ops were pruned from the inference program
+    assert all(op.type not in ("sgd", "backward_region")
+               for op in prog.global_block().ops)
+
+
+def test_save_load_persistables_roundtrip(tmp_path, _fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    out = L.fc(x, 2, name="fc")
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    w0 = np.array(scope.find_var("fc.w"))
+    static.save_persistables(exe, str(tmp_path / "ckpt"))
+    scope.set("fc.w", np.zeros_like(w0))
+    static.load_persistables(exe, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.array(scope.find_var("fc.w")), w0)
+
+
+def test_program_clone_for_test_switches_dropout(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    h = L.dropout(L.fc(x, 8), dropout_prob=0.9)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attrs["is_test"] is True
+    # train program unchanged
+    drop_train = [op for op in main.global_block().ops
+                  if op.type == "dropout"][0]
+    assert not drop_train.attrs.get("is_test", False)
+
+
+def test_executor_reports_uninitialized(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    out = L.fc(x, 2)
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match="startup"):
+        exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                fetch_list=[out])
