@@ -1,0 +1,188 @@
+"""Automatic mixed precision.
+
+Reference parity: the dygraph AMP pair — `amp_guard`/`auto_cast`
+(python/paddle/fluid/dygraph/amp/auto_cast.py:90) and `AmpScaler`/
+`GradScaler` (loss_scaler.py:27) — plus the static decorator
+(fluid/contrib/mixed_precision/decorator.py:218) whose white/black op lists
+drive a program rewrite.
+
+TPU-native design: the natural mixed-precision dtype is **bfloat16**, which
+shares float32's exponent range — so loss scaling is mathematically
+unnecessary on the default path (SURVEY.md §2.2 AMP row).  `auto_cast`
+therefore works by value-casting: inside the context, `amp_cast`/the
+functional train-step helpers cast float params/activations to the compute
+dtype while normalization/softmax/losses stay float32 (our nn.functional
+already computes those in float32 internally).  `GradScaler` implements the
+reference's dynamic loss-scale state machine for float16 parity and for
+users porting scaler-based loops.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype_mod
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+           "amp_state", "amp_cast", "WHITE_LIST", "BLACK_LIST"]
+
+# ref fp16_lists.py: ops safe in low precision vs ops kept in float32 —
+# informational here (jax fns in nn.functional already pin norm/softmax/loss
+# accumulation to float32).
+WHITE_LIST = ("matmul", "conv2d", "mul", "fc", "attention")
+BLACK_LIST = ("softmax", "layer_norm", "batch_norm", "cross_entropy",
+              "mean", "sum", "exp", "log")
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16"):
+    """ref dygraph/amp/auto_cast.py:90 `amp_guard`.  Within the context,
+    `amp_cast` (and the hapi/pretrainer train-step builders) cast compute to
+    `dtype`."""
+    old = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = _dtype_mod.convert_dtype(dtype)
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = old
+
+
+amp_guard = auto_cast
+
+
+def amp_cast(tree, dtype=None):
+    """Cast every float leaf of a pytree to the AMP compute dtype (no-op when
+    autocast is disabled and no dtype given)."""
+    if dtype is None:
+        if not _state.enabled:
+            return tree
+        dtype = _state.dtype
+    dtype = _dtype_mod.convert_dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None):
+    """ref paddle.amp.decorate / static mixed_precision decorator.py:218.
+    O2 casts parameters in place (pure-low-precision); O1 leaves parameters
+    float32 and relies on auto_cast at compute time."""
+    if level not in ("O1", "O2"):
+        raise ValueError("level must be O1 or O2")
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """ref dygraph/amp/loss_scaler.py:27 `AmpScaler` (and paddle.amp.GradScaler):
+    dynamic loss-scale state machine — grow after N good steps, shrink on
+    non-finite grads, skip the update that step."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self) -> float:
+        return self._scale
+
+    def scale(self, loss):
+        """Multiply the loss (pre-backward) by the current scale."""
+        if not self._enable:
+            return loss
+        return loss * jnp.asarray(self._scale, jnp.float32)
+
+    def unscale_(self, grads):
+        """Divide grads by the scale; records found_inf.  Returns grads."""
+        if not self._enable:
+            return grads
+        inv = 1.0 / self._scale
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        finite = all(bool(jnp.all(jnp.isfinite(g)))
+                     for g in jax.tree_util.tree_leaves(grads))
+        self._found_inf = not finite
+        return grads
+
+    def update(self):
+        """Advance the loss-scale state machine (ref update_loss_scaling,
+        mixed_precision/decorator.py:169)."""
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def step(self, optimizer, grads):
+        """Unscale, skip on non-finite, else optimizer.step(grads)."""
+        grads = self.unscale_(grads)
+        if not self._found_inf:
+            optimizer.step(grads)
+        return not self._found_inf
+
+    def minimize(self, optimizer, scaled_loss_grads):
+        """ref AmpScaler.minimize — here grads come from the caller (no
+        global tape): behaves like step()."""
+        return self.step(optimizer, scaled_loss_grads)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"scale": self._scale, "incr_count": self._good,
+                "decr_count": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good = state.get("incr_count", 0)
+        self._bad = state.get("decr_count", 0)
+
+
+AmpScaler = GradScaler

@@ -1,1 +1,13 @@
 from .lenet import LeNet
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
+from .resnet import (
+    BasicBlock,
+    BottleneckBlock,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19

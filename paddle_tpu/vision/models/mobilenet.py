@@ -1,0 +1,144 @@
+"""MobileNet V1/V2 (ref: python/paddle/vision/models/mobilenetv1.py /
+mobilenetv2.py).  Depthwise convs lower to XLA grouped convolutions."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        from .. import models  # noqa: F401  (keep import graph acyclic)
+        from ...nn import functional as F
+        x = self.bn(self.conv(x))
+        if self.act == "relu":
+            x = F.relu(x)
+        elif self.act == "relu6":
+            x = F.relu6(x)
+        return x
+
+
+class DepthwiseSeparable(nn.Layer):
+    """ref mobilenetv1.py DepthwiseSeparable: dw 3x3 + pw 1x1."""
+
+    def __init__(self, cin, cout1, cout2, stride, scale=1.0):
+        super().__init__()
+        c1, c2 = int(cout1 * scale), int(cout2 * scale)
+        self.dw = ConvBNLayer(cin, c1, 3, stride=stride, padding=1, groups=cin)
+        self.pw = ConvBNLayer(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """ref mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)
+        self.conv1 = ConvBNLayer(3, s(32), 3, stride=2, padding=1)
+        cfg = [  # (cin, c1, c2, stride)
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2), (s(128), 128, 128, 1),
+            (s(128), 128, 256, 2), (s(256), 256, 256, 1),
+            (s(256), 256, 512, 2),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1),
+            (s(512), 512, 1024, 2), (s(1024), 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(cin, c1, c2, st, scale)
+            for cin, c1, c2, st in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import ops
+            x = ops.flatten(x, 1, -1)
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    """ref mobilenetv2.py InvertedResidualUnit."""
+
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(cin, hidden, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, act="relu6"),
+            ConvBNLayer(hidden, cout, 1, act=None),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """ref mobilenetv2.py MobileNetV2."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = int(32 * scale)
+        features = [ConvBNLayer(3, cin, 3, stride=2, padding=1, act="relu6")]
+        for t, c, n, s in cfg:
+            cout = int(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(cin, cout,
+                                                 s if i == 0 else 1, t))
+                cin = cout
+        self.last_c = int(1280 * max(1.0, scale))
+        features.append(ConvBNLayer(cin, self.last_c, 1, act="relu6"))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(self.last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import ops
+            x = ops.flatten(x, 1, -1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)

@@ -1,0 +1,70 @@
+"""VGG family (ref: python/paddle/vision/models/vgg.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def make_layers(cfg, batch_norm=False, in_channels=3):
+    layers = []
+    c = in_channels
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(c, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            c = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(dropout),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            from ... import ops
+            x = ops.flatten(x, 1, -1)
+            x = self.classifier(x)
+        return x
+
+
+def _vgg(cfg, batch_norm, **kw):
+    return VGG(make_layers(_CFGS[cfg], batch_norm), **kw)
+
+
+def vgg11(batch_norm=False, **kw):
+    return _vgg("A", batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return _vgg("B", batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return _vgg("D", batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return _vgg("E", batch_norm, **kw)
