@@ -62,6 +62,17 @@ from .layer.norm import (
     SyncBatchNorm,
 )
 from .layer.moe import MoEFFN
+from .layer.rnn import (
+    GRU,
+    LSTM,
+    RNN,
+    BiRNN,
+    GRUCell,
+    LSTMCell,
+    RNNCellBase,
+    SimpleRNN,
+    SimpleRNNCell,
+)
 from .layer.transformer import (
     MultiHeadAttention,
     Transformer,

@@ -63,6 +63,7 @@ from .loss import (
     square_error_cost,
 )
 from .input import embedding, one_hot
+from .rnn import birnn, rnn
 from ...ops.attention import flash_attention, scaled_dot_product_attention
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,345 @@
+"""Recurrent layers: SimpleRNN/LSTM/GRU cells and networks.
+
+Reference parity: python/paddle/nn/layer/rnn.py (RNNCellBase:141,
+SimpleRNNCell:263, LSTMCell:401, GRUCell:555, RNN:704, BiRNN:797,
+SimpleRNN:934, LSTM:1074, GRU:1212) and the fused cuDNN path
+(operators/cudnn_lstm_op.cu).  TPU-native design: cells are plain jnp
+formulas; the ``RNN``/``BiRNN`` wrappers run them under one ``lax.scan``
+(nn/functional/rnn.py) so XLA fuses the whole recurrence — no cuDNN-style
+hand-fused kernel is needed, and the same code path jits/pjits inside larger
+training steps.
+
+Weight layout matches the reference exactly (so state_dicts port):
+weight_ih [gates*H, input], weight_hh [gates*H, H], bias_ih/bias_hh [gates*H];
+LSTM gate chunk order (i, f, g, o) — rnn.py:535–540; GRU chunk order
+(r, z, c) with reset applied after the hidden matmul — rnn.py:685–691;
+default init Uniform(-1/sqrt(H), 1/sqrt(H)) — rnn.py:352.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from .base import Layer, LayerList, Parameter
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+    "split_states", "concat_states",
+]
+
+
+def split_states(states, bidirectional=False, state_components=1):
+    """ref: rnn.py:46 — unstack [L*D, B, H]-packed states into nested lists."""
+    if state_components == 1:
+        states = [states[i] for i in range(states.shape[0])]
+    else:
+        components = [[s[i] for i in range(s.shape[0])] for s in states]
+        states = [tuple(c) for c in zip(*components)]
+    if not bidirectional:
+        return states
+    return [(states[2 * i], states[2 * i + 1]) for i in range(len(states) // 2)]
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """ref: rnn.py:99 — inverse of split_states."""
+    if bidirectional:
+        flat = []
+        for pair in states:
+            flat.extend(pair)
+        states = flat
+    if state_components == 1:
+        return jnp.stack(list(states))
+    components = list(zip(*states))
+    return tuple(jnp.stack(list(c)) for c in components)
+
+
+class RNNCellBase(Layer):
+    """ref: rnn.py:141 — base providing ``get_initial_states``."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch_ref = jax.tree_util.tree_leaves(batch_ref)[0]
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        dtype = dtype or batch_ref.dtype
+
+        def is_leaf(s):
+            return isinstance(s, (list, tuple)) and all(
+                isinstance(d, int) for d in s)
+
+        def build(s):
+            if is_leaf(s):
+                return jnp.full((batch,) + tuple(s), init_value, dtype=dtype)
+            return tuple(build(sub) for sub in s)
+
+        return build(shape)
+
+    def _create_rnn_params(self, input_size, hidden_size, gates,
+                           weight_ih_attr=None, weight_hh_attr=None,
+                           bias_ih_attr=None, bias_hh_attr=None):
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        if bias_ih_attr is False:
+            self.bias_ih = None
+        else:
+            self.bias_ih = self.create_parameter(
+                (gates * hidden_size,), attr=bias_ih_attr, is_bias=True,
+                default_initializer=u)
+        if bias_hh_attr is False:
+            self.bias_hh = None
+        else:
+            self.bias_hh = self.create_parameter(
+                (gates * hidden_size,), attr=bias_hh_attr, is_bias=True,
+                default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def _ih(self, inputs):
+        out = jnp.matmul(inputs, self.weight_ih.value.T)
+        if self.bias_ih is not None:
+            out = out + self.bias_ih.value
+        return out
+
+    def _hh(self, h):
+        out = jnp.matmul(h, self.weight_hh.value.T)
+        if self.bias_hh is not None:
+            out = out + self.bias_hh.value
+        return out
+
+
+class SimpleRNNCell(RNNCellBase):
+    """Elman cell: h = act(W_ih x + b_ih + W_hh h + b_hh) (ref: rnn.py:263)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self._create_rnn_params(input_size, hidden_size, 1, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"activation for SimpleRNNCell should be tanh or relu, "
+                f"but got {activation}")
+        self.activation = activation
+        self._activation_fn = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h = self._activation_fn(self._ih(inputs) + self._hh(states))
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    """LSTM cell, gate chunk order (i, f, g, o) (ref: rnn.py:401,:535)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self._create_rnn_params(input_size, hidden_size, 4, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h, pre_c = states
+        gates = self._ih(inputs) + self._hh(pre_h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c = f * pre_c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    """GRU cell, chunk order (r, z, c), reset applied after the hidden matmul
+    (ref: rnn.py:555,:685–691)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self._create_rnn_params(input_size, hidden_size, 3, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        x_r, x_z, x_c = jnp.split(self._ih(inputs), 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(self._hh(pre_h), 3, axis=-1)
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)
+        h = (pre_h - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over a sequence via lax.scan (ref: rnn.py:704)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        return F.rnn(self.cell, inputs, initial_states=initial_states,
+                     sequence_length=sequence_length,
+                     time_major=self.time_major, is_reverse=self.is_reverse,
+                     **kwargs)
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (ref: rnn.py:797)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        return F.birnn(self.cell_fw, self.cell_bw, inputs, initial_states,
+                       sequence_length, time_major=self.time_major, **kwargs)
+
+
+class _RNNMixin(LayerList):
+    """Multi-layer forward shared by SimpleRNN/LSTM/GRU (ref: rnn.py:892).
+
+    Packed-state convention matches the reference: [L*D, B, H] per state
+    component, layer-major then direction.
+    """
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_index = 1 if self.time_major else 0
+        dtype = inputs.dtype
+        if initial_states is None:
+            D = 2 if self.num_directions == 2 else 1
+            batch = inputs.shape[batch_index]
+            dims = ((self.num_layers * D, batch, self.hidden_size),) \
+                * self.state_components
+            initial_states = tuple(jnp.zeros(d, dtype) for d in dims)
+            if self.state_components == 1:
+                initial_states = initial_states[0]
+
+        states = split_states(initial_states, self.num_directions == 2,
+                              self.state_components)
+        final_states = []
+        out = inputs
+        for i, rnn_layer in enumerate(self):
+            if i > 0:
+                out = F.dropout(out, self.dropout, training=self.training)
+            out, final_state = rnn_layer(out, states[i], sequence_length)
+            final_states.append(final_state)
+        return out, concat_states(final_states, self.num_directions == 2,
+                                  self.state_components)
+
+
+def _build_multilayer(obj, make_cell, input_size, hidden_size, num_layers,
+                      direction, time_major, dropout):
+    bidirect = direction in ("bidirect", "bidirectional")
+    if direction not in ("forward", "bidirect", "bidirectional"):
+        raise ValueError(
+            f"direction should be forward or bidirect (or bidirectional), "
+            f"received direction = {direction}")
+    if bidirect:
+        obj.append(BiRNN(make_cell(input_size), make_cell(input_size),
+                         time_major))
+        for _ in range(1, num_layers):
+            obj.append(BiRNN(make_cell(2 * hidden_size),
+                             make_cell(2 * hidden_size), time_major))
+    else:
+        obj.append(RNN(make_cell(input_size), is_reverse=False,
+                       time_major=time_major))
+        for _ in range(1, num_layers):
+            obj.append(RNN(make_cell(hidden_size), is_reverse=False,
+                           time_major=time_major))
+    obj.input_size = input_size
+    obj.hidden_size = hidden_size
+    obj.num_layers = num_layers
+    obj.num_directions = 2 if bidirect else 1
+    obj.time_major = time_major
+    obj.dropout = dropout
+
+
+class SimpleRNN(_RNNMixin):
+    """ref: rnn.py:934."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+
+        def make_cell(in_size):
+            return SimpleRNNCell(in_size, hidden_size, activation,
+                                 weight_ih_attr, weight_hh_attr,
+                                 bias_ih_attr, bias_hh_attr)
+
+        _build_multilayer(self, make_cell, input_size, hidden_size,
+                          num_layers, direction, time_major, dropout)
+        self.state_components = 1
+
+
+class LSTM(_RNNMixin):
+    """ref: rnn.py:1074 — final states ((L*D,B,H) h, (L*D,B,H) c)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+
+        def make_cell(in_size):
+            return LSTMCell(in_size, hidden_size, weight_ih_attr,
+                            weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        _build_multilayer(self, make_cell, input_size, hidden_size,
+                          num_layers, direction, time_major, dropout)
+        self.state_components = 2
+
+
+class GRU(_RNNMixin):
+    """ref: rnn.py:1212."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+
+        def make_cell(in_size):
+            return GRUCell(in_size, hidden_size, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        _build_multilayer(self, make_cell, input_size, hidden_size,
+                          num_layers, direction, time_major, dropout)
+        self.state_components = 1
