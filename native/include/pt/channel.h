@@ -1,0 +1,70 @@
+// Bounded blocking MPMC channel.
+//
+// Reference parity: paddle/fluid/framework/channel.h (Go-style channel used
+// by the DataFeed/Dataset pipeline) — rebuilt minimal and TPU-host oriented:
+// it only ever carries host-side sample/batch structs, never device memory
+// (XLA owns device memory; SURVEY.md L0b TPU mapping).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <utility>
+
+namespace pt {
+
+template <typename T>
+class Channel {
+ public:
+  explicit Channel(size_t capacity = 0) : capacity_(capacity), closed_(false) {}
+
+  // Returns false if the channel is closed.
+  bool Put(T&& item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    send_cv_.wait(lk, [&] {
+      return closed_ || capacity_ == 0 || buf_.size() < capacity_;
+    });
+    if (closed_) return false;
+    buf_.push_back(std::move(item));
+    recv_cv_.notify_one();
+    return true;
+  }
+
+  // Returns false when the channel is closed AND drained.
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !buf_.empty(); });
+    if (buf_.empty()) return false;
+    *out = std::move(buf_.front());
+    buf_.pop_front();
+    send_cv_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    send_cv_.notify_all();
+    recv_cv_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    buf_.clear();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return buf_.size();
+  }
+
+ private:
+  size_t capacity_;
+  bool closed_;
+  std::deque<T> buf_;
+  std::mutex mu_;
+  std::condition_variable send_cv_, recv_cv_;
+};
+
+}  // namespace pt

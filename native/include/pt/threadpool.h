@@ -1,0 +1,65 @@
+// Fixed-size worker pool.
+//
+// Reference parity: paddle/fluid/framework/threadpool.h (ThreadPool::Run)
+// — used here by the data-feed engine for parallel file parsing and async
+// batch assembly. Kept deliberately simple: futures via std::packaged_task.
+#pragma once
+
+#include <functional>
+#include <future>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace pt {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t n) : stop_(false) {
+    for (size_t i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> task;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+            if (stop_ && tasks_.empty()) return;
+            task = std::move(tasks_.front());
+            tasks_.pop();
+          }
+          task();
+        }
+      });
+    }
+  }
+
+  template <typename F>
+  std::future<void> Run(F&& f) {
+    auto task = std::make_shared<std::packaged_task<void()>>(std::forward<F>(f));
+    std::future<void> fut = task->get_future();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_.emplace([task] { (*task)(); });
+    }
+    cv_.notify_one();
+    return fut;
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_;
+};
+
+}  // namespace pt

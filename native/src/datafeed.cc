@@ -1,0 +1,298 @@
+// In-memory multi-slot data feed: parallel file parsing, global shuffle,
+// async fixed-shape batch assembly.
+//
+// Reference parity: paddle/fluid/framework/data_feed.h — `DataFeed` (:108),
+// `MultiSlotDataFeed` (:650), `MultiSlotInMemoryDataFeed` (:668) — plus the
+// in-memory sample store with shuffle of framework/data_set.h and the
+// double-buffered staging of operators/reader/buffered_reader.cc.
+//
+// TPU-first redesign rather than a port: the reference's samples are ragged
+// (LoD) and batches carry LoD offsets; XLA wants static shapes, so every
+// slot here has a FIXED per-sample dim and parsing right-pads/truncates to
+// it (the padding/bucketing policy SURVEY.md §7 "hard parts" calls for).
+// Batches are assembled into per-slot contiguous [batch, dim] host buffers
+// that Python wraps zero-copy as numpy and ships to device in one transfer.
+//
+// Text format, one sample per line:   slot0_v1,v2,...;slot1_v1,...;...
+// (slots ';'-separated in spec order, values ','-separated; int slots parse
+// as int64, float slots as float32).
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "pt/channel.h"
+#include "pt/threadpool.h"
+
+extern "C" void pt_stat_add(const char* name, long long v);
+
+namespace pt {
+
+enum class SlotType : int { kFloat32 = 0, kInt64 = 1 };
+
+struct SlotSpec {
+  std::string name;
+  SlotType type;
+  int dim;
+};
+
+// One sample: per-slot fixed-dim values, stored SoA-per-sample (small) —
+// float and int payloads in one buffer each to keep shuffle cheap (moves of
+// two vectors, no per-slot allocation churn).
+struct Sample {
+  std::vector<float> fvals;    // concatenated float slots, spec order
+  std::vector<int64_t> ivals;  // concatenated int slots, spec order
+};
+
+struct Batch {
+  int rows = 0;
+  std::vector<float> fdata;    // [rows * total_float_dim]
+  std::vector<int64_t> idata;  // [rows * total_int_dim]
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotSpec> slots, int batch_size, int capacity,
+           int num_threads)
+      : slots_(std::move(slots)),
+        batch_size_(batch_size),
+        queue_(capacity > 0 ? capacity : 8),
+        num_threads_(num_threads > 0 ? num_threads : 4) {
+    for (const auto& s : slots_) {
+      if (s.type == SlotType::kFloat32)
+        float_dim_ += s.dim;
+      else
+        int_dim_ += s.dim;
+    }
+  }
+
+  ~DataFeed() { Stop(); }
+
+  void SetFiles(std::vector<std::string> files) { files_ = std::move(files); }
+
+  // data_set.h LoadIntoMemory: parse all files in parallel into samples_.
+  int LoadIntoMemory() {
+    std::vector<std::vector<Sample>> shards(files_.size());
+    {
+      ThreadPool pool(num_threads_);
+      std::vector<std::future<void>> futs;
+      std::atomic<int> bad{0};
+      for (size_t i = 0; i < files_.size(); ++i) {
+        futs.push_back(pool.Run([this, i, &shards, &bad] {
+          if (!ParseFile(files_[i], &shards[i])) bad.fetch_add(1);
+        }));
+      }
+      for (auto& f : futs) f.wait();
+      if (bad.load()) return -1;
+    }
+    size_t total = samples_.size();
+    for (auto& sh : shards) total += sh.size();
+    samples_.reserve(total);
+    for (auto& sh : shards) {
+      for (auto& s : sh) samples_.push_back(std::move(s));
+    }
+    pt_stat_add("datafeed.samples_loaded",
+                static_cast<long long>(samples_.size()));
+    return static_cast<int>(samples_.size());
+  }
+
+  // data_set.h LocalShuffle (single-process scope of the reference's
+  // global shuffle; cross-host shuffle belongs to the Python sharding layer).
+  // Stops any in-flight epoch first: the assembler thread reads samples_.
+  void Shuffle(uint64_t seed) {
+    Stop();
+    std::mt19937_64 rng(seed);
+    for (size_t i = samples_.size(); i > 1; --i) {
+      std::swap(samples_[i - 1], samples_[rng() % i]);
+    }
+  }
+
+  int NumSamples() const { return static_cast<int>(samples_.size()); }
+  int FloatDim() const { return float_dim_; }
+  int IntDim() const { return int_dim_; }
+
+  // Launch the background assembler for one epoch (buffered_reader.cc
+  // double-buffering generalized to a bounded channel of ready batches).
+  void Start(int drop_last) {
+    Stop();
+    queue_.Reopen();
+    stop_requested_ = false;
+    worker_ = std::thread([this, drop_last] {
+      const size_t n = samples_.size();
+      size_t i = 0;
+      while (i < n && !stop_requested_) {
+        size_t rows = std::min<size_t>(batch_size_, n - i);
+        if (drop_last && rows < static_cast<size_t>(batch_size_)) break;
+        Batch b;
+        b.rows = static_cast<int>(rows);
+        b.fdata.resize(rows * float_dim_);
+        b.idata.resize(rows * int_dim_);
+        for (size_t r = 0; r < rows; ++r) {
+          const Sample& s = samples_[i + r];
+          if (float_dim_)
+            memcpy(b.fdata.data() + r * float_dim_, s.fvals.data(),
+                   float_dim_ * sizeof(float));
+          if (int_dim_)
+            memcpy(b.idata.data() + r * int_dim_, s.ivals.data(),
+                   int_dim_ * sizeof(int64_t));
+        }
+        i += rows;
+        pt_stat_add("datafeed.batches_produced", 1);
+        if (!queue_.Put(std::move(b))) return;
+      }
+      queue_.Close();
+    });
+    started_ = true;
+  }
+
+  // Copy next batch into caller buffers ([batch, total_dim] each, already
+  // allocated at full batch_size). Returns rows, or 0 at epoch end.
+  int Next(float* fbuf, int64_t* ibuf) {
+    Batch b;
+    if (!queue_.Get(&b)) return 0;
+    if (fbuf && float_dim_)
+      memcpy(fbuf, b.fdata.data(), b.fdata.size() * sizeof(float));
+    if (ibuf && int_dim_)
+      memcpy(ibuf, b.idata.data(), b.idata.size() * sizeof(int64_t));
+    return b.rows;
+  }
+
+  void ReleaseMemory() {
+    Stop();  // assembler thread memcpys out of samples_
+    samples_.clear();
+    samples_.shrink_to_fit();
+  }
+
+ private:
+  void Stop() {
+    if (started_) {
+      stop_requested_ = true;
+      queue_.Close();
+      if (worker_.joinable()) worker_.join();
+      started_ = false;
+    }
+  }
+
+  bool ParseFile(const std::string& path, std::vector<Sample>* out) {
+    std::ifstream in(path);
+    if (!in.good()) return false;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      Sample s;
+      s.fvals.assign(float_dim_, 0.0f);
+      s.ivals.assign(int_dim_, 0);
+      size_t pos = 0;
+      int foff = 0, ioff = 0;
+      for (const auto& slot : slots_) {
+        size_t end = line.find(';', pos);
+        std::string field = line.substr(
+            pos, end == std::string::npos ? std::string::npos : end - pos);
+        pos = end == std::string::npos ? line.size() : end + 1;
+        // pad-or-truncate to slot.dim (static-shape policy)
+        const char* p = field.c_str();
+        char* q = nullptr;
+        for (int k = 0; k < slot.dim && *p; ++k) {
+          if (slot.type == SlotType::kFloat32) {
+            s.fvals[foff + k] = strtof(p, &q);
+          } else {
+            s.ivals[ioff + k] = strtoll(p, &q, 10);
+          }
+          if (q == p) break;
+          p = (*q == ',') ? q + 1 : q;
+        }
+        if (slot.type == SlotType::kFloat32)
+          foff += slot.dim;
+        else
+          ioff += slot.dim;
+      }
+      out->push_back(std::move(s));
+    }
+    return true;
+  }
+
+  std::vector<SlotSpec> slots_;
+  int batch_size_;
+  int float_dim_ = 0, int_dim_ = 0;
+  Channel<Batch> queue_;
+  int num_threads_;
+  std::vector<std::string> files_;
+  std::vector<Sample> samples_;
+  std::thread worker_;
+  std::atomic<bool> stop_requested_{false};
+  bool started_ = false;
+};
+
+// slot_spec: "name:f:dim;name:i:dim;..."
+static std::vector<SlotSpec> ParseSpec(const char* spec) {
+  std::vector<SlotSpec> out;
+  std::stringstream ss(spec);
+  std::string item;
+  while (std::getline(ss, item, ';')) {
+    if (item.empty()) continue;
+    size_t c1 = item.find(':'), c2 = item.find(':', c1 + 1);
+    SlotSpec s;
+    s.name = item.substr(0, c1);
+    s.type = item[c1 + 1] == 'i' ? SlotType::kInt64 : SlotType::kFloat32;
+    s.dim = atoi(item.c_str() + c2 + 1);
+    if (s.dim <= 0) s.dim = 1;
+    out.push_back(std::move(s));
+  }
+  return out;
+}
+
+}  // namespace pt
+
+extern "C" {
+
+void* pt_feed_create(const char* slot_spec, int batch_size, int capacity,
+                     int num_threads) {
+  auto slots = pt::ParseSpec(slot_spec);
+  if (slots.empty() || batch_size <= 0) return nullptr;
+  return new pt::DataFeed(std::move(slots), batch_size, capacity, num_threads);
+}
+
+void pt_feed_set_files(void* h, const char* files) {
+  std::vector<std::string> fs;
+  std::stringstream ss(files);
+  std::string f;
+  while (std::getline(ss, f, ';'))
+    if (!f.empty()) fs.push_back(f);
+  static_cast<pt::DataFeed*>(h)->SetFiles(std::move(fs));
+}
+
+int pt_feed_load_into_memory(void* h) {
+  return static_cast<pt::DataFeed*>(h)->LoadIntoMemory();
+}
+void pt_feed_shuffle(void* h, unsigned long long seed) {
+  static_cast<pt::DataFeed*>(h)->Shuffle(seed);
+}
+int pt_feed_num_samples(void* h) {
+  return static_cast<pt::DataFeed*>(h)->NumSamples();
+}
+int pt_feed_float_dim(void* h) {
+  return static_cast<pt::DataFeed*>(h)->FloatDim();
+}
+int pt_feed_int_dim(void* h) {
+  return static_cast<pt::DataFeed*>(h)->IntDim();
+}
+void pt_feed_start(void* h, int drop_last) {
+  static_cast<pt::DataFeed*>(h)->Start(drop_last);
+}
+int pt_feed_next(void* h, float* fbuf, long long* ibuf) {
+  return static_cast<pt::DataFeed*>(h)->Next(
+      fbuf, reinterpret_cast<int64_t*>(ibuf));
+}
+void pt_feed_release_memory(void* h) {
+  static_cast<pt::DataFeed*>(h)->ReleaseMemory();
+}
+void pt_feed_destroy(void* h) { delete static_cast<pt::DataFeed*>(h); }
+
+}  // extern "C"

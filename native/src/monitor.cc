@@ -1,0 +1,84 @@
+// Runtime stats monitor: named int64 gauges.
+//
+// Reference parity: paddle/fluid/platform/monitor.h — `StatValue` (:43) and
+// `StatRegistry` (:84), the STAT_ADD/STAT_RESET macros used by gpu_info.cc
+// and data_feed.cc. Rebuilt as a process-wide registry with a C ABI so both
+// the Python layer and native subsystems (datafeed) publish into one place.
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace pt {
+
+class StatRegistry {
+ public:
+  static StatRegistry& Instance() {
+    static StatRegistry r;
+    return r;
+  }
+
+  void Add(const std::string& name, long long v) {
+    Slot(name)->fetch_add(v, std::memory_order_relaxed);
+  }
+  void Set(const std::string& name, long long v) {
+    Slot(name)->store(v, std::memory_order_relaxed);
+  }
+  long long Get(const std::string& name) {
+    return Slot(name)->load(std::memory_order_relaxed);
+  }
+  void Reset(const std::string& name) { Slot(name)->store(0); }
+
+  std::string List() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out;
+    for (auto& kv : stats_) {
+      out += kv.first + "=" + std::to_string(kv.second->load()) + "\n";
+    }
+    return out;
+  }
+
+ private:
+  std::atomic<long long>* Slot(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = stats_.find(name);
+    if (it == stats_.end()) {
+      it = stats_.emplace(name, new std::atomic<long long>(0)).first;
+    }
+    return it->second;
+  }
+  std::mutex mu_;
+  std::map<std::string, std::atomic<long long>*> stats_;
+};
+
+}  // namespace pt
+
+extern "C" {
+
+void pt_stat_add(const char* name, long long v) {
+  pt::StatRegistry::Instance().Add(name, v);
+}
+void pt_stat_set(const char* name, long long v) {
+  pt::StatRegistry::Instance().Set(name, v);
+}
+long long pt_stat_get(const char* name) {
+  return pt::StatRegistry::Instance().Get(name);
+}
+void pt_stat_reset(const char* name) {
+  pt::StatRegistry::Instance().Reset(name);
+}
+// Writes "name=value\n" lines into buf; returns bytes needed (caller may
+// retry with a bigger buffer).
+int pt_stat_list(char* buf, int buflen) {
+  std::string s = pt::StatRegistry::Instance().List();
+  int need = static_cast<int>(s.size());
+  if (buf && buflen > 0) {
+    int n = need < buflen - 1 ? need : buflen - 1;
+    memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+}  // extern "C"

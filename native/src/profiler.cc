@@ -1,0 +1,200 @@
+// Host-side scoped-event profiler with chrome-trace export.
+//
+// Reference parity: paddle/fluid/platform/profiler.h — `RecordEvent` RAII
+// markers (:126), `EnableProfiler`/`DisableProfiler` (:208/:211), the
+// aggregated event table of profiler_helper.h, and tools/timeline.py's
+// chrome://tracing conversion. The CUPTI device tracer (device_tracer.h:19)
+// has no TPU analogue here — device-side traces come from jax.profiler/XLA
+// (SURVEY.md §5.1 TPU mapping); this records the host/framework side and can
+// be merged with an XLA trace by the Python bridge.
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace pt {
+
+static inline long long NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Event {
+  std::string name;
+  long long start_ns;
+  long long end_ns;
+  unsigned long long tid;
+};
+
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+class Profiler {
+ public:
+  static Profiler& Instance() {
+    static Profiler p;
+    return p;
+  }
+
+  void Enable() {
+    std::lock_guard<std::mutex> lk(mu_);
+    enabled_ = true;
+  }
+  void Disable() {
+    std::lock_guard<std::mutex> lk(mu_);
+    enabled_ = false;
+  }
+  bool Enabled() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return enabled_;
+  }
+  void Clear() {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.clear();
+  }
+
+  void Push(const char* name) {
+    if (!Enabled()) return;
+    Stack().push_back({name, NowNs()});
+  }
+
+  // Pops regardless of enabled-state (a disable between push and pop must
+  // not strand the open entry on the stack); only records while enabled.
+  void Pop() {
+    auto& st = Stack();
+    if (st.empty()) return;
+    auto open = st.back();
+    st.pop_back();
+    if (!Enabled()) return;
+    Event e{std::move(open.first), open.second, NowNs(),
+            std::hash<std::thread::id>{}(std::this_thread::get_id())};
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(std::move(e));
+  }
+
+  // One complete event straight from the caller (used for externally timed
+  // spans, e.g. XLA executable runs surfaced from Python).
+  void AddSpan(const char* name, long long start_ns, long long end_ns) {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(Event{
+        name, start_ns, end_ns,
+        std::hash<std::thread::id>{}(std::this_thread::get_id())});
+  }
+
+  // chrome://tracing "traceEvents" JSON (ph:X complete events, us units).
+  int ExportChrome(const char* path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = fopen(path, "w");
+    if (!f) return -1;
+    fputs("{\"traceEvents\":[", f);
+    for (size_t i = 0; i < events_.size(); ++i) {
+      const Event& e = events_[i];
+      fprintf(f,
+              "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+              "\"ts\":%.3f,\"dur\":%.3f}",
+              i ? "," : "", JsonEscape(e.name).c_str(), e.tid,
+              e.start_ns / 1000.0, (e.end_ns - e.start_ns) / 1000.0);
+    }
+    fputs("]}", f);
+    fclose(f);
+    return static_cast<int>(events_.size());
+  }
+
+  // Aggregated text table sorted by total time (profiler_helper.h style).
+  std::string Summary() {
+    std::lock_guard<std::mutex> lk(mu_);
+    struct Agg {
+      long long total = 0, mn = 0, mx = 0;
+      long long calls = 0;
+    };
+    std::map<std::string, Agg> agg;
+    for (const auto& e : events_) {
+      auto& a = agg[e.name];
+      long long d = e.end_ns - e.start_ns;
+      a.total += d;
+      a.mn = a.calls ? std::min(a.mn, d) : d;
+      a.mx = std::max(a.mx, d);
+      a.calls++;
+    }
+    std::vector<std::pair<std::string, Agg>> rows(agg.begin(), agg.end());
+    std::sort(rows.begin(), rows.end(), [](const auto& a, const auto& b) {
+      return a.second.total > b.second.total;
+    });
+    char line[512];
+    std::string out =
+        "Event                            Calls    Total(ms)    Avg(ms)    "
+        "Min(ms)    Max(ms)\n";
+    for (const auto& r : rows) {
+      snprintf(line, sizeof(line), "%-32s %6lld %12.3f %10.3f %10.3f %10.3f\n",
+               r.first.c_str(), r.second.calls, r.second.total / 1e6,
+               r.second.total / 1e6 / r.second.calls, r.second.mn / 1e6,
+               r.second.mx / 1e6);
+      out += line;
+    }
+    return out;
+  }
+
+ private:
+  static std::vector<std::pair<std::string, long long>>& Stack() {
+    thread_local std::vector<std::pair<std::string, long long>> st;
+    return st;
+  }
+  bool enabled_ = false;
+  std::vector<Event> events_;
+  std::mutex mu_;
+};
+
+}  // namespace pt
+
+extern "C" {
+
+void pt_prof_enable() { pt::Profiler::Instance().Enable(); }
+void pt_prof_disable() { pt::Profiler::Instance().Disable(); }
+int pt_prof_enabled() { return pt::Profiler::Instance().Enabled() ? 1 : 0; }
+void pt_prof_clear() { pt::Profiler::Instance().Clear(); }
+void pt_prof_push(const char* name) { pt::Profiler::Instance().Push(name); }
+void pt_prof_pop() { pt::Profiler::Instance().Pop(); }
+void pt_prof_add_span(const char* name, long long start_ns, long long end_ns) {
+  pt::Profiler::Instance().AddSpan(name, start_ns, end_ns);
+}
+int pt_prof_export_chrome(const char* path) {
+  return pt::Profiler::Instance().ExportChrome(path);
+}
+int pt_prof_summary(char* buf, int buflen) {
+  std::string s = pt::Profiler::Instance().Summary();
+  int need = static_cast<int>(s.size());
+  if (buf && buflen > 0) {
+    int n = need < buflen - 1 ? need : buflen - 1;
+    memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+}  // extern "C"

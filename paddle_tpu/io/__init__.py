@@ -11,6 +11,7 @@ from .dataset import (
     TensorDataset,
     random_split,
 )
+from .multislot import DatasetFactory, InMemoryDataset, QueueDataset
 from .sampler import (
     BatchSampler,
     DistributedBatchSampler,

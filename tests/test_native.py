@@ -1,0 +1,201 @@
+"""Native runtime layer: monitor stats, profiler, multi-slot datafeed.
+
+Mirrors the reference's C++-side coverage of monitor/profiler/data_feed
+(e.g. fluid/tests framework data_feed tests + platform profiler tests) from
+Python through the ctypes bridge, plus the pure-Python fallback path.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.io import DatasetFactory, InMemoryDataset, QueueDataset
+from paddle_tpu.io.multislot import _PySlotFeed
+
+
+def _write_data(tmp_path, n_files=2, rows_per_file=25):
+    files = []
+    k = 0
+    for i in range(n_files):
+        p = tmp_path / f"part-{i}.txt"
+        with open(p, "w") as f:
+            for _ in range(rows_per_file):
+                # x: 4 floats; label: 1 int — x values encode the sample id
+                f.write(f"{k},{k + 0.5},{k + 0.25},{k + 0.75};{k % 10}\n")
+                k += 1
+        files.append(str(p))
+    return files, k
+
+
+SLOTS = [("x", "float32", 4), ("label", "int64", 1)]
+
+
+def test_native_available():
+    # g++ is baked into the image; the library must build.
+    assert native.available()
+
+
+def test_stats_roundtrip():
+    native.stat_reset("test.counter")
+    native.stat_add("test.counter", 3)
+    native.stat_add("test.counter", 4)
+    assert native.stat_get("test.counter") == 7
+    native.stat_set("test.counter", 100)
+    assert native.stat_get("test.counter") == 100
+    assert native.stat_list().get("test.counter") == 100
+
+
+def test_profiler_events_and_chrome_export(tmp_path):
+    native.prof_clear()
+    native.prof_enable()
+    native.prof_push("outer")
+    native.prof_push("inner")
+    native.prof_pop()
+    native.prof_pop()
+    native.prof_add_span("external", 1000, 2000)
+    native.prof_disable()
+    path = str(tmp_path / "trace.json")
+    n = native.prof_export_chrome(path)
+    assert n == 3
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert names == {"outer", "inner", "external"}
+    summary = native.prof_summary()
+    assert "outer" in summary and "Calls" in summary
+
+
+def test_inmemory_dataset_batches(tmp_path):
+    files, total = _write_data(tmp_path)
+    ds = InMemoryDataset()
+    ds.set_use_var(SLOTS)
+    ds.set_batch_size(8)
+    ds.set_filelist(files)
+    assert ds.load_into_memory() == total
+    assert ds.get_memory_data_size() == total
+
+    seen = 0
+    for batch in ds:
+        assert set(batch) == {"x", "label"}
+        assert batch["x"].dtype == np.float32 and batch["x"].shape[1] == 4
+        assert batch["label"].dtype == np.int64 and batch["label"].shape[1] == 1
+        # per-row consistency: label == floor(x[0]) % 10
+        ids = batch["x"][:, 0].astype(np.int64)
+        np.testing.assert_array_equal(batch["label"][:, 0], ids % 10)
+        np.testing.assert_allclose(batch["x"][:, 1], ids + 0.5)
+        seen += batch["x"].shape[0]
+    assert seen == total
+
+
+def test_inmemory_shuffle_is_permutation(tmp_path):
+    files, total = _write_data(tmp_path, n_files=1, rows_per_file=40)
+    ds = InMemoryDataset()
+    ds.set_use_var(SLOTS)
+    ds.set_batch_size(40)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    before = next(iter(ds))["x"][:, 0].copy()
+    ds.local_shuffle(seed=123)
+    after = next(iter(ds))["x"][:, 0].copy()
+    assert sorted(before.tolist()) == sorted(after.tolist())
+    assert not np.array_equal(before, after)
+
+
+def test_queue_dataset_streams_and_rejects_shuffle(tmp_path):
+    files, total = _write_data(tmp_path, n_files=1, rows_per_file=10)
+    factory = DatasetFactory()
+    ds = factory.create_dataset("QueueDataset")
+    ds.set_use_var(SLOTS)
+    ds.set_batch_size(4)
+    ds.set_filelist(files)
+    with pytest.raises(RuntimeError):
+        ds.local_shuffle()
+    rows = sum(b["x"].shape[0] for b in ds)
+    assert rows == total
+    # second epoch re-streams
+    rows2 = sum(b["x"].shape[0] for b in ds)
+    assert rows2 == total
+
+
+def test_python_fallback_matches_native(tmp_path):
+    files, total = _write_data(tmp_path, n_files=1, rows_per_file=12)
+    py = _PySlotFeed(SLOTS, batch_size=5)
+    py.set_filelist(files)
+    assert py.load_into_memory() == total
+    py_batches = list(py)
+
+    nat = native.NativeDataFeed(SLOTS, batch_size=5)
+    nat.set_filelist(files)
+    nat.load_into_memory()
+    nat_batches = list(nat)
+
+    assert len(py_batches) == len(nat_batches)
+    for pb, nb in zip(py_batches, nat_batches):
+        np.testing.assert_allclose(pb["x"], nb["x"])
+        np.testing.assert_array_equal(pb["label"], nb["label"])
+
+
+def test_second_iterator_invalidates_first(tmp_path):
+    files, _ = _write_data(tmp_path, n_files=1, rows_per_file=20)
+    feed = native.NativeDataFeed(SLOTS, batch_size=4)
+    feed.set_filelist(files)
+    feed.load_into_memory()
+    it1 = iter(feed)
+    next(it1)
+    it2 = iter(feed)  # restarts the epoch
+    next(it2)
+    with pytest.raises(RuntimeError, match="new epoch"):
+        next(it1)
+
+
+def test_setters_locked_after_build(tmp_path):
+    files, _ = _write_data(tmp_path, n_files=1, rows_per_file=5)
+    ds = InMemoryDataset()
+    ds.set_use_var(SLOTS)
+    ds.set_batch_size(2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    with pytest.raises(RuntimeError):
+        ds.set_batch_size(8)
+    with pytest.raises(ValueError):
+        InMemoryDataset().set_use_var([("bad;name", "float32", 1)])
+
+
+def test_break_midepoch_then_release(tmp_path):
+    # regression: releasing memory while the assembler thread streams must
+    # not crash (worker is stopped first)
+    files, _ = _write_data(tmp_path, n_files=1, rows_per_file=50)
+    feed = native.NativeDataFeed(SLOTS, batch_size=2, capacity=2)
+    feed.set_filelist(files)
+    feed.load_into_memory()
+    for _ in feed:
+        break
+    feed.release_memory()
+    assert feed.num_samples == 0
+
+
+def test_profiler_name_escaping(tmp_path):
+    native.prof_clear()
+    native.prof_enable()
+    native.prof_push('quoted "name" \\ with\nnewline')
+    native.prof_pop()
+    native.prof_disable()
+    path = str(tmp_path / "esc.json")
+    assert native.prof_export_chrome(path) == 1
+    with open(path) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"][0]["name"] == 'quoted "name" \\ with\nnewline'
+
+
+def test_short_rows_padded(tmp_path):
+    p = tmp_path / "short.txt"
+    # only 2 of 4 x-values present -> right-padded with zeros
+    p.write_text("1.0,2.0;7\n")
+    feed = native.NativeDataFeed(SLOTS, batch_size=1)
+    feed.set_filelist([str(p)])
+    feed.load_into_memory()
+    (batch,) = list(feed)
+    np.testing.assert_allclose(batch["x"][0], [1.0, 2.0, 0.0, 0.0])
+    assert batch["label"][0, 0] == 7
