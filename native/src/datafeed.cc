@@ -79,6 +79,7 @@ class DataFeed {
 
   // data_set.h LoadIntoMemory: parse all files in parallel into samples_.
   int LoadIntoMemory() {
+    Stop();  // a running assembler reads samples_; appending may reallocate
     std::vector<std::vector<Sample>> shards(files_.size());
     {
       ThreadPool pool(num_threads_);
@@ -242,7 +243,7 @@ static std::vector<SlotSpec> ParseSpec(const char* spec) {
     s.name = item.substr(0, c1);
     s.type = item[c1 + 1] == 'i' ? SlotType::kInt64 : SlotType::kFloat32;
     s.dim = atoi(item.c_str() + c2 + 1);
-    if (s.dim <= 0) s.dim = 1;
+    if (s.dim <= 0) return {};  // invalid spec — creation fails loudly
     out.push_back(std::move(s));
   }
   return out;

@@ -64,6 +64,8 @@ _LAZY_SUBMODULES = (
     "parallel",
     "static",
     "io",
+    "jit",
+    "inference",
     "hapi",
     "metric",
     "vision",

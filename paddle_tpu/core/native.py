@@ -224,10 +224,15 @@ def prof_summary() -> str:
     lib = get_lib()
     if lib is None:
         return ""
+    # Same grow-and-retry as stat_list: events can land between the size
+    # query and the fill.
     need = lib.pt_prof_summary(None, 0)
-    buf = ctypes.create_string_buffer(need + 1)
-    lib.pt_prof_summary(buf, need + 1)
-    return buf.value.decode()
+    while True:
+        buf = ctypes.create_string_buffer(need + 256)
+        got = lib.pt_prof_summary(buf, need + 256)
+        if got <= need + 255:
+            return buf.value.decode()
+        need = got
 
 
 # --------------------------------------------------------------- datafeed --
@@ -247,9 +252,11 @@ class NativeDataFeed:
             raise RuntimeError("native runtime unavailable (g++/make build failed)")
         self._lib = lib
         self.slots = [(str(n), str(t), int(d)) for n, t, d in slots]
-        for n, _, _ in self.slots:
+        for n, _, d in self.slots:
             if ";" in n or ":" in n:
                 raise ValueError(f"slot name {n!r} may not contain ';' or ':'")
+            if d <= 0:
+                raise ValueError(f"slot {n!r} dim must be positive, got {d}")
         self.batch_size = int(batch_size)
         self._epoch_gen = 0
         spec = ";".join(

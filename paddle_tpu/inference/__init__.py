@@ -1,0 +1,200 @@
+"""paddle_tpu.inference — the deployment/serving path.
+
+Reference parity: paddle/fluid/inference/ — `AnalysisConfig`
+(api/analysis_config.cc switches), `AnalysisPredictor`
+(api/analysis_predictor.h:82, `CreatePaddlePredictor` :62, `ZeroCopyRun`
+:165) executed by `NaiveExecutor`, and the 2.0 `paddle.inference`
+Config/create_predictor/Tensor-handle API.
+
+TPU-native design: the reference's analysis pipeline (IR fusion passes, TRT
+subgraph capture, memory-optimize) is what XLA does during AOT compilation —
+so the predictor loads a `jit.save` StableHLO artifact and **AOT-compiles it
+once** (`jax.jit(...).lower(...).compile()`); there is no pass manager to
+re-implement (SURVEY.md §7 design stance).  Zero-copy semantics: input
+handles stage host numpy; outputs are device arrays exposed to numpy without
+extra copies on CPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import jit as _jit
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """ref AnalysisConfig: model path + execution switches.
+
+    `prog_file`-style split files collapse to the single `jit.save` prefix.
+    GPU/IR switches that have no TPU meaning are accepted and recorded so
+    reference scripts run unchanged, but act as no-ops (XLA already fuses
+    and plans memory).
+    """
+
+    def __init__(self, model_prefix: Optional[str] = None):
+        self.model_prefix = model_prefix
+        self._device = "default"  # default: whatever jax.devices()[0] is
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._profile = False
+        self._math_threads = 1
+        self.switches: Dict[str, Any] = {}
+
+    # --- model location (ref set_model / set_prog_file) ---
+    def set_model(self, prefix: str, params_file: Optional[str] = None):
+        self.model_prefix = prefix
+
+    # --- device selection (ref enable_use_gpu / disable_gpu) ---
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_tpu(self):
+        self._device = "cpu"
+
+    # GPU-era aliases kept for script parity: map onto the accelerator.
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "tpu"
+
+    # --- precision / perf switches ---
+    def set_precision(self, precision: str):
+        self._precision = precision
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_profile(self):
+        self._profile = True
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._math_threads = int(n)
+
+    def switch_ir_optim(self, flag: bool = True):
+        self.switches["ir_optim"] = flag  # XLA always optimizes; recorded only
+
+    def switch_use_feed_fetch_ops(self, flag: bool):
+        self.switches["feed_fetch_ops"] = flag
+
+    def device(self):
+        if self._device == "cpu":
+            cpus = [d for d in jax.devices("cpu")] if jax.default_backend() != "cpu" \
+                else jax.devices()
+            return cpus[0]
+        return jax.devices()[0]
+
+
+class Tensor:
+    """IO handle (ref ZeroCopyTensor / paddle.inference.Tensor):
+    copy_from_cpu stages the input; copy_to_cpu returns numpy."""
+
+    def __init__(self, name: str, spec):
+        self.name = name
+        self._spec = spec
+        self._value: Optional[np.ndarray] = None
+
+    # input side
+    def reshape(self, shape):
+        pass  # shapes are fixed by the exported artifact (static shapes)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        data = np.asarray(data)
+        want = tuple(self._spec.shape)
+        if tuple(data.shape) != want:
+            raise ValueError(
+                f"input {self.name!r} expects shape {want}, got {data.shape} "
+                "(exported models have static shapes; re-export with the "
+                "serving shape or pad/bucket the batch)")
+        self._value = data
+
+    # output side
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        return tuple(self._spec.shape) if self._value is None else self._value.shape
+
+
+class Predictor:
+    """ref AnalysisPredictor over NaiveExecutor: pre-compiled executable,
+    named IO handles, run() with no per-call allocation decisions."""
+
+    def __init__(self, config: Config):
+        if not config.model_prefix:
+            raise ValueError("Config.model_prefix not set")
+        self.config = config
+        self._model = _jit.load(config.model_prefix)
+        specs = self._model.input_specs
+        self._input_names = [s.name or f"x{i}" for i, s in enumerate(specs)]
+        self._inputs = {n: Tensor(n, s) for n, s in zip(self._input_names, specs)}
+        self._device = config.device()
+        self._compiled = self._model._compiled  # TranslatedLayer's jitted call
+        self._outputs: List[Tensor] = []
+        self._output_names: List[str] = []
+
+    # --- reference API surface ---
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun: executes the AOT-compiled artifact.  Either set
+        inputs via handles first, or pass them positionally (2.0 style
+        `predictor.run([x, y])`)."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model expects "
+                    f"{len(self._input_names)}: {self._input_names}")
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = []
+        for n in self._input_names:
+            v = self._inputs[n]._value
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set; call "
+                                   "get_input_handle(name).copy_from_cpu(...)")
+            args.append(jax.device_put(v, self._device))
+        out = self._compiled(*args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._output_names = [f"out{i}" for i in range(len(leaves))]
+        self._outputs = []
+        for n, leaf in zip(self._output_names, leaves):
+            t = Tensor(n, jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+            t._value = leaf
+            self._outputs.append(t)
+        return [np.asarray(l) for l in leaves]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """ref CreatePaddlePredictor factory (analysis_predictor.h:62)."""
+    return Predictor(config)

@@ -124,9 +124,11 @@ class InMemoryDataset:
 
     def set_use_var(self, slots: Sequence[Tuple[str, str, int]]) -> None:
         self._check_not_built("set_use_var")
-        for n, _, _ in slots:
+        for n, _, d in slots:
             if ";" in str(n) or ":" in str(n):
                 raise ValueError(f"slot name {n!r} may not contain ';' or ':'")
+            if int(d) <= 0:
+                raise ValueError(f"slot {n!r} dim must be positive, got {d}")
         self._slots = [(n, t, int(d)) for n, t, d in slots]
 
     def set_batch_size(self, batch_size: int) -> None:

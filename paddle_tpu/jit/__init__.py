@@ -1,0 +1,294 @@
+"""paddle_tpu.jit — dygraph-to-static, traced layers, and model export.
+
+Reference parity: the dygraph_to_static subsystem — `@declarative`/
+`paddle.jit.to_static` (fluid/dygraph/jit.py:155, program_translator.py:667),
+`TracedLayer` (dygraph/jit.py), and `paddle.jit.save`/`load` which emit the
+inference-model format consumed by AnalysisPredictor (SURVEY.md §1 L4, L5).
+
+TPU-native design: the reference needs a 400-file AST-transformer pipeline
+because its imperative mode executes op-by-op; here dygraph code *is already
+traceable* — `to_static` is jax.jit over a functional capture of the Layer
+(params lifted to arguments), with per-signature executable caching.  Export
+is `jax.export`: the traced forward is lowered to StableHLO and serialized;
+`load` deserializes to an executable artifact that runs without the original
+Python class — the same role ProgramDesc+save_inference_model plays in the
+reference, but carried by XLA's stable IR instead of a custom proto.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import _swapped, buffers_dict, parameters_dict
+from ..nn.layer.base import Layer
+
+__all__ = ["InputSpec", "to_static", "not_to_static", "TracedLayer",
+           "TranslatedLayer", "save", "load"]
+
+_FORMAT_VERSION = 1
+_MODEL_SUFFIX = ".pdmodel"     # serialized jax.export artifact (StableHLO)
+_PARAMS_SUFFIX = ".pdiparams"  # npz state dict (reference suffix parity)
+_META_SUFFIX = ".pdmeta.json"
+
+
+class InputSpec:
+    """Shape/dtype signature of one input (ref paddle.static.InputSpec).
+
+    `None` dims mean "any" for to_static's cache key; export requires all
+    dims concrete (XLA static shapes — SURVEY.md §7 hard parts)."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype: Any = "float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name: Optional[str] = None) -> "InputSpec":
+        return cls(t.shape, t.dtype, name)
+
+    def to_sds(self) -> jax.ShapeDtypeStruct:
+        if any(d is None for d in self.shape):
+            raise ValueError(
+                f"InputSpec {self.name or ''} has unknown dims {self.shape}; "
+                "export needs concrete shapes")
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _canon(x):
+    return x if isinstance(x, (jax.Array, np.ndarray)) else np.asarray(x)
+
+
+class StaticFunction:
+    """The object `to_static` returns (ref program_translator.py
+    StaticFunction): callable with per-signature compiled-program caching."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec: Optional[Sequence[InputSpec]] = None):
+        self._fn = fn
+        self._layer = layer
+        self.input_spec = list(input_spec) if input_spec else None
+        self._cache: Dict[tuple, Callable] = {}
+        self._last_args: Optional[Tuple] = None
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _functional(self):
+        if self._layer is None:
+            return jax.jit(self._fn)
+        # Call the ORIGINAL forward (self._fn), not layer(*args): to_static
+        # on a Layer rebinds layer.forward to this StaticFunction, so going
+        # back through Layer.__call__ would recurse.
+        layer, fn = self._layer, self._fn
+
+        def pure(params, buffers, *args):
+            with _swapped(layer, params, dict(buffers)):
+                return fn(*args)
+
+        return jax.jit(pure)
+
+    def __call__(self, *args):
+        args = tuple(_canon(a) for a in args)
+        self._last_args = args
+        key = tuple((tuple(a.shape), str(jnp.asarray(a).dtype)) for a in args)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._functional()
+            self._cache[key] = compiled
+        if self._layer is None:
+            return compiled(*args)
+        return compiled(parameters_dict(self._layer, trainable_only=False),
+                        buffers_dict(self._layer), *args)
+
+    # -- export support -----------------------------------------------------
+    def _example_sds(self) -> List[jax.ShapeDtypeStruct]:
+        if self.input_spec:
+            return [s.to_sds() for s in self.input_spec]
+        if self._last_args is not None:
+            return [jax.ShapeDtypeStruct(a.shape, jnp.asarray(a).dtype)
+                    for a in self._last_args]
+        raise ValueError(
+            "cannot export: pass input_spec or call the function once first")
+
+
+def to_static(function=None, input_spec: Optional[Sequence[InputSpec]] = None,
+              **kwargs):
+    """Decorator/wrapper converting dygraph code to a compiled static function
+    (ref @to_static jit.py:155). Accepts a function, a bound Layer method, or
+    a Layer (wraps its forward)."""
+
+    def wrap(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = sf
+            return obj
+        layer = getattr(obj, "__self__", None)
+        if isinstance(layer, Layer):
+            return StaticFunction(obj.__func__.__get__(layer), layer=layer,
+                                  input_spec=input_spec)
+        return StaticFunction(obj, input_spec=input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    """ref paddle.jit.not_to_static — marker excluding a function from
+    conversion; conversion here is whole-trace jit, so it is an identity
+    marker kept for API parity."""
+    fn.__pdtpu_not_to_static__ = True
+    return fn
+
+
+# --------------------------------------------------------------- save/load --
+def _export_artifact(fn: Callable, sds_list: List[jax.ShapeDtypeStruct]):
+    exp = jax.export.export(jax.jit(fn))(*sds_list)
+    return exp
+
+
+def save(obj, path: str, input_spec: Optional[Sequence[InputSpec]] = None):
+    """Serialize a Layer / to_static function to `path{.pdmodel,.pdiparams,
+    .pdmeta.json}` (ref paddle.jit.save → __model__ + params files).
+
+    The .pdmodel artifact has parameters **baked in as constants** and runs
+    standalone (inference); .pdiparams keeps the state_dict for reload into
+    Python (fine-tuning path).
+    """
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    if isinstance(obj, Layer):
+        layer = obj
+        sf = obj.forward if isinstance(obj.forward, StaticFunction) else None
+        raw_forward = sf._fn if sf is not None else obj.forward
+    elif isinstance(obj, StaticFunction):
+        sf, layer = obj, obj.layer
+        raw_forward = obj._fn
+    else:
+        raise TypeError(f"jit.save expects a Layer or to_static function, got {type(obj)}")
+    # Always export through the original forward — a to_static-rebound
+    # layer.forward would re-enter the compiled path mid-trace.  Parameters
+    # are read as concrete arrays and baked into the artifact as constants.
+    fn = (lambda *a: raw_forward(*a))
+
+    if input_spec is not None:
+        specs = [s if isinstance(s, InputSpec) else
+                 InputSpec(tuple(s.shape), s.dtype, getattr(s, "name", None))
+                 for s in input_spec]
+        sds = [s.to_sds() for s in specs]
+    elif sf is not None:
+        sds = sf._example_sds()
+        specs = [InputSpec(s.shape, s.dtype) for s in sds]
+    else:
+        raise ValueError("pass input_spec (layer has no recorded example call)")
+
+    was_training = getattr(layer, "training", False)
+    if layer is not None and was_training:
+        layer.eval()  # export inference behavior (no dropout etc.)
+    try:
+        exp = _export_artifact(fn, sds)
+    finally:
+        if layer is not None and was_training:
+            layer.train()
+
+    with open(path + _MODEL_SUFFIX, "wb") as f:
+        f.write(exp.serialize())
+
+    state: Dict[str, np.ndarray] = {}
+    if layer is not None:
+        for k, v in layer.state_dict().items():
+            state[k] = np.asarray(getattr(v, "value", v))
+    np.savez(path + _PARAMS_SUFFIX, **state)
+
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "inputs": [{"shape": list(s.shape), "dtype": str(np.dtype(s.dtype)),
+                    "name": s.name} for s in specs],
+        "param_names": sorted(state),
+        "platforms": list(exp.platforms),
+    }
+    with open(path + _META_SUFFIX, "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+class TranslatedLayer:
+    """Loaded model (ref TranslatedLayer of paddle.jit.load): an executable
+    artifact + the saved state_dict.  Callable for inference; the compiled
+    path is the deserialized StableHLO module under jit."""
+
+    def __init__(self, exported, meta: Dict, state: Dict[str, np.ndarray]):
+        self._exported = exported
+        self._meta = meta
+        self._state = state
+        self._compiled = jax.jit(exported.call)
+
+    def __call__(self, *args):
+        return self._compiled(*[_canon(a) for a in args])
+
+    forward = __call__
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return dict(self._state)
+
+    @property
+    def input_specs(self) -> List[InputSpec]:
+        return [InputSpec(i["shape"], i["dtype"], i.get("name"))
+                for i in self._meta["inputs"]]
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference artifact is not trainable; "
+                           "rebuild the Layer and set_state_dict(state_dict())")
+
+
+def load(path: str) -> TranslatedLayer:
+    """Load a `jit.save`d model (ref paddle.jit.load)."""
+    with open(path + _MODEL_SUFFIX, "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    with open(path + _META_SUFFIX) as f:
+        meta = json.load(f)
+    state = {}
+    params_file = path + _PARAMS_SUFFIX + ".npz"
+    if os.path.exists(params_file):
+        with np.load(params_file, allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files}
+    return TranslatedLayer(exported, meta, state)
+
+
+# ------------------------------------------------------------- TracedLayer --
+class TracedLayer:
+    """ref dygraph/jit.py TracedLayer: trace a dygraph Layer with example
+    inputs; the result replays the traced computation and can be saved as an
+    inference model."""
+
+    def __init__(self, layer: Layer, sds: List[jax.ShapeDtypeStruct]):
+        self._layer = layer
+        self._sds = sds
+        self._sf = StaticFunction(layer.forward, layer=layer)
+
+    @staticmethod
+    def trace(layer: Layer, inputs: Sequence[Any]) -> Tuple[Any, "TracedLayer"]:
+        inputs = [_canon(i) for i in inputs]
+        sds = [jax.ShapeDtypeStruct(i.shape, jnp.asarray(i).dtype) for i in inputs]
+        tl = TracedLayer(layer, sds)
+        out = tl(*inputs)
+        return out, tl
+
+    def __call__(self, *args):
+        return self._sf(*args)
+
+    def save_inference_model(self, path: str, feed=None, fetch=None) -> None:
+        specs = [InputSpec(s.shape, s.dtype) for s in self._sds]
+        save(self._layer, path, input_spec=specs)

@@ -1,1 +1,2 @@
-from . import checkpoint
+from . import checkpoint, debug, monitor, profiler
+from .debug import check_numerics, disable_nan_check, enable_nan_check

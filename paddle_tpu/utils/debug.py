@@ -1,0 +1,76 @@
+"""Numerical debugging: NaN/Inf detection.
+
+Reference parity: FLAGS_check_nan_inf (platform/flags.cc:44) and the per-op
+post-check `CheckOpHasNanOrInf` that executors run over op outputs
+(framework/details/nan_inf_utils_detail.cc), reporting the op and variable
+name.  TPU-native design (SURVEY.md §5.2 mapping): under jit there are no
+per-op boundaries — the check runs on whole pytrees at user-chosen points
+(losses, grads, params) via `check_numerics`, with `jax.debug.callback`
+making it jit-safe; `enable_nan_check()` flips jax's global debug_nans for
+eager paths and arms the flag consulted by the train-step helpers.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags as _flags
+
+__all__ = ["check_numerics", "enable_nan_check", "disable_nan_check",
+           "nan_check_enabled"]
+
+
+def enable_nan_check(eager_also: bool = True) -> None:
+    """Arm NaN/Inf checking (ref FLAGS_check_nan_inf)."""
+    _flags.set_flags({"check_nan_inf": True})
+    if eager_also:
+        jax.config.update("jax_debug_nans", True)
+
+
+def disable_nan_check() -> None:
+    _flags.set_flags({"check_nan_inf": False})
+    jax.config.update("jax_debug_nans", False)
+
+
+def nan_check_enabled() -> bool:
+    return bool(_flags.get_flag("check_nan_inf"))
+
+
+def _report(bad_names, tag):
+    names = [n for n in bad_names if n]
+    raise FloatingPointError(
+        f"NaN/Inf detected in {tag!r}: {names}"
+        if names else f"NaN/Inf detected in {tag!r}")
+
+
+def check_numerics(tree: Any, tag: str = "tensors", force: bool = False):
+    """Raise FloatingPointError if any leaf of `tree` has NaN/Inf.
+
+    jit-safe (uses jax.debug.callback); a no-op unless the check_nan_inf
+    flag is set or `force=True`.  Returns `tree` so it can be inlined:
+        grads = check_numerics(grads, "grads")
+    """
+    if not (force or nan_check_enabled()):
+        return tree
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+
+    names = []
+    flags = []
+    for path, leaf in leaves_with_paths:
+        arr = jnp.asarray(leaf)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        names.append(jax.tree_util.keystr(path))
+        flags.append(~jnp.all(jnp.isfinite(arr)))
+    if not flags:
+        return tree
+
+    def _cb(bad):
+        bad_names = [n for n, b in zip(names, bad) if b]
+        if bad_names:
+            _report(bad_names, tag)
+
+    jax.debug.callback(_cb, jnp.stack(flags))
+    return tree

@@ -1,0 +1,24 @@
+"""Runtime stats monitor (Python face of the native StatRegistry).
+
+Reference parity: platform/monitor.h — `StatValue` (:43), `StatRegistry`
+(:84) and the STAT_ADD/STAT_RESET macros; values flow into the same
+process-wide native registry the C++ subsystems (datafeed) publish to, so
+`stats()` shows framework and native counters together.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core import native as _native
+
+__all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "stats"]
+
+stat_add = _native.stat_add
+stat_set = _native.stat_set
+stat_get = _native.stat_get
+stat_reset = _native.stat_reset
+
+
+def stats() -> Dict[str, int]:
+    """All registered gauges, name -> value."""
+    return _native.stat_list()

@@ -1,0 +1,116 @@
+"""Profiler API: scoped host events, summaries, chrome-trace timelines, and
+an XLA/jax.profiler bridge.
+
+Reference parity: python/paddle/fluid/profiler.py (`start_profiler`,
+`stop_profiler`, the `profiler(...)` context manager, `reset_profiler`) over
+platform/profiler.h `RecordEvent` (:126) / `EnableProfiler` (:208), plus
+tools/timeline.py's chrome://tracing export.  The host side records into the
+native C++ event store (native/src/profiler.cc) through the ctypes bridge;
+the device side is delegated to `jax.profiler` (XLA's own tracer replaces
+the reference's CUPTI DeviceTracer, SURVEY.md §5.1 TPU mapping).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Optional
+
+from ..core import native as _native
+
+__all__ = [
+    "RecordEvent", "record_event", "start_profiler", "stop_profiler",
+    "reset_profiler", "profiler", "export_chrome_tracing", "summary",
+    "start_device_trace", "stop_device_trace",
+]
+
+
+class RecordEvent:
+    """Scoped host-side event (ref platform/profiler.h:126).
+
+    Usable as a context manager or a decorator::
+
+        with profiler.RecordEvent("data_load"):
+            batch = next(loader)
+    """
+
+    def __init__(self, name: str):
+        self.name = str(name)
+
+    def __enter__(self):
+        _native.prof_push(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _native.prof_pop()
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+record_event = RecordEvent
+
+
+def start_profiler(state: str = "All") -> None:
+    """ref fluid/profiler.py start_profiler; `state` kept for API parity —
+    host events are always recorded, "GPU"/"All" additionally arms the
+    device-trace bridge on the next `start_device_trace` call."""
+    _native.prof_enable()
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None) -> None:
+    """Stop recording; print the summary table and optionally dump a
+    chrome-trace timeline to `profile_path` (ref stop_profiler's
+    profile_path dumps a proto; here it is directly chrome-trace JSON)."""
+    _native.prof_disable()
+    if profile_path:
+        _native.prof_export_chrome(profile_path)
+    s = _native.prof_summary()
+    if s:
+        print(s)
+
+
+def reset_profiler() -> None:
+    _native.prof_clear()
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None):
+    """ref fluid/profiler.py:profiler context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def export_chrome_tracing(path: str) -> int:
+    """Dump all recorded host events as chrome://tracing JSON
+    (ref tools/timeline.py). Returns number of events written."""
+    return _native.prof_export_chrome(path)
+
+
+def summary() -> str:
+    """Aggregated per-event table sorted by total time
+    (ref profiler_helper.h table)."""
+    return _native.prof_summary()
+
+
+# ---------------------------------------------------------------- devices --
+def start_device_trace(logdir: str) -> None:
+    """Start an XLA device trace (TensorBoard format) — the TPU replacement
+    for the reference's CUPTI DeviceTracer (platform/device_tracer.h:19)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()

@@ -1,0 +1,125 @@
+"""jit.to_static / TracedLayer / jit.save+load and the inference Predictor.
+
+Mirrors the reference's dygraph_to_static numeric-equality tests
+(unittests/dygraph_to_static/: dygraph output == converted static output)
+and the inference API tests (inference/tests/api/) at the Python surface.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, jit
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _x(batch=3, seed=0):
+    return np.random.RandomState(seed).rand(batch, 8).astype(np.float32)
+
+
+def test_to_static_matches_dygraph():
+    net = SmallNet()
+    x = _x()
+    eager = np.asarray(net(pd.to_tensor(x)))
+    static_fn = jit.to_static(net.forward)
+    out = np.asarray(static_fn(x))
+    np.testing.assert_allclose(eager, out, rtol=1e-5)
+    # cache hit on same signature, recompile on new shape
+    out2 = np.asarray(static_fn(_x(batch=5)))
+    assert out2.shape == (5, 4)
+
+
+def test_to_static_on_layer_object():
+    net = jit.to_static(SmallNet())
+    out = net(_x())
+    assert np.asarray(out).shape == (3, 4)
+
+
+def test_to_static_plain_function():
+    @jit.to_static
+    def f(a, b):
+        return pd.matmul(a, b) + 1.0
+
+    a = np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(f(a, a)), a @ a + 1.0)
+
+
+def test_traced_layer_and_roundtrip(tmp_path):
+    net = SmallNet()
+    x = _x()
+    out, traced = jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(np.asarray(traced(x)), np.asarray(out), rtol=1e-6)
+    prefix = str(tmp_path / "traced_model")
+    traced.save_inference_model(prefix)
+    assert os.path.exists(prefix + ".pdmodel")
+
+
+def test_jit_save_load_numeric_equality(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = _x(batch=2, seed=1)
+    ref = np.asarray(net(pd.to_tensor(x)))
+
+    prefix = str(tmp_path / "model")
+    jit.save(net, prefix, input_spec=[jit.InputSpec([2, 8], "float32", "x")])
+
+    loaded = jit.load(prefix)
+    np.testing.assert_allclose(np.asarray(loaded(x)), ref, rtol=1e-5)
+    # state dict preserved for fine-tune reload
+    sd = loaded.state_dict()
+    assert any(k.endswith("weight") or "fc1" in k for k in sd)
+    net2 = SmallNet()
+    net2.set_state_dict({k: v for k, v in sd.items()})
+    np.testing.assert_allclose(np.asarray(net2(pd.to_tensor(x))), ref, rtol=1e-5)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_predictor_handles_and_run(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = _x(batch=4, seed=2)
+    ref = np.asarray(net(pd.to_tensor(x)))
+
+    prefix = str(tmp_path / "serving")
+    jit.save(net, prefix, input_spec=[jit.InputSpec([4, 8], "float32", "input")])
+
+    cfg = inference.Config(prefix)
+    cfg.enable_memory_optim()
+    predictor = inference.create_predictor(cfg)
+
+    assert predictor.get_input_names() == ["input"]
+    h = predictor.get_input_handle("input")
+    h.copy_from_cpu(x)
+    outs = predictor.run()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+    oh = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(oh.copy_to_cpu(), ref, rtol=1e-5)
+
+    # positional 2.0-style run
+    outs2 = predictor.run([x])
+    np.testing.assert_allclose(outs2[0], ref, rtol=1e-5)
+
+    # static-shape contract is enforced loudly
+    with pytest.raises(ValueError, match="static shapes"):
+        h.copy_from_cpu(_x(batch=7))
+
+
+def test_predictor_requires_inputs(tmp_path):
+    net = SmallNet()
+    prefix = str(tmp_path / "m")
+    jit.save(net, prefix, input_spec=[jit.InputSpec([1, 8], "float32")])
+    p = inference.create_predictor(inference.Config(prefix))
+    with pytest.raises(RuntimeError, match="not set"):
+        p.run()

@@ -1,0 +1,78 @@
+"""Profiler API, monitor, and NaN/Inf debugging."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+from paddle_tpu.utils import check_numerics, debug, monitor, profiler
+
+
+def test_profiler_context_and_timeline(tmp_path, capsys):
+    profiler.reset_profiler()
+    path = str(tmp_path / "timeline.json")
+    with profiler.profiler(profile_path=path):
+        with profiler.RecordEvent("forward"):
+            x = jnp.ones((8, 8))
+            (x @ x).block_until_ready()
+        with profiler.RecordEvent("backward"):
+            pass
+    out = capsys.readouterr().out
+    assert "forward" in out and "Calls" in out
+    with open(path) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert {"forward", "backward"} <= names
+
+
+def test_record_event_decorator(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler()
+
+    @profiler.RecordEvent("decorated_fn")
+    def fn(a, b):
+        return a + b
+
+    assert fn(1, 2) == 3
+    profiler.stop_profiler()
+    assert "decorated_fn" in profiler.summary()
+
+
+def test_monitor_stats():
+    monitor.stat_reset("pytest.gauge")
+    monitor.stat_add("pytest.gauge", 5)
+    assert monitor.stat_get("pytest.gauge") == 5
+    assert monitor.stats()["pytest.gauge"] == 5
+
+
+def test_check_numerics_flags_nan_in_jit():
+    debug.enable_nan_check(eager_also=False)
+    try:
+        @jax.jit
+        def f(x):
+            y = {"a": x, "b": jnp.log(x)}  # log(-1) -> nan
+            return check_numerics(y, "activations")
+
+        # under jit the callback's FloatingPointError surfaces wrapped in
+        # JaxRuntimeError; the message (incl. the bad leaf path) is preserved
+        with pytest.raises(Exception, match="NaN/Inf detected in 'activations'"):
+            jax.block_until_ready(f(jnp.array([-1.0])))
+        # clean values pass
+        out = jax.block_until_ready(f(jnp.array([1.0])))
+        assert float(out["a"][0]) == 1.0
+    finally:
+        debug.disable_nan_check()
+
+
+def test_check_numerics_noop_when_disabled():
+    debug.disable_nan_check()
+    out = check_numerics({"a": jnp.array([jnp.inf])}, "x")
+    assert not np.isfinite(float(out["a"][0]))  # passed through, no raise
+
+
+def test_check_numerics_force_names_bad_leaf():
+    with pytest.raises(FloatingPointError, match="b"):
+        jax.block_until_ready(
+            check_numerics({"a": jnp.ones(2), "b": jnp.array([np.nan])},
+                           "grads", force=True))
