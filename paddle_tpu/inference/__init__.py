@@ -16,7 +16,6 @@ extra copies on CPU.
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional
 
 import jax

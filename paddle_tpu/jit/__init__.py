@@ -100,7 +100,9 @@ class StaticFunction:
     def __call__(self, *args):
         args = tuple(_canon(a) for a in args)
         self._last_args = args
-        key = tuple((tuple(a.shape), str(jnp.asarray(a).dtype)) for a in args)
+        # _canon guarantees jax.Array or np.ndarray — read .dtype directly,
+        # never jnp.asarray (that would device-transfer just to build a key)
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._functional()
@@ -115,7 +117,7 @@ class StaticFunction:
         if self.input_spec:
             return [s.to_sds() for s in self.input_spec]
         if self._last_args is not None:
-            return [jax.ShapeDtypeStruct(a.shape, jnp.asarray(a).dtype)
+            return [jax.ShapeDtypeStruct(a.shape, jnp.dtype(a.dtype))
                     for a in self._last_args]
         raise ValueError(
             "cannot export: pass input_spec or call the function once first")

@@ -1,0 +1,200 @@
+"""Sequence ops — the LoD (ragged) op family on static shapes.
+
+Reference parity: paddle/fluid/operators/sequence_ops/ (sequence_pool_op,
+sequence_softmax_op, sequence_reverse_op, sequence_expand_op,
+sequence_mask_op, sequence_pad_op/sequence_unpad_op, sequence_first/last
+steps via pool) and the LoDTensor model itself (framework/lod_tensor.h:104).
+
+TPU-native design (SURVEY.md §7 hard parts "LoD tensors"): XLA wants static
+shapes, so the ragged LoD representation becomes one of two dense forms —
+  * padded-batch: (x [B, T, ...], lengths [B]) — the form every op here
+    takes; masks derive from lengths.
+  * segment-ids: (values [N, ...], segment_ids [N]) — for flattened
+    token streams; segment_* reductions cover the LoD-level-pool cases.
+Conversions between the two are sequence_pad / sequence_unpad.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_expand",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool"):
+    """[B] lengths -> [B, maxlen] mask (ref sequence_mask_op.cc)."""
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        raise ValueError(
+            "maxlen must be given under static shapes (the reference's "
+            "runtime max(lengths) would make the output shape dynamic)")
+    m = jnp.arange(maxlen)[None, :] < lengths[:, None]
+    return m if dtype == "bool" else m.astype(dtype)
+
+
+def _mask_for(x, lengths):
+    B, T = x.shape[0], x.shape[1]
+    m = sequence_mask(lengths, T)
+    return m.reshape((B, T) + (1,) * (x.ndim - 2))
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum", pad_value: float = 0.0):
+    """Pool over the time axis respecting lengths (ref sequence_pool_op.h).
+
+    x: [B, T, ...]; lengths: [B]. pool_type: sum|mean|max|sqrt|last|first.
+    Empty sequences yield pad_value (reference behavior).
+    """
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    m = _mask_for(x, lengths)
+    empty = (lengths == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type == "sum":
+        out = jnp.where(m, x, 0).sum(axis=1)
+    elif pool_type == "mean":
+        out = jnp.where(m, x, 0).sum(axis=1) / jnp.maximum(
+            lengths.reshape((-1,) + (1,) * (x.ndim - 2)), 1)
+    elif pool_type == "sqrt":
+        out = jnp.where(m, x, 0).sum(axis=1) / jnp.sqrt(jnp.maximum(
+            lengths.reshape((-1,) + (1,) * (x.ndim - 2)), 1).astype(x.dtype))
+    elif pool_type == "max":
+        out = jnp.where(m, x, -jnp.inf).max(axis=1)
+    elif pool_type == "first":
+        out = x[:, 0]
+    elif pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_softmax(x, lengths):
+    """Masked softmax over time (ref sequence_softmax_op.h). x: [B, T, ...]."""
+    x = jnp.asarray(x)
+    m = jnp.broadcast_to(_mask_for(x, lengths), x.shape)
+    z = jnp.where(m, x, -jnp.inf)
+    zmax = jnp.max(z, axis=1, keepdims=True)
+    zmax = jnp.where(jnp.isfinite(zmax), zmax, 0.0)  # all-padding rows
+    e = jnp.where(m, jnp.exp(x - zmax), 0.0)
+    return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+
+
+def sequence_reverse(x, lengths):
+    """Reverse each sequence's valid prefix, keeping padding in place
+    (ref sequence_reverse_op.h). x: [B, T, ...]."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    T = x.shape[1]
+    pos = jnp.arange(T)[None, :]
+    L = lengths[:, None]
+    src = jnp.where(pos < L, L - 1 - pos, pos)  # [B, T]
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_pad(values, segment_ids, batch: int, maxlen: int,
+                 pad_value: float = 0.0):
+    """segment-ids stream [N, ...] -> (padded [batch, maxlen, ...],
+    lengths [batch]) (ref sequence_pad_op.cc, LoD→dense).
+    segment_ids must be sorted ascending (LoD order); elements beyond
+    maxlen are dropped."""
+    values = jnp.asarray(values)
+    segment_ids = jnp.asarray(segment_ids)
+    # position of each element within its segment
+    one = jnp.ones_like(segment_ids)
+    # cumulative count per segment: rank i - first-index-of-segment
+    first_idx = jnp.searchsorted(segment_ids, jnp.arange(batch))
+    pos_in_seq = jnp.arange(segment_ids.shape[0]) - first_idx[segment_ids]
+    out = jnp.full((batch, maxlen) + values.shape[1:], pad_value, values.dtype)
+    out = out.at[segment_ids, pos_in_seq].set(values, mode="drop")
+    # clamp: elements beyond maxlen were dropped, lengths must agree
+    lengths = jnp.minimum(
+        jax.ops.segment_sum(one, segment_ids, num_segments=batch), maxlen)
+    return out, lengths
+
+
+def sequence_unpad(x, lengths):
+    """(padded [B, T, ...], lengths) -> (values [B*T, ...], segment_ids
+    [B*T], valid mask [B*T]) (ref sequence_unpad_op.cc).  Static shapes:
+    the stream keeps padding rows, marked invalid in the mask."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    seg = jnp.repeat(jnp.arange(B), T)
+    mask = sequence_mask(lengths, T).reshape(-1)
+    return x.reshape((B * T,) + x.shape[2:]), seg, mask
+
+
+def sequence_expand(x, lengths, ref_lengths, maxlen: int):
+    """Expand each sequence to repeat per ref_lengths (ref
+    sequence_expand_op.cc with y-LoD at level 0): sequence i of x is tiled
+    ref_lengths[i] times along time, truncated/padded to maxlen."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    reps = jnp.asarray(ref_lengths)
+    src_len = jnp.asarray(lengths)
+    pos = jnp.arange(maxlen)[None, :]
+    total = src_len[:, None] * reps[:, None]
+    src = jnp.where(pos < total, pos % jnp.maximum(src_len[:, None], 1), 0)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    valid = pos < total
+    out = jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)), out, 0)
+    return out, jnp.minimum(total[:, 0], maxlen)
+
+
+def sequence_slice(x, lengths, offset, length):
+    """Slice [offset, offset+length) of each sequence (ref
+    sequence_slice_op.h); returns (y [B, T, ...] shifted to t=0, new_lengths)."""
+    x = jnp.asarray(x)
+    T = x.shape[1]
+    offset = jnp.asarray(offset).reshape(-1)
+    length = jnp.asarray(length).reshape(-1)
+    pos = jnp.arange(T)[None, :]
+    src = jnp.clip(pos + offset[:, None], 0, T - 1)
+    y = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    valid = pos < length[:, None]
+    y = jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)), y, 0)
+    new_len = jnp.minimum(length, jnp.maximum(jnp.asarray(lengths) - offset, 0))
+    return y, new_len
+
+
+# ----------------------------------------------------- segment reductions --
+def segment_sum(values, segment_ids, num_segments: int):
+    return jax.ops.segment_sum(jnp.asarray(values), jnp.asarray(segment_ids),
+                               num_segments=num_segments)
+
+
+def segment_mean(values, segment_ids, num_segments: int):
+    s = segment_sum(values, segment_ids, num_segments)
+    n = jax.ops.segment_sum(jnp.ones_like(jnp.asarray(segment_ids),
+                                          jnp.float32),
+                            jnp.asarray(segment_ids),
+                            num_segments=num_segments)
+    return s / jnp.maximum(n, 1.0).reshape((-1,) + (1,) * (s.ndim - 1))
+
+
+def segment_max(values, segment_ids, num_segments: int):
+    return jax.ops.segment_max(jnp.asarray(values), jnp.asarray(segment_ids),
+                               num_segments=num_segments)
+
+
+def segment_min(values, segment_ids, num_segments: int):
+    return jax.ops.segment_min(jnp.asarray(values), jnp.asarray(segment_ids),
+                               num_segments=num_segments)

@@ -1,0 +1,501 @@
+"""Detection / vision ops.
+
+Reference parity: paddle/fluid/operators/detection/ (~17K LoC C++/CUDA —
+yolo_box_op.cc, yolov3_loss_op.cc, multiclass_nms_op.cc, roi_align_op.cc,
+anchor_generator_op.cc, prior_box_op.cc, box_coder_op.cc, iou_similarity_op.cc,
+box_clip_op.cc) and their python wrappers fluid/layers/detection.py.
+
+TPU-native design (SURVEY.md §7 step 8 "dynamic shapes policy"): the
+reference returns LoD (ragged) detection lists; XLA needs static shapes, so
+every op here returns **fixed-size padded outputs plus a valid-count** —
+`multiclass_nms` yields (dets[keep_top_k, 6], num_valid) instead of a ragged
+LoDTensor, NMS runs as a `lax.fori_loop` over a top-k-bounded candidate set,
+and RoIAlign samples a fixed grid with gather/bilinear weights (vectorized,
+MXU/VPU-friendly) instead of per-ROI scalar loops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "anchor_generator",
+    "prior_box", "yolo_box", "yolo_loss", "multiclass_nms", "roi_align",
+]
+
+
+# ------------------------------------------------------------------- boxes --
+def iou_similarity(x, y, box_normalized: bool = True, eps: float = 1e-10):
+    """Pairwise IoU between two box sets (ref iou_similarity_op.cc).
+
+    x: [N, 4], y: [M, 4] in (x1, y1, x2, y2). Returns [N, M].
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    # +1 for integer-coordinate (non-normalized) boxes, as the reference does
+    off = 0.0 if box_normalized else 1.0
+    area_x = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    area_y = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, eps)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0):
+    """Encode/decode boxes against priors (ref box_coder_op.cc).
+
+    encode: target [N,4] vs priors [M,4] -> [N,M,4] offsets.
+    decode: target [N,M,4] (or [N,4] broadcast) offsets -> boxes [N,M,4].
+    prior_box_var: None | [M,4] | 4-list of floats.
+    """
+    prior_box = jnp.asarray(prior_box)
+    target_box = jnp.asarray(target_box)
+    off = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + off
+    ph = prior_box[:, 3] - prior_box[:, 1] + off
+    pcx = prior_box[:, 0] + 0.5 * pw
+    pcy = prior_box[:, 1] + 0.5 * ph
+    if prior_box_var is None:
+        var = jnp.ones((4,), target_box.dtype)
+        var = jnp.broadcast_to(var, prior_box.shape)
+    else:
+        var = jnp.asarray(prior_box_var, target_box.dtype)
+        if var.ndim == 1:
+            var = jnp.broadcast_to(var[None, :], prior_box.shape)
+
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + off
+        th = target_box[:, 3] - target_box[:, 1] + off
+        tcx = target_box[:, 0] + 0.5 * tw
+        tcy = target_box[:, 1] + 0.5 * th
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / var[None, :, :]
+    elif code_type == "decode_center_size":
+        if target_box.ndim == 2:
+            target_box = target_box[:, None, :]
+        # ref box_coder_op.h:138 — axis 0: priors indexed by the col dim;
+        # axis 1: priors indexed by the row dim.
+        expect = target_box.shape[1] if axis == 0 else target_box.shape[0]
+        if prior_box.shape[0] != expect:
+            raise ValueError(
+                f"decode with axis={axis} needs {expect} priors (target dim "
+                f"{1 if axis == 0 else 0} of {tuple(target_box.shape)}); "
+                f"got {prior_box.shape[0]}")
+        # axis selects whether priors broadcast along rows (0) or cols (1);
+        # after the [:, None, :] insert both reduce to broadcasting over dim 1
+        t = target_box * var[None, :, :] if axis == 0 else target_box * var[:, None, :]
+        pw_b = pw[None, :] if axis == 0 else pw[:, None]
+        ph_b = ph[None, :] if axis == 0 else ph[:, None]
+        pcx_b = pcx[None, :] if axis == 0 else pcx[:, None]
+        pcy_b = pcy[None, :] if axis == 0 else pcy[:, None]
+        cx = t[..., 0] * pw_b + pcx_b
+        cy = t[..., 1] * ph_b + pcy_b
+        w = jnp.exp(t[..., 2]) * pw_b
+        h = jnp.exp(t[..., 3]) * ph_b
+        return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                          cx + 0.5 * w - off, cy + 0.5 * h - off], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def box_clip(input, im_info):
+    """Clip boxes to image bounds (ref box_clip_op.cc).
+    input: [..., 4]; im_info: (h, w) or [..., 2]."""
+    input = jnp.asarray(input)
+    h, w = im_info[0], im_info[1]
+    x1 = jnp.clip(input[..., 0], 0, w - 1)
+    y1 = jnp.clip(input[..., 1], 0, h - 1)
+    x2 = jnp.clip(input[..., 2], 0, w - 1)
+    y2 = jnp.clip(input[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+# ----------------------------------------------------------------- anchors --
+def anchor_generator(feature_hw: Tuple[int, int],
+                     anchor_sizes: Sequence[float] = (64., 128., 256., 512.),
+                     aspect_ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                     stride: Sequence[float] = (16., 16.),
+                     variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                     offset: float = 0.5):
+    """RPN-style anchors (ref anchor_generator_op.cc).
+
+    Returns (anchors [H, W, A, 4] xyxy in input-image coords,
+             variances [H, W, A, 4]); A = len(sizes)*len(ratios).
+    """
+    H, W = feature_hw
+    sizes = jnp.asarray(anchor_sizes, jnp.float32)
+    ratios = jnp.asarray(aspect_ratios, jnp.float32)
+    # all (ratio, size) combos — ratio-major to match the reference's loops;
+    # anchor w/h from size & ratio: w = size/sqrt(ratio), h = size*sqrt(ratio)
+    r = jnp.repeat(ratios, sizes.shape[0])
+    s = jnp.tile(sizes, ratios.shape[0])
+    aw = s / jnp.sqrt(r)
+    ah = s * jnp.sqrt(r)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    anchors = jnp.stack([
+        cxg[..., None] - 0.5 * aw,
+        cyg[..., None] - 0.5 * ah,
+        cxg[..., None] + 0.5 * aw,
+        cyg[..., None] + 0.5 * ah,
+    ], axis=-1)  # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
+              min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (1.0,), flip: bool = False,
+              clip: bool = False, steps: Sequence[float] = (0.0, 0.0),
+              offset: float = 0.5,
+              variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2)):
+    """SSD prior boxes (ref prior_box_op.cc / layers/detection.py prior_box).
+
+    Returns (boxes [H, W, P, 4] normalized xyxy, variances [H, W, P, 4]).
+    """
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    # ref prior_box_op ExpandAspectRatios: ratio 1.0 is always present, and
+    # flip adds reciprocals
+    ratios = [1.0] + [a for a in aspect_ratios if abs(a - 1.0) > 1e-6]
+    if flip:
+        ratios += [1.0 / a for a in aspect_ratios if abs(a - 1.0) > 1e-6]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must pair 1:1 with min_sizes "
+                         f"(got {len(max_sizes)} vs {len(min_sizes)})")
+    ws, hs = [], []
+    for i, ms in enumerate(min_sizes):
+        for ar in ratios:
+            ws.append(ms * (ar ** 0.5))
+            hs.append(ms / (ar ** 0.5))
+        if max_sizes:  # ref: one extra sqrt(min*max) prior per min size
+            Ms = max_sizes[i]
+            ws.append((ms * Ms) ** 0.5)
+            hs.append((ms * Ms) ** 0.5)
+    ws = jnp.asarray(ws, jnp.float32) / img_w
+    hs = jnp.asarray(hs, jnp.float32) / img_h
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w / img_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h / img_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    boxes = jnp.stack([
+        cxg[..., None] - 0.5 * ws,
+        cyg[..., None] - 0.5 * hs,
+        cxg[..., None] + 0.5 * ws,
+        cyg[..., None] + 0.5 * hs,
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+# -------------------------------------------------------------------- yolo --
+def _yolo_grid(x, anchors, class_num, downsample_ratio, scale_x_y):
+    """Shared decode of the YOLO head tensor x [N, A*(5+C), H, W]."""
+    N, CC, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    if CC != A * (5 + C):
+        raise ValueError(
+            f"yolo head has {CC} channels but {len(anchors)//2} anchors x "
+            f"(5+{C}) classes needs {A * (5 + C)}")
+    x = x.reshape(N, A, 5 + C, H, W)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    gx = jnp.arange(W, dtype=jnp.float32)
+    gy = jnp.arange(H, dtype=jnp.float32)
+    gxg, gyg = jnp.meshgrid(gx, gy)  # [H, W]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias + gxg) / W  # [N,A,H,W]
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias + gyg) / H
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+    obj = jax.nn.sigmoid(x[:, :, 4])
+    cls = jax.nn.sigmoid(x[:, :, 5:])  # [N, A, C, H, W]
+    return cx, cy, bw, bh, obj, cls
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float = 0.01, downsample_ratio: int = 32,
+             clip_bbox: bool = True, scale_x_y: float = 1.0):
+    """Decode one YOLO head to boxes+scores (ref yolo_box_op.cc).
+
+    x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, A*H*W, 4] xyxy in image coords,
+             scores [N, A*H*W, C]); low-confidence rows are zeroed (the
+    static-shape stand-in for the reference's filtering).
+    """
+    x = jnp.asarray(x)
+    img_size = jnp.asarray(img_size)
+    N, _, H, W = x.shape
+    cx, cy, bw, bh, obj, cls = _yolo_grid(x, anchors, class_num,
+                                          downsample_ratio, scale_x_y)
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2) * img_w
+    y1 = (cy - bh / 2) * img_h
+    x2 = (cx + bw / 2) * img_w
+    y2 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, img_w - 1)
+        y1 = jnp.clip(y1, 0.0, img_h - 1)
+        x2 = jnp.clip(x2, 0.0, img_w - 1)
+        y2 = jnp.clip(y2, 0.0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    conf = obj[..., None]  # [N, A, H, W, 1]
+    scores = cls.transpose(0, 1, 3, 4, 2) * conf  # [N, A, H, W, C]
+    keep = (conf > conf_thresh).astype(boxes.dtype)
+    boxes = boxes * keep
+    scores = scores * keep
+    M = boxes.shape[1] * H * W
+    return boxes.reshape(N, M, 4), scores.reshape(N, M, class_num)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
+              anchor_mask: Sequence[int], class_num: int,
+              ignore_thresh: float = 0.7, downsample_ratio: int = 32,
+              gt_score=None, use_label_smooth: bool = False,
+              scale_x_y: float = 1.0):
+    """YOLOv3 training loss for one head (ref yolov3_loss_op.cc/.h).
+
+    x: [N, len(mask)*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h,
+    normalized to [0,1]); gt_label: [N, B] int; rows with w<=0 are padding.
+    Returns per-image loss [N].
+
+    Assignment follows the reference: a gt's responsible anchor is the
+    global-argmax-IoU anchor over ALL anchors (shape-only IoU); the gt only
+    contributes at this head if that anchor is in `anchor_mask`.  Objectness
+    of unmatched predictions is trained toward 0 except where their IoU with
+    any gt exceeds ignore_thresh.  All built as dense scatters — no ragged
+    tensors (static-shape policy).
+    """
+    x = jnp.asarray(x)
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label)
+    N, _, H, W = x.shape
+    mask = list(anchor_mask)
+    A = len(mask)
+    C = class_num
+    xr = x.reshape(N, A, 5 + C, H, W)
+    anc_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anc = anc_all[jnp.asarray(mask)]
+    input_w = jnp.float32(downsample_ratio * W)
+    input_h = jnp.float32(downsample_ratio * H)
+    B = gt_box.shape[1]
+    valid = gt_box[:, :, 2] > 0  # [N, B]
+    if gt_score is None:
+        gt_score = valid.astype(jnp.float32)
+    else:
+        gt_score = jnp.asarray(gt_score, jnp.float32) * valid
+
+    # ---- responsible-anchor assignment (shape-only IoU, centered boxes) ----
+    gw = gt_box[:, :, 2] * input_w  # pixels
+    gh = gt_box[:, :, 3] * input_h
+    inter = (jnp.minimum(gw[..., None], anc_all[None, None, :, 0]) *
+             jnp.minimum(gh[..., None], anc_all[None, None, :, 1]))
+    union = gw[..., None] * gh[..., None] + \
+        anc_all[None, None, :, 0] * anc_all[None, None, :, 1] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(mask)
+    in_head = (best_anchor[..., None] == mask_arr[None, None, :])  # [N,B,A]
+    local_anchor = jnp.argmax(in_head, axis=-1)  # [N,B] (valid where any)
+    assigned = valid & jnp.any(in_head, axis=-1)  # [N,B]
+
+    gi = jnp.clip((gt_box[:, :, 0] * W).astype(jnp.int32), 0, W - 1)  # [N,B]
+    gj = jnp.clip((gt_box[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+
+    # ---- dense targets via scatter ----
+    tx = gt_box[:, :, 0] * W - gi
+    ty = gt_box[:, :, 1] * H - gj
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(anc[local_anchor][..., 0], 1e-6), 1e-9))
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(anc[local_anchor][..., 1], 1e-6), 1e-9))
+    box_scale = 2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]  # small boxes upweighted
+
+    # Unassigned/padding rows must not write at all (a clamped scatter at
+    # (n,0,0,0) would clobber a real target there): push their batch index
+    # out of bounds and use mode="drop" so XLA discards those updates.
+    bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    bidx = jnp.where(assigned, bidx, N)
+    sel = (bidx, local_anchor, gj, gi)
+
+    def scat(vals):
+        t = jnp.zeros((N, A, H, W), jnp.float32)
+        return t.at[sel].set(vals, mode="drop")
+
+    obj_mask = scat(gt_score)                # positive weight
+    t_x, t_y = scat(tx), scat(ty)
+    t_w, t_h = scat(tw), scat(th)
+    t_scale = scat(box_scale)
+    t_cls = jnp.zeros((N, A, H, W, C), jnp.float32)
+    cls_idx = jnp.clip(gt_label, 0, C - 1)
+    t_cls = t_cls.at[sel + (cls_idx,)].set(1.0, mode="drop")
+
+    # ---- ignore mask: predictions overlapping any gt beyond thresh ----
+    # same decode as yolo_box, restricted to this head's anchors
+    masked_anchors = [float(v) for i in mask
+                      for v in (anchors[2 * i], anchors[2 * i + 1])]
+    cx, cy, bw, bh, _, _ = _yolo_grid(x, masked_anchors, C,
+                                      downsample_ratio, scale_x_y)
+    pb = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], -1)
+    gb = jnp.stack([gt_box[:, :, 0] - gt_box[:, :, 2] / 2,
+                    gt_box[:, :, 1] - gt_box[:, :, 3] / 2,
+                    gt_box[:, :, 0] + gt_box[:, :, 2] / 2,
+                    gt_box[:, :, 1] + gt_box[:, :, 3] / 2], -1)  # [N,B,4]
+    pb_flat = pb.reshape(N, -1, 4)
+    ious = jax.vmap(iou_similarity)(pb_flat, gb)  # [N, A*H*W, B]
+    ious = jnp.where(valid[:, None, :], ious, 0.0)
+    best_iou = ious.max(axis=-1).reshape(N, A, H, W)
+    ignore = (best_iou > ignore_thresh) & (obj_mask <= 0)
+
+    # ---- loss terms (BCE-with-logits like the reference) ----
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    lx = bce(xr[:, :, 0], t_x) * t_scale * obj_mask
+    ly = bce(xr[:, :, 1], t_y) * t_scale * obj_mask
+    lw = jnp.abs(xr[:, :, 2] - t_w) * t_scale * obj_mask
+    lh = jnp.abs(xr[:, :, 3] - t_h) * t_scale * obj_mask
+    pos = bce(xr[:, :, 4], jnp.ones_like(obj_mask)) * obj_mask
+    neg = bce(xr[:, :, 4], jnp.zeros_like(obj_mask)) * \
+        jnp.where((obj_mask <= 0) & (~ignore), 1.0, 0.0)
+    smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+    t_cls_s = t_cls * (1 - 2 * smooth) + smooth if use_label_smooth else t_cls
+    lcls = (bce(xr[:, :, 5:].transpose(0, 1, 3, 4, 2), t_cls_s) *
+            obj_mask[..., None]).sum(-1)
+    per_img = (lx + ly + lw + lh + pos + neg + lcls).sum(axis=(1, 2, 3))
+    return per_img
+
+
+# --------------------------------------------------------------------- nms --
+def _nms_one_class(boxes, scores, iou_threshold, score_threshold, top_k,
+                   normalized=True):
+    """Greedy NMS over the top_k highest-scoring candidates.
+    Returns (keep mask [top_k], order indices [top_k] into boxes)."""
+    order = jnp.argsort(-scores)[:top_k]
+    b = boxes[order]
+    s = scores[order]
+    iou = iou_similarity(b, b, box_normalized=normalized)
+    M = b.shape[0]
+    idx = jnp.arange(M)
+
+    def body(i, keep):
+        earlier = (idx < i) & keep
+        sup = jnp.any(earlier & (iou[i] > iou_threshold))
+        ok = (~sup) & (s[i] > score_threshold)
+        return keep.at[i].set(ok)
+
+    keep = lax.fori_loop(0, M, body, jnp.ones(M, bool))
+    return keep, order
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
+                   nms_top_k: int = 400, keep_top_k: int = 100,
+                   nms_threshold: float = 0.45, normalized: bool = True,
+                   background_label: int = -1):
+    """Per-class NMS (ref multiclass_nms_op.cc), single image.
+
+    bboxes: [M, 4] (shared across classes) or [M, C, 4];
+    scores: [C, M].  Returns (dets [keep_top_k, 6] = (label, score, x1, y1,
+    x2, y2) sorted by score, padded with label=-1, and num_valid).
+    """
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    C, M = scores.shape
+    top_k = min(nms_top_k, M)
+    if bboxes.ndim == 2:
+        per_class_boxes = jnp.broadcast_to(bboxes[None], (C, M, 4))
+    else:
+        per_class_boxes = bboxes.transpose(1, 0, 2)  # [C, M, 4]
+
+    keep, order = jax.vmap(
+        lambda b, s: _nms_one_class(b, s, nms_threshold, score_threshold,
+                                    top_k, normalized))(per_class_boxes, scores)
+    # gather per-class candidates
+    cls_ids = jnp.broadcast_to(jnp.arange(C)[:, None], (C, top_k))
+    sel_scores = jnp.take_along_axis(scores, order, axis=1)  # [C, top_k]
+    sel_boxes = jnp.take_along_axis(per_class_boxes, order[..., None], axis=1)
+    if background_label >= 0:
+        keep = keep & (cls_ids != background_label)
+    flat_scores = jnp.where(keep, sel_scores, -jnp.inf).reshape(-1)
+    flat_boxes = sel_boxes.reshape(-1, 4)
+    flat_cls = cls_ids.reshape(-1)
+    k = min(keep_top_k, flat_scores.shape[0])
+    top_scores, top_idx = lax.top_k(flat_scores, k)
+    out_valid = jnp.isfinite(top_scores)
+    dets = jnp.concatenate([
+        jnp.where(out_valid, flat_cls[top_idx], -1).astype(jnp.float32)[:, None],
+        jnp.where(out_valid, top_scores, 0.0)[:, None],
+        jnp.where(out_valid[:, None], flat_boxes[top_idx], 0.0),
+    ], axis=1)
+    if k < keep_top_k:
+        pad = jnp.zeros((keep_top_k - k, 6), dets.dtype).at[:, 0].set(-1.0)
+        dets = jnp.concatenate([dets, pad], axis=0)
+    return dets, out_valid.sum().astype(jnp.int32)
+
+
+# --------------------------------------------------------------- roi align --
+def roi_align(input, rois, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = False):
+    """RoIAlign (ref roi_align_op.cc/.cu), batch-size-1 feature map.
+
+    input: [C, H, W]; rois: [R, 4] xyxy in input-image coords.
+    Returns [R, C, out_h, out_w].  Bilinear sampling over a fixed
+    sampling grid, fully vectorized (gather + weighted sum).
+    """
+    input = jnp.asarray(input)
+    rois = jnp.asarray(rois, jnp.float32)
+    C, H, W = input.shape
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / out_w
+        bin_h = rh / out_h
+        # sample grid: (out_h*ratio) x (out_w*ratio) points
+        sy = y1 + (jnp.arange(out_h * ratio) + 0.5) * bin_h / ratio
+        sx = x1 + (jnp.arange(out_w * ratio) + 0.5) * bin_w / ratio
+        yy, xx = jnp.meshgrid(sy, sx, indexing="ij")  # [oh*r, ow*r]
+        # ref roi_align_op: samples with y/x outside [-1, H]/[-1, W]
+        # contribute zero (not border replication)
+        in_img = (yy >= -1.0) & (yy <= H) & (xx >= -1.0) & (xx <= W)
+        yy_c = jnp.clip(yy, 0.0, H - 1)
+        xx_c = jnp.clip(xx, 0.0, W - 1)
+        y0 = jnp.floor(yy_c)
+        x0 = jnp.floor(xx_c)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        ly = jnp.clip(yy_c - y0, 0.0, 1.0)
+        lx = jnp.clip(xx_c - x0, 0.0, 1.0)
+        v = (input[:, y0i, x0i] * ((1 - ly) * (1 - lx)) +
+             input[:, y0i, x1i] * ((1 - ly) * lx) +
+             input[:, y1i, x0i] * (ly * (1 - lx)) +
+             input[:, y1i, x1i] * (ly * lx))  # [C, oh*r, ow*r]
+        v = jnp.where(in_img, v, 0.0)
+        v = v.reshape(C, out_h, ratio, out_w, ratio)
+        return v.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)

@@ -1,5 +1,6 @@
 """paddle_tpu.text — NLP model zoo (ref: python/paddle/text/ + the
 PaddleNLP-era ERNIE family targeted by BASELINE.json)."""
+from .datasets import Imdb, Imikolov, UCIHousing
 from .ernie import (
     BertConfig,
     BertForPretraining,

@@ -1,0 +1,174 @@
+"""Text datasets (ref: python/paddle/text/datasets/ — Imdb, Imikolov,
+UCIHousing, Conll05, Movielens … backed by paddle/dataset/ downloaders).
+
+No egress in this environment: each dataset loads from a local ``data_file``
+when provided (the reference's on-disk formats where cheap: IMDB aclImdb
+tar layout, Imikolov token files, UCI housing whitespace table) and otherwise
+falls back to a deterministic synthetic corpus, keeping e2e tests hermetic
+(same policy as vision/datasets.py).
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing"]
+
+
+def _tokenize(text: str) -> List[str]:
+    out, cur = [], []
+    for ch in text.lower():
+        if ch.isalnum():
+            cur.append(ch)
+        elif cur:
+            out.append("".join(cur))
+            cur = []
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (ref text/datasets/imdb.py): sequences of word ids +
+    binary label, padded to ``maxlen`` with 0 (static shapes)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, maxlen: int = 256,
+                 synthetic_size: int = 512):
+        self.mode = mode
+        self.maxlen = maxlen
+        if data_file and os.path.exists(data_file):
+            docs, labels = self._load_tar(data_file, mode)
+            self.word_idx = self._build_dict(docs, cutoff)
+            seqs = [[self.word_idx.get(w, len(self.word_idx)) for w in d]
+                    for d in docs]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab = 5000
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            seqs, labels = [], []
+            for i in range(synthetic_size):
+                label = int(rng.rand() > 0.5)
+                L = int(rng.randint(8, maxlen))
+                # class-dependent token distribution so models can learn
+                base = rng.randint(0, vocab // 2, size=L)
+                seqs.append((base + label * vocab // 2).tolist())
+                labels.append(label)
+        self.docs = [self._pad(s) for s in seqs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def _pad(self, seq):
+        out = np.zeros(self.maxlen, np.int64)
+        s = np.asarray(seq[:self.maxlen], np.int64)
+        out[:len(s)] = s
+        return out
+
+    @staticmethod
+    def _load_tar(path, mode):
+        docs, labels = [], []
+        pat_pos = f"aclImdb/{mode}/pos/"
+        pat_neg = f"aclImdb/{mode}/neg/"
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if member.name.startswith(pat_pos) or member.name.startswith(pat_neg):
+                    f = tf.extractfile(member)
+                    if f is None:
+                        continue
+                    docs.append(_tokenize(f.read().decode("utf-8", "ignore")))
+                    labels.append(1 if pat_pos in member.name else 0)
+        return docs, labels
+
+    @staticmethod
+    def _build_dict(docs, cutoff):
+        freq = {}
+        for d in docs:
+            for w in d:
+                freq[w] = freq.get(w, 0) + 1
+        words = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        return {w: i for i, w in enumerate(words)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (ref text/datasets/imikolov.py):
+    each item is (context ids [N-1], next id)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50, synthetic_size: int = 2048):
+        self.window_size = window_size
+        if data_file and os.path.exists(data_file):
+            with open(data_file, encoding="utf-8") as f:
+                tokens = _tokenize(f.read())
+            freq = {}
+            for t in tokens:
+                freq[t] = freq.get(t, 0) + 1
+            vocab = [w for w, c in sorted(freq.items(),
+                                          key=lambda kv: (-kv[1], kv[0]))
+                     if c >= min_word_freq]
+            self.word_idx = {w: i for i, w in enumerate(vocab)}
+            unk = len(self.word_idx)
+            ids = [self.word_idx.get(t, unk) for t in tokens]
+        else:
+            rng = np.random.RandomState(2 if mode == "train" else 3)
+            vocab_n = 2000
+            self.word_idx = {f"w{i}": i for i in range(vocab_n)}
+            # markov-ish synthetic stream: next ≈ (prev*7+3) mod vocab + noise
+            ids = [int(rng.randint(vocab_n))]
+            for _ in range(synthetic_size + window_size):
+                nxt = (ids[-1] * 7 + 3 + int(rng.randint(0, 3))) % vocab_n
+                ids.append(nxt)
+        w = window_size
+        self.samples = [(np.asarray(ids[i:i + w - 1], np.int64),
+                         np.int64(ids[i + w - 1]))
+                        for i in range(len(ids) - w + 1)]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression (ref text/datasets/uci_housing.py):
+    13 normalized features -> price."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 synthetic_size: int = 506):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            feats, prices = raw[:, :-1], raw[:, -1:]
+        else:
+            rng = np.random.RandomState(4 if mode == "train" else 5)
+            feats = rng.rand(synthetic_size, self.FEATURE_DIM).astype(np.float32)
+            w = np.linspace(-2, 2, self.FEATURE_DIM, dtype=np.float32)
+            prices = (feats @ w[:, None] + 3.0 +
+                      rng.randn(synthetic_size, 1).astype(np.float32) * 0.1)
+        mean, std = feats.mean(0), feats.std(0) + 1e-8
+        self.features = (feats - mean) / std
+        self.prices = prices.astype(np.float32)
+        split = int(0.8 * len(self.features))
+        if mode == "train":
+            self.features, self.prices = self.features[:split], self.prices[:split]
+        else:
+            self.features, self.prices = self.features[split:], self.prices[split:]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.features)

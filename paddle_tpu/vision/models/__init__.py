@@ -11,3 +11,4 @@ from .resnet import (
     resnet152,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .yolov3 import DarkNet53, YOLOv3, yolov3_darknet53

@@ -1,0 +1,170 @@
+"""YOLOv3 with DarkNet-53 backbone (ref: the PaddleDetection YOLOv3 config
+the reference ecosystem ships — BASELINE.json config 4 "PaddleDetection
+YOLOv3/PP-YOLO multi-host" — built on operators/detection/yolo_box_op.cc and
+yolov3_loss_op.cc via paddle_tpu.ops.vision).
+
+TPU notes: fixed input resolution (default 416) keeps every head's shape
+static; train loss and inference decode are pure functions over the three
+heads, so the whole detector jits as one XLA program.  NCHW like the rest of
+the vision zoo.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn, ops
+
+__all__ = ["DarkNet53", "YOLOv3", "yolov3_darknet53"]
+
+# canonical YOLOv3 anchor set (COCO), pixel units at the input resolution
+DEFAULT_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+                   59, 119, 116, 90, 156, 198, 373, 326]
+DEFAULT_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_ch, out_ch, k=3, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=(k - 1) // 2, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return nn.functional.leaky_relu(self.bn(self.conv(x)), 0.1)
+
+
+class DarkBlock(nn.Layer):
+    """1x1 squeeze + 3x3 expand residual block."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch // 2, k=1)
+        self.conv2 = ConvBNLayer(ch // 2, ch, k=3)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class DarkNet53(nn.Layer):
+    """Backbone; returns C3, C4, C5 feature maps (stride 8/16/32)."""
+
+    def __init__(self):
+        super().__init__()
+        self.stem = ConvBNLayer(3, 32, k=3)
+        self.stages = nn.LayerList()
+        chans = [(32, 64, 1), (64, 128, 2), (128, 256, 8),
+                 (256, 512, 8), (512, 1024, 4)]
+        for in_ch, out_ch, blocks in chans:
+            stage = nn.Sequential(
+                ConvBNLayer(in_ch, out_ch, k=3, stride=2),
+                *[DarkBlock(out_ch) for _ in range(blocks)])
+            self.stages.append(stage)
+
+    def forward(self, x) -> List:
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats[2:]  # C3 (256, /8), C4 (512, /16), C5 (1024, /32)
+
+
+class YoloDetectionBlock(nn.Layer):
+    """5-conv tower producing (route, tip) as in the v3 neck."""
+
+    def __init__(self, in_ch, ch):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, k=1)
+        self.conv1 = ConvBNLayer(ch, ch * 2, k=3)
+        self.conv2 = ConvBNLayer(ch * 2, ch, k=1)
+        self.conv3 = ConvBNLayer(ch, ch * 2, k=3)
+        self.route = ConvBNLayer(ch * 2, ch, k=1)
+        self.tip = ConvBNLayer(ch, ch * 2, k=3)
+
+    def forward(self, x):
+        x = self.conv3(self.conv2(self.conv1(self.conv0(x))))
+        route = self.route(x)
+        return route, self.tip(route)
+
+
+class YOLOv3(nn.Layer):
+    """Full detector: backbone → FPN-style neck → 3 heads.
+
+    forward(images) returns the 3 raw head tensors (train target);
+    `loss(heads, gt_box, gt_label)` and `predict(heads, img_size)` wrap
+    ops.yolo_loss / ops.yolo_box + ops.multiclass_nms.
+    """
+
+    def __init__(self, num_classes: int = 80,
+                 anchors: Sequence[int] = DEFAULT_ANCHORS,
+                 anchor_masks: Sequence[Sequence[int]] = DEFAULT_ANCHOR_MASKS,
+                 ignore_thresh: float = 0.7):
+        super().__init__()
+        self.num_classes = num_classes
+        self.anchors = list(anchors)
+        self.anchor_masks = [list(m) for m in anchor_masks]
+        self.ignore_thresh = ignore_thresh
+        self.backbone = DarkNet53()
+        self.blocks = nn.LayerList()
+        self.heads = nn.LayerList()
+        self.routes = nn.LayerList()
+        out_per_anchor = 5 + num_classes
+        in_chs = [1024, 768, 384]  # C5; C4+route; C3+route
+        chs = [512, 256, 128]
+        for i, (ic, ch, m) in enumerate(zip(in_chs, chs, self.anchor_masks)):
+            self.blocks.append(YoloDetectionBlock(ic, ch))
+            self.heads.append(nn.Conv2D(ch * 2, len(m) * out_per_anchor, 1))
+            if i < 2:
+                self.routes.append(ConvBNLayer(ch, ch // 2, k=1))
+
+    def forward(self, x):
+        c3, c4, c5 = self.backbone(x)
+        outs = []
+        feat = c5
+        for i, skip in enumerate([None, c4, c3]):
+            if skip is not None:
+                feat = jnp.concatenate([feat, skip], axis=1)
+            route, tip = self.blocks[i](feat)
+            outs.append(self.heads[i](tip))
+            if i < 2:
+                r = self.routes[i](route)
+                feat = nn.functional.interpolate(r, scale_factor=2,
+                                                 mode="nearest")
+        return outs  # strides 32, 16, 8
+
+    def loss(self, heads, gt_box, gt_label, gt_score=None):
+        """Summed yolo_loss over the three heads; returns mean over batch."""
+        total = 0.0
+        for out, m, ds in zip(heads, self.anchor_masks, (32, 16, 8)):
+            total = total + ops.yolo_loss(
+                out, gt_box, gt_label, anchors=self.anchors, anchor_mask=m,
+                class_num=self.num_classes, ignore_thresh=self.ignore_thresh,
+                downsample_ratio=ds, gt_score=gt_score)
+        return total.mean()
+
+    def predict(self, heads, img_size, conf_thresh: float = 0.01,
+                nms_threshold: float = 0.45, keep_top_k: int = 100):
+        """Decode + per-class NMS. img_size: [N, 2] (h, w).
+        Returns (dets [N, keep_top_k, 6], num_valid [N])."""
+        boxes_all, scores_all = [], []
+        for out, m, ds in zip(heads, self.anchor_masks, (32, 16, 8)):
+            anc = []
+            for idx in m:
+                anc += self.anchors[2 * idx:2 * idx + 2]
+            b, s = ops.yolo_box(out, img_size, anchors=anc,
+                                class_num=self.num_classes,
+                                conf_thresh=conf_thresh, downsample_ratio=ds)
+            boxes_all.append(b)
+            scores_all.append(s)
+        boxes = jnp.concatenate(boxes_all, axis=1)      # [N, M, 4]
+        scores = jnp.concatenate(scores_all, axis=1)    # [N, M, C]
+        return jax.vmap(lambda bb, ss: ops.multiclass_nms(
+            bb, ss.T, score_threshold=conf_thresh, nms_threshold=nms_threshold,
+            keep_top_k=keep_top_k))(boxes, scores)
+
+
+def yolov3_darknet53(num_classes: int = 80, **kwargs) -> YOLOv3:
+    return YOLOv3(num_classes=num_classes, **kwargs)

@@ -1,0 +1,254 @@
+"""Detection ops + YOLOv3 model.
+
+Mirrors the reference OpTest pattern (unittests/test_yolo_box_op.py,
+test_multiclass_nms_op.py, test_roi_align_op.py, test_iou_similarity_op.py):
+numpy oracles checked against the op outputs; plus a model-level smoke that
+the full detector jits, trains a step, and predicts fixed-size detections.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+from paddle_tpu import ops
+
+
+# ---------------------------------------------------------------- helpers --
+def np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def test_iou_similarity_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.rand(5, 4).astype(np.float32)
+    a[:, 2:] += a[:, :2]  # ensure x2>x1, y2>y1
+    b = rng.rand(7, 4).astype(np.float32)
+    b[:, 2:] += b[:, :2]
+    got = np.asarray(ops.iou_similarity(a, b))
+    np.testing.assert_allclose(got, np_iou(a, b), rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = rng.rand(6, 4).astype(np.float32)
+    priors[:, 2:] += priors[:, :2] + 0.1
+    targets = rng.rand(3, 4).astype(np.float32)
+    targets[:, 2:] += targets[:, :2] + 0.1
+    enc = ops.box_coder(priors, None, targets, "encode_center_size")
+    assert enc.shape == (3, 6, 4)
+    dec = ops.box_coder(priors, None, enc, "decode_center_size")
+    # decoding the encoding against the same priors must return the targets
+    want = np.broadcast_to(targets[:, None, :], (3, 6, 4))
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-4, atol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[-5.0, -5.0, 50.0, 80.0]], np.float32)
+    got = np.asarray(ops.box_clip(boxes, (32, 64)))  # h=32, w=64
+    np.testing.assert_allclose(got, [[0, 0, 50, 31]])
+
+
+def test_anchor_generator_shapes_and_geometry():
+    anchors, var = ops.anchor_generator(
+        (4, 6), anchor_sizes=[64, 128], aspect_ratios=[1.0], stride=(16, 16))
+    assert anchors.shape == (4, 6, 2, 4) and var.shape == anchors.shape
+    a = np.asarray(anchors)
+    # first cell center at (0.5*16, 0.5*16); ratio 1 -> square, side = size
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 32, 8 - 32, 8 + 32, 8 + 32])
+    np.testing.assert_allclose(a[0, 0, 1], [8 - 64, 8 - 64, 8 + 64, 8 + 64])
+
+
+def test_prior_box_normalized_and_clipped():
+    boxes, var = ops.prior_box((2, 2), (64, 64), min_sizes=[32],
+                               max_sizes=[64], aspect_ratios=[1.0, 2.0],
+                               flip=True, clip=True)
+    b = np.asarray(boxes)
+    # P = |min|*(|ratios incl. flip|) + |min|*|max| = 3 + 1 = 4
+    assert b.shape == (2, 2, 4, 4)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_prior_box_pairs_min_max_and_implicit_ratio1():
+    # min/max pair 1:1 (not cross-product) and ratio 1.0 is implicit
+    boxes, _ = ops.prior_box((1, 1), (64, 64), min_sizes=[32, 64],
+                             max_sizes=[64, 128], aspect_ratios=[2.0])
+    # per min size: ratios [1.0, 2.0] + one sqrt(min*max) prior = 3 → 6 total
+    assert boxes.shape == (1, 1, 6, 4)
+    with pytest.raises(ValueError, match="pair"):
+        ops.prior_box((1, 1), (64, 64), min_sizes=[32, 64], max_sizes=[64])
+
+
+def test_roi_align_out_of_image_contributes_zero():
+    feat = np.full((1, 8, 8), 5.0, np.float32)
+    # roi mostly outside the map: out-of-image bins must be 0, not 5
+    out = np.asarray(ops.roi_align(feat, np.array([[-20., -20., 2., 2.]]),
+                                   output_size=2))
+    assert out[0, 0, 0, 0] == 0.0          # far outside
+    assert out[0, 0, 1, 1] > 0.0           # inside corner
+
+
+def test_yolo_box_decode_against_numpy():
+    rng = np.random.RandomState(2)
+    A, C, H, W = 2, 3, 2, 2
+    anchors = [10, 14, 23, 27]
+    x = rng.randn(1, A * (5 + C), H, W).astype(np.float32)
+    img_size = np.array([[64, 64]], np.int32)
+    ds = 32
+    boxes, scores = ops.yolo_box(x, img_size, anchors, C, conf_thresh=0.0,
+                                 downsample_ratio=ds, clip_bbox=False)
+    assert boxes.shape == (1, A * H * W, 4)
+    assert scores.shape == (1, A * H * W, C)
+    # numpy oracle for anchor 0, cell (0, 0)
+    xr = x.reshape(1, A, 5 + C, H, W)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    cx = (sig(xr[0, 0, 0, 0, 0]) + 0) / W
+    cy = (sig(xr[0, 0, 1, 0, 0]) + 0) / H
+    bw = np.exp(xr[0, 0, 2, 0, 0]) * anchors[0] / (ds * W)
+    bh = np.exp(xr[0, 0, 3, 0, 0]) * anchors[1] / (ds * H)
+    want = [(cx - bw / 2) * 64, (cy - bh / 2) * 64,
+            (cx + bw / 2) * 64, (cy + bh / 2) * 64]
+    np.testing.assert_allclose(np.asarray(boxes)[0, 0], want, rtol=1e-4)
+    obj = sig(xr[0, 0, 4, 0, 0])
+    want_score = obj * sig(xr[0, 0, 5, 0, 0])
+    np.testing.assert_allclose(np.asarray(scores)[0, 0, 0], want_score,
+                               rtol=1e-4)
+
+
+def test_yolo_box_conf_threshold_zeroes():
+    x = np.full((1, 7, 1, 1), -10.0, np.float32)  # sigmoid(obj) ~ 0
+    boxes, scores = ops.yolo_box(x, [[32, 32]], [10, 14], 2,
+                                 conf_thresh=0.5, downsample_ratio=32)
+    assert np.allclose(np.asarray(boxes), 0) and np.allclose(np.asarray(scores), 0)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two heavily overlapping boxes + one distinct, single class
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)  # [C=1, M=3]
+    dets, n = ops.multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_threshold=0.5, keep_top_k=5)
+    dets = np.asarray(dets)
+    assert int(n) == 2
+    # sorted by score: 0.9 box then 0.7 box; middle suppressed
+    np.testing.assert_allclose(dets[0, 1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(dets[0, 2:], [0, 0, 10, 10])
+    np.testing.assert_allclose(dets[1, 1], 0.7, rtol=1e-6)
+    assert (dets[2:, 0] == -1).all()  # padding rows
+
+
+def test_multiclass_nms_multiclass_and_background():
+    boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    scores = np.array([[0.9, 0.85],   # class 0 (background)
+                       [0.6, 0.7]], np.float32)
+    dets, n = ops.multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_threshold=0.5, keep_top_k=4,
+                                 background_label=0)
+    dets = np.asarray(dets)
+    assert int(n) == 2
+    assert set(dets[:2, 0].astype(int)) == {1}  # only class 1 kept
+
+
+def test_multiclass_nms_under_jit():
+    boxes = jnp.asarray(np.random.RandomState(3).rand(20, 4), jnp.float32)
+    boxes = boxes.at[:, 2:].add(boxes[:, :2])
+    scores = jnp.asarray(np.random.RandomState(4).rand(3, 20), jnp.float32)
+    f = jax.jit(lambda b, s: ops.multiclass_nms(b, s, keep_top_k=10))
+    dets, n = f(boxes, scores)
+    assert dets.shape == (10, 6)
+    assert 0 <= int(n) <= 10
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every pooled value equals the constant
+    feat = np.full((3, 8, 8), 2.5, np.float32)
+    rois = np.array([[0, 0, 4, 4], [2, 2, 7, 7]], np.float32)
+    out = np.asarray(ops.roi_align(feat, rois, output_size=2))
+    assert out.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(out, 2.5, rtol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    feat = jnp.asarray(np.random.RandomState(5).rand(1, 8, 8), jnp.float32)
+    rois = jnp.asarray([[1.0, 1.0, 6.0, 6.0]])
+    g = jax.grad(lambda f: ops.roi_align(f, rois, 2).sum())(feat)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
+
+
+def test_yolo_loss_padding_rows_do_not_clobber():
+    # regression: a padded gt row (w=0) after a real gt assigned to anchor 0
+    # at cell (0,0) must not erase that target via a clamped scatter
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 2 * 7, 4, 4).astype(np.float32)  # 2 anchors, C=2
+    anchors = [10, 14, 23, 27]
+    # gt centered in cell (0,0); anchor sizes chosen so anchor 0 wins
+    gt_real = np.array([[[0.06, 0.06, 0.08, 0.10]]], np.float32)
+    lbl_real = np.array([[1]])
+    l_no_pad = float(ops.yolo_loss(x, gt_real, lbl_real, anchors, [0, 1], 2,
+                                   downsample_ratio=32)[0])
+    gt_padded = np.concatenate(
+        [gt_real, np.zeros((1, 1, 4), np.float32)], axis=1)
+    lbl_padded = np.concatenate([lbl_real, np.zeros((1, 1), np.int64)], axis=1)
+    l_pad = float(ops.yolo_loss(x, gt_padded, lbl_padded, anchors, [0, 1], 2,
+                                downsample_ratio=32)[0])
+    np.testing.assert_allclose(l_pad, l_no_pad, rtol=1e-6)
+
+
+def test_box_coder_axis1_validation():
+    priors = np.random.rand(6, 4).astype(np.float32)
+    target = np.random.rand(3, 6, 4).astype(np.float32)
+    with pytest.raises(ValueError, match="priors"):
+        ops.box_coder(priors, None, target, "decode_center_size", axis=1)
+
+
+# ------------------------------------------------------------------ model --
+@pytest.fixture(scope="module")
+def tiny_yolo():
+    from paddle_tpu.vision.models import YOLOv3
+    return YOLOv3(num_classes=4)
+
+
+def test_yolov3_forward_shapes(tiny_yolo):
+    x = jnp.zeros((1, 3, 96, 96), jnp.float32)
+    heads = tiny_yolo(x)
+    # strides 32, 16, 8; 3 anchors each; 5+4 channels per anchor
+    assert [tuple(h.shape) for h in heads] == [
+        (1, 27, 3, 3), (1, 27, 6, 6), (1, 27, 12, 12)]
+
+
+def test_yolov3_loss_and_grad(tiny_yolo):
+    from paddle_tpu.autograd import functional_call, parameters_dict
+    params = parameters_dict(tiny_yolo)
+    x = jnp.asarray(np.random.RandomState(6).rand(2, 3, 96, 96), jnp.float32)
+    gt_box = jnp.asarray([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1]],
+                          [[0.7, 0.2, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]],
+                         jnp.float32)  # second image has 1 padded gt
+    gt_label = jnp.asarray([[1, 3], [0, 0]])
+
+    def loss_fn(p):
+        heads = functional_call(tiny_yolo, p, (x,))
+        return tiny_yolo.loss(heads, gt_box, gt_label)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    assert any(np.abs(np.asarray(g)).sum() > 0 for g in flat)
+
+
+def test_yolov3_predict_fixed_size(tiny_yolo):
+    tiny_yolo.eval()
+    x = jnp.asarray(np.random.RandomState(7).rand(1, 3, 96, 96), jnp.float32)
+    heads = tiny_yolo(x)
+    img_size = jnp.asarray([[96, 96]], jnp.int32)
+    dets, n = tiny_yolo.predict(heads, img_size, keep_top_k=20)
+    assert dets.shape == (1, 20, 6)
+    assert 0 <= int(n[0]) <= 20
+    tiny_yolo.train()

@@ -1,0 +1,136 @@
+"""Sequence (LoD-family) ops on the padded+lengths / segment-ids forms.
+
+Mirrors the reference per-op tests (unittests/test_sequence_pool.py,
+test_sequence_softmax_op.py, test_sequence_reverse.py, test_sequence_pad_op.py,
+test_sequence_mask.py, test_sequence_expand.py, test_sequence_slice_op.py)
+with numpy oracles over ragged lists."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import ops
+
+RAGGED = [np.array([[1.0, 2], [3, 4], [5, 6]], np.float32),   # len 3
+          np.array([[7.0, 8]], np.float32),                   # len 1
+          np.zeros((0, 2), np.float32)]                       # len 0
+
+
+def _padded(maxlen=4):
+    B = len(RAGGED)
+    x = np.zeros((B, maxlen, 2), np.float32)
+    lens = np.zeros(B, np.int32)
+    for i, r in enumerate(RAGGED):
+        x[i, :len(r)] = r
+        lens[i] = len(r)
+    return x, lens
+
+
+def test_sequence_mask():
+    m = np.asarray(ops.sequence_mask([3, 1, 0], maxlen=4))
+    want = [[1, 1, 1, 0], [1, 0, 0, 0], [0, 0, 0, 0]]
+    np.testing.assert_array_equal(m, np.asarray(want, bool))
+    with pytest.raises(ValueError):
+        ops.sequence_mask([1], maxlen=None)
+
+
+@pytest.mark.parametrize("ptype,expect", [
+    ("sum", [[9, 12], [7, 8], [0, 0]]),
+    ("mean", [[3, 4], [7, 8], [0, 0]]),
+    ("sqrt", [[9 / np.sqrt(3), 12 / np.sqrt(3)], [7, 8], [0, 0]]),
+    ("max", [[5, 6], [7, 8], [0, 0]]),
+    ("first", [[1, 2], [7, 8], [0, 0]]),
+    ("last", [[5, 6], [7, 8], [0, 0]]),
+])
+def test_sequence_pool(ptype, expect):
+    x, lens = _padded()
+    got = np.asarray(ops.sequence_pool(x, lens, ptype))
+    np.testing.assert_allclose(got, np.asarray(expect, np.float32), rtol=1e-6)
+
+
+def test_sequence_softmax():
+    x, lens = _padded()
+    got = np.asarray(ops.sequence_softmax(x[..., 0], lens))
+    for i, r in enumerate(RAGGED):
+        L = len(r)
+        if L:
+            e = np.exp(r[:, 0] - r[:, 0].max())
+            np.testing.assert_allclose(got[i, :L], e / e.sum(), rtol=1e-5)
+        assert np.allclose(got[i, L:], 0)
+
+
+def test_sequence_reverse():
+    x, lens = _padded()
+    got = np.asarray(ops.sequence_reverse(x, lens))
+    np.testing.assert_allclose(got[0, :3], RAGGED[0][::-1])
+    np.testing.assert_allclose(got[0, 3:], 0)  # padding untouched
+    np.testing.assert_allclose(got[1, 0], RAGGED[1][0])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    # flattened LoD stream: segments 0,0,0,1 (sorted)
+    values = np.concatenate([RAGGED[0], RAGGED[1]], axis=0)
+    seg = np.array([0, 0, 0, 1])
+    padded, lens = ops.sequence_pad(values, seg, batch=3, maxlen=4)
+    x, want_lens = _padded()
+    np.testing.assert_allclose(np.asarray(padded), x)
+    np.testing.assert_array_equal(np.asarray(lens), want_lens)
+
+    flat, seg2, mask = ops.sequence_unpad(padded, lens)
+    valid = np.asarray(flat)[np.asarray(mask)]
+    np.testing.assert_allclose(valid, values)
+    np.testing.assert_array_equal(np.asarray(seg2)[np.asarray(mask)], seg)
+
+
+def test_sequence_pad_clamps_lengths_to_maxlen():
+    vals = np.arange(6, dtype=np.float32)[:, None]
+    seg = np.zeros(6, np.int64)
+    padded, lens = ops.sequence_pad(vals, seg, batch=1, maxlen=4)
+    assert int(np.asarray(lens)[0]) == 4  # not 6
+    # downstream invariant holds: mean over stored elements
+    m = np.asarray(ops.sequence_pool(padded, lens, "mean"))
+    np.testing.assert_allclose(m[0, 0], (0 + 1 + 2 + 3) / 4)
+
+
+def test_sequence_expand():
+    x = np.array([[[1.0], [2.0], [0.0]], [[5.0], [0.0], [0.0]]], np.float32)
+    lens = np.array([2, 1])
+    out, new_len = ops.sequence_expand(x, lens, ref_lengths=[2, 3], maxlen=4)
+    out = np.asarray(out)[..., 0]
+    np.testing.assert_allclose(out[0], [1, 2, 1, 2])   # tiled twice
+    np.testing.assert_allclose(out[1], [5, 5, 5, 0])   # tiled thrice, padded
+    np.testing.assert_array_equal(np.asarray(new_len), [4, 3])
+
+
+def test_sequence_slice():
+    x, lens = _padded()
+    y, nl = ops.sequence_slice(x, lens, offset=[1, 0, 0], length=[2, 1, 1])
+    y = np.asarray(y)
+    np.testing.assert_allclose(y[0, :2], RAGGED[0][1:3])
+    np.testing.assert_allclose(y[1, 0], RAGGED[1][0])
+    np.testing.assert_array_equal(np.asarray(nl), [2, 1, 0])
+
+
+def test_segment_reductions():
+    vals = np.array([1.0, 2, 3, 10, 20], np.float32)
+    seg = np.array([0, 0, 0, 2, 2])
+    s = np.asarray(ops.segment_sum(vals, seg, 3))
+    np.testing.assert_allclose(s, [6, 0, 30])
+    m = np.asarray(ops.segment_mean(vals, seg, 3))
+    np.testing.assert_allclose(m, [2, 0, 15])
+    mx = np.asarray(ops.segment_max(vals, seg, 3))
+    assert mx[0] == 3 and mx[2] == 20
+
+
+def test_sequence_ops_jit_and_grad():
+    x, lens = _padded()
+
+    @jax.jit
+    def f(x):
+        return ops.sequence_pool(x, lens, "mean").sum()
+
+    g = jax.grad(f)(jnp.asarray(x))
+    g = np.asarray(g)
+    # gradient flows only into valid positions
+    assert np.abs(g[0, :3]).sum() > 0 and np.allclose(g[0, 3:], 0)
+    assert np.allclose(g[2], 0)
