@@ -68,6 +68,7 @@ _LAZY_SUBMODULES = (
     "inference",
     "hapi",
     "metric",
+    "slim",
     "vision",
     "text",
     "utils",

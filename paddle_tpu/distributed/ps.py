@@ -33,6 +33,15 @@ __all__ = [
 ]
 
 
+def _merge_duplicate_ids(ids: np.ndarray, grads: np.ndarray):
+    """Sum grads of duplicate ids (the reference's SelectedRows MergeAdd
+    before send).  Returns (unique_ids, merged_grads)."""
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
+
+
 class _Shard:
     """One hash shard of a row-sharded table (ref: the per-pserver block of
     ParameterSend's row split).  Rows materialize lazily on first touch
@@ -104,18 +113,32 @@ class SparseTable:
     VarBlock split).  num_shards models the pserver count; shard(i) is the
     multi-host seam."""
 
+    _OPTIMIZERS = ("sgd", "adagrad", "adam")
+
     def __init__(self, dim: int, num_shards: int = 4,
                  initializer: Optional[Callable[[int], np.ndarray]] = None,
                  optimizer: str = "adagrad", seed: int = 0,
                  beta1: float = 0.9, beta2: float = 0.999):
-        if initializer is None:
-            rng = np.random.RandomState(seed)
-            scale = 1.0 / np.sqrt(dim)
-            initializer = lambda d: rng.uniform(-scale, scale, d)
+        if optimizer not in self._OPTIMIZERS:
+            # validated here, not at first push — a bad name must not kill
+            # the AsyncCommunicator worker thread mid-training
+            raise ValueError(f"unknown optimizer {optimizer!r}; "
+                             f"one of {self._OPTIMIZERS}")
         self.dim = dim
         self.num_shards = num_shards
-        self.shards = [_Shard(dim, initializer, optimizer, beta1, beta2)
-                       for _ in range(num_shards)]
+
+        def make_init(shard_idx):
+            if initializer is not None:
+                return initializer
+            # per-shard RNG: shards fault rows in from different threads
+            # (trainer pull vs async-communicator push) and numpy
+            # RandomState is not thread-safe
+            rng = np.random.RandomState(seed + shard_idx * 9973)
+            scale = 1.0 / np.sqrt(dim)
+            return lambda d: rng.uniform(-scale, scale, d)
+
+        self.shards = [_Shard(dim, make_init(i), optimizer, beta1, beta2)
+                       for i in range(num_shards)]
 
     def _route(self, ids: np.ndarray):
         ids = np.asarray(ids).reshape(-1)
@@ -137,9 +160,7 @@ class SparseTable:
         reference's MergeAdd on SelectedRows before send)."""
         ids, shard_of = self._route(ids)
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
-        uniq, inv = np.unique(ids, return_inverse=True)
-        merged = np.zeros((len(uniq), self.dim), np.float32)
-        np.add.at(merged, inv, grads)
+        uniq, merged = _merge_duplicate_ids(ids, grads)
         shard_of_u = uniq % self.num_shards
         for s in range(self.num_shards):
             m = shard_of_u == s
@@ -242,11 +263,11 @@ class AsyncCommunicator:
         self.lr = lr
         self.max_merge = max_merge
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
-        self._running = False
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        self._running = True
+        if self._thread is not None:
+            raise RuntimeError("AsyncCommunicator already started")
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -258,7 +279,6 @@ class AsyncCommunicator:
             self._q.put(None)  # sentinel: processed strictly after pending
             self._thread.join()
             self._thread = None
-        self._running = False
 
     def send(self, ids: np.ndarray, grads: np.ndarray) -> None:
         """Enqueue a sparse grad (blocks when the queue is full — the
@@ -323,10 +343,9 @@ class GeoCommunicator:
     def update_local(self, ids, grads, lr: float = 0.1) -> None:
         """Local SGD on the worker copy; counts toward the sync cadence."""
         ids = np.asarray(ids).reshape(-1)
+        self.pull(ids)  # fault in rows not yet seen by this worker
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
-        uniq, inv = np.unique(ids, return_inverse=True)
-        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
-        np.add.at(merged, inv, grads)
+        uniq, merged = _merge_duplicate_ids(ids, grads)
         for r, g in zip(uniq, merged):
             self._local[int(r)] -= lr * g
         self._step += 1
@@ -390,7 +409,13 @@ class HeartBeatMonitor:
                             to_report.append(w)
                 for w in to_report:
                     if self.on_dead is not None:
-                        self.on_dead(w)
+                        try:
+                            self.on_dead(w)
+                        except Exception:
+                            # a failing callback must not kill liveness
+                            # monitoring for every other worker
+                            import traceback
+                            traceback.print_exc()
                 time.sleep(interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -1,0 +1,27 @@
+"""paddle_tpu.slim — model compression (quantization).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/ —
+QuantizationTransformPass (quantization_pass.py: insert fake_quant/dequant
+around quantizable ops), ImperativeQuantAware (imperative/qat.py: swap
+Linear/Conv for quantized layers), PostTrainingQuantization
+(post_training_quantization.py: calibration then int8 weights+scales) and
+the fake-quant op family (operators/fake_quantize_op.cc: abs_max,
+moving_average_abs_max, channel_wise_abs_max).
+"""
+from .quant import (
+    ImperativeQuantAware,
+    PostTrainingQuantization,
+    QuantizedConv2D,
+    QuantizedLinear,
+    fake_channel_wise_quant_dequant_abs_max,
+    fake_quant_dequant_abs_max,
+    fake_quant_dequant_moving_average_abs_max,
+    quant_int8,
+)
+
+__all__ = [
+    "ImperativeQuantAware", "PostTrainingQuantization", "QuantizedLinear",
+    "QuantizedConv2D", "fake_quant_dequant_abs_max",
+    "fake_channel_wise_quant_dequant_abs_max",
+    "fake_quant_dequant_moving_average_abs_max", "quant_int8",
+]

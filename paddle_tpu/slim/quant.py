@@ -1,0 +1,287 @@
+"""Quantization: fake-quant ops (QAT), quantized layer wrappers, PTQ.
+
+Reference parity: operators/fake_quantize_op.cc (FakeQuantizeAbsMax,
+FakeChannelWiseQuantizeAbsMax, FakeQuantizeMovingAverageAbsMax — all
+quantize-dequantize with a straight-through gradient),
+slim/quantization/imperative/qat.py `ImperativeQuantAware` (layer swap),
+post_training_quantization.py (calibrate abs-max stats → int8 weights +
+scales).
+
+TPU-native notes: fake-quant trains in float with rounding noise — pure
+elementwise, fuses into the surrounding matmul under XLA.  Converted int8
+inference computes the contraction in int8 with int32 accumulation
+(`preferred_element_type`) — the MXU's native int8 path — then rescales.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.layer.base import Layer, Parameter
+
+
+# ------------------------------------------------------------ fake quant --
+def _ste(x, q):
+    """Straight-through estimator: forward q, backward identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quant_dequant_abs_max(x, bit_length: int = 8):
+    """Per-tensor abs-max quantize-dequantize (ref FakeQuantizeAbsMax).
+    Returns (y, scale)."""
+    x = jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.round(x / scale * qmax) / qmax * scale
+    return _ste(x, q), scale
+
+
+def fake_channel_wise_quant_dequant_abs_max(w, bit_length: int = 8,
+                                            quant_axis: int = 0):
+    """Per-output-channel abs-max for weights (ref
+    FakeChannelWiseQuantizeAbsMax).  Returns (y, scales[channels])."""
+    w = jnp.asarray(w)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(w.ndim) if i != quant_axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=axes), 1e-8)
+    shape = [1] * w.ndim
+    shape[quant_axis] = -1
+    s = scale.reshape(shape)
+    q = jnp.round(w / s * qmax) / qmax * s
+    return _ste(w, q), scale
+
+
+def fake_quant_dequant_moving_average_abs_max(x, state, bit_length: int = 8,
+                                              moving_rate: float = 0.9,
+                                              training: bool = True):
+    """Activation quant with EMA abs-max scale (ref
+    FakeQuantizeMovingAverageAbsMax).  state: scalar EMA scale.
+    Returns (y, new_state)."""
+    x = jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    state = jnp.asarray(state)
+    if training:
+        new_state = jnp.where(state > 0,
+                              moving_rate * state + (1 - moving_rate) * cur,
+                              cur)
+        s = new_state
+    else:
+        new_state = state
+        # uncalibrated scale (e.g. the EMA buffer could not update because
+        # training ran under trace): fall back to dynamic per-batch abs-max
+        # instead of quantizing against a garbage epsilon scale
+        s = jnp.where(state > 0, state, cur)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) / qmax * s
+    return _ste(x, q), new_state
+
+
+def quant_int8(w, quant_axis: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Convert a float weight to (int8 array, per-channel float scales) —
+    the PTQ weight path (ref post_training_quantization.py _quantize_weight)."""
+    w = np.asarray(w, np.float32)
+    axes = tuple(i for i in range(w.ndim) if i != quant_axis)
+    scale = np.maximum(np.abs(w).max(axis=axes), 1e-8)
+    shape = [1] * w.ndim
+    shape[quant_axis] = -1
+    q = np.clip(np.round(w / scale.reshape(shape) * 127.0), -128, 127)
+    return q.astype(np.int8), (scale / 127.0).astype(np.float32)
+
+
+# -------------------------------------------------------- QAT layer swap --
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight (channel-wise) and activation
+    (moving-average) — ref imperative/quant_nn.py QuantizedLinear."""
+
+    def __init__(self, layer: "nn.Linear", weight_bits: int = 8,
+                 activation_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        # EMA scale lives in a buffer so it ships with state_dict
+        self.register_buffer("in_scale", jnp.zeros(()))
+
+    def forward(self, x):
+        x_q, new_scale = fake_quant_dequant_moving_average_abs_max(
+            x, self._buffers["in_scale"].value, self.activation_bits,
+            self.moving_rate, training=self.training)
+        if self.training and not isinstance(new_scale, jax.core.Tracer):
+            # eager-mode EMA update; under trace (value_and_grad/jit) the
+            # buffer is read-only — same idiom as BatchNorm running stats
+            self._buffers["in_scale"].value = new_scale
+        # weight layout (in, out): output channels on axis 1
+        w_q, _ = fake_channel_wise_quant_dequant_abs_max(
+            self.inner.weight.value, self.weight_bits, quant_axis=1)
+        b = None if self.inner.bias is None else self.inner.bias.value
+        return F.linear(x_q, w_q, b)
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with fake-quantized weight/activation — ref QuantizedConv2D."""
+
+    def __init__(self, layer: "nn.Conv2D", weight_bits: int = 8,
+                 activation_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("in_scale", jnp.zeros(()))
+
+    def forward(self, x):
+        x_q, new_scale = fake_quant_dequant_moving_average_abs_max(
+            x, self._buffers["in_scale"].value, self.activation_bits,
+            self.moving_rate, training=self.training)
+        if self.training and not isinstance(new_scale, jax.core.Tracer):
+            # eager-mode EMA update; under trace (value_and_grad/jit) the
+            # buffer is read-only — same idiom as BatchNorm running stats
+            self._buffers["in_scale"].value = new_scale
+        w_q, _ = fake_channel_wise_quant_dequant_abs_max(
+            self.inner.weight.value, self.weight_bits, quant_axis=0)
+        b = None if self.inner.bias is None else self.inner.bias.value
+        inner = self.inner
+        return F.conv2d(x_q, w_q, b, stride=inner.stride,
+                        padding=inner.padding, dilation=inner.dilation,
+                        groups=inner.groups, data_format=inner.data_format)
+
+
+_DEFAULT_QUANTIZABLE = ("Linear", "Conv2D")
+
+
+class ImperativeQuantAware:
+    """QAT driver (ref imperative/qat.py:ImperativeQuantAware): walks the
+    Layer tree and swaps quantizable layers for fake-quant wrappers in
+    place; the model then trains normally and `state_dict` carries the
+    learned activation scales."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9,
+                 quantizable_layer_type: Sequence[str] = _DEFAULT_QUANTIZABLE):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.types = tuple(quantizable_layer_type)
+
+    def _wrap(self, layer: Layer) -> Layer:
+        name = type(layer).__name__
+        if name == "Linear" and "Linear" in self.types:
+            return QuantizedLinear(layer, self.weight_bits,
+                                   self.activation_bits, self.moving_rate)
+        if name == "Conv2D" and "Conv2D" in self.types:
+            return QuantizedConv2D(layer, self.weight_bits,
+                                   self.activation_bits, self.moving_rate)
+        return layer
+
+    def quantize(self, model: Layer) -> Layer:
+        for name, child in list(model._sub_layers.items()):
+            wrapped = self._wrap(child)
+            if wrapped is not child:
+                model._sub_layers[name] = wrapped
+            else:
+                self.quantize(child)
+        return model
+
+
+# ------------------------------------------------------------------- PTQ --
+class _CalibHook(Layer):
+    """Records activation abs-max during calibration forward passes."""
+
+    def __init__(self, layer: Layer):
+        super().__init__()
+        self.inner = layer
+        self.abs_max = 0.0
+
+    def forward(self, x, *args, **kwargs):
+        self.abs_max = max(self.abs_max, float(jnp.max(jnp.abs(x))))
+        return self.inner(x, *args, **kwargs)
+
+
+class Int8Linear(Layer):
+    """Converted serving layer: int8 weight, int32 accumulation on the MXU,
+    float rescale (ref: the program a quantized inference model executes)."""
+
+    def __init__(self, w_int8: np.ndarray, w_scale: np.ndarray,
+                 bias: Optional[np.ndarray], in_scale: float,
+                 activation_bits: int = 8):
+        super().__init__()
+        self.register_buffer("w_int8", jnp.asarray(w_int8))      # (in, out)
+        self.register_buffer("w_scale", jnp.asarray(w_scale))    # (out,)
+        if bias is not None:
+            self.register_buffer("bias", jnp.asarray(bias))
+        self.has_bias = bias is not None
+        self.in_scale = float(in_scale)
+        self.qmax = float(2 ** (activation_bits - 1) - 1)
+
+    def forward(self, x):
+        s_in = self.in_scale / self.qmax
+        x_q = jnp.clip(jnp.round(jnp.asarray(x) / s_in),
+                       -self.qmax - 1, self.qmax).astype(jnp.int8)
+        w = self._buffers["w_int8"].value
+        w_scale = self._buffers["w_scale"].value
+        acc = jax.lax.dot_general(
+            x_q, w,
+            (((x_q.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (w_scale * s_in)
+        if self.has_bias:
+            y = y + self._buffers["bias"].value
+        return y
+
+
+class PostTrainingQuantization:
+    """PTQ driver (ref post_training_quantization.py): calibrate activation
+    ranges on sample data, then convert Linear layers to Int8Linear.
+
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_loader: ptq.sample(batch)   # runs forward
+        qmodel = ptq.convert()
+    """
+
+    def __init__(self, model: Layer, activation_bits: int = 8):
+        self.model = model
+        self.activation_bits = activation_bits
+        self._hooked: List[Tuple[Layer, str, _CalibHook]] = []
+        self._install(model)
+
+    def _install(self, layer: Layer):
+        for name, child in list(layer._sub_layers.items()):
+            if type(child).__name__ == "Linear":
+                hook = _CalibHook(child)
+                layer._sub_layers[name] = hook
+                self._hooked.append((layer, name, hook))
+            else:
+                self._install(child)
+
+    def sample(self, *args, **kwargs):
+        """One calibration forward pass."""
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            return self.model(*args, **kwargs)
+        finally:
+            if was_training:
+                self.model.train()
+
+    def convert(self) -> Layer:
+        """Replace hooked Linears with Int8Linear using calibrated scales;
+        returns the model (mutated in place)."""
+        for parent, name, hook in self._hooked:
+            lin = hook.inner
+            if hook.abs_max <= 0:
+                raise RuntimeError(
+                    f"layer {name!r} saw no calibration data; call sample() "
+                    "with representative batches before convert()")
+            w_int8, w_scale = quant_int8(np.asarray(lin.weight.value),
+                                         quant_axis=1)
+            bias = None if lin.bias is None else np.asarray(lin.bias.value)
+            parent._sub_layers[name] = Int8Linear(
+                w_int8, w_scale, bias, hook.abs_max, self.activation_bits)
+        self._hooked = []
+        return self.model

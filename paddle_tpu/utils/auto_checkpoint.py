@@ -86,10 +86,14 @@ class AutoCheckpoint:
         self._gc(epoch)
 
     def _gc(self, newest: int) -> None:
+        # saves are sequential, so at most the one dir that just fell out of
+        # the keep window exists; stop at the first missing dir (O(1) per
+        # save instead of scanning to epoch 0 — matters on NFS)
         for e in range(newest - self.keep_last, -1, -1):
             d = self._epoch_dir(e)
-            if os.path.exists(d):
-                shutil.rmtree(d)
+            if not os.path.exists(d):
+                break
+            shutil.rmtree(d)
 
     def load(self, epoch: int) -> Any:
         return _ckpt.load(os.path.join(self._epoch_dir(epoch), "state"))

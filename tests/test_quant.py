@@ -1,0 +1,135 @@
+"""Quantization: fake-quant numerics, STE gradients, QAT swap, PTQ int8.
+
+Mirrors the reference's slim tests (test_fake_quantize_op.py numerics,
+test_imperative_qat.py train-after-swap, test_post_training_quantization_*
+accuracy-drop bound)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+import paddle_tpu.nn as nn
+from paddle_tpu import slim
+from paddle_tpu.autograd import functional_call, parameters_dict
+from paddle_tpu.optimizer import Adam
+
+
+def test_fake_quant_abs_max_numerics():
+    x = np.array([-1.0, -0.5, 0.0, 0.5, 1.0], np.float32)
+    y, scale = slim.fake_quant_dequant_abs_max(x, bit_length=8)
+    assert float(scale) == 1.0
+    # values representable on the 127-level grid stay close
+    np.testing.assert_allclose(np.asarray(y), x, atol=1.0 / 127)
+
+
+def test_fake_quant_channel_wise_scales():
+    w = np.stack([np.full(4, 0.5), np.full(4, 2.0)]).astype(np.float32)  # [2,4]
+    y, scales = slim.fake_channel_wise_quant_dequant_abs_max(w, quant_axis=0)
+    np.testing.assert_allclose(np.asarray(scales), [0.5, 2.0])
+    np.testing.assert_allclose(np.asarray(y), w, atol=2.0 / 127)
+
+
+def test_fake_quant_ste_gradient_is_identity():
+    x = jnp.asarray(np.linspace(-0.9, 0.9, 7, dtype=np.float32))
+    g = jax.grad(lambda v: slim.fake_quant_dequant_abs_max(v)[0].sum())(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones(7), rtol=1e-6)
+
+
+def test_moving_average_state_machine():
+    x = np.ones(4, np.float32) * 2.0
+    y, s1 = slim.fake_quant_dequant_moving_average_abs_max(x, 0.0)
+    assert float(s1) == 2.0           # first step adopts current max
+    y, s2 = slim.fake_quant_dequant_moving_average_abs_max(
+        x * 2, s1, moving_rate=0.9)
+    np.testing.assert_allclose(float(s2), 0.9 * 2.0 + 0.1 * 4.0)
+    # eval mode: state frozen
+    y, s3 = slim.fake_quant_dequant_moving_average_abs_max(
+        x * 10, s2, training=False)
+    assert float(s3) == float(s2)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_qat_swaps_layers_and_trains():
+    net = Net()
+    slim.ImperativeQuantAware().quantize(net)
+    assert type(net.fc1).__name__ == "QuantizedLinear"
+    assert type(net.fc2).__name__ == "QuantizedLinear"
+    # QAT training converges on a synthetic task
+    rng = np.random.RandomState(0)
+    X = rng.rand(128, 8).astype(np.float32)
+    Y = (X @ rng.randn(8, 4)).argmax(1).astype(np.int64)  # linearly separable
+    params = parameters_dict(net)
+    opt = Adam(learning_rate=1e-2, parameters=params)
+    state = opt.init(params)
+
+    def loss_fn(p, x, y):
+        return pd.nn.functional.cross_entropy(
+            functional_call(net, p, (x,)), y).mean()
+
+    # activation scales are stateful buffers -> keep the step un-jitted here
+    losses = []
+    vg = jax.value_and_grad(loss_fn)
+    for i in range(30):
+        l, g = vg(params, jnp.asarray(X), jnp.asarray(Y))
+        params, state = opt.update(g, state, params)
+        losses.append(float(l))
+    assert losses[-1] < 0.7 * losses[0]
+    # EMA activation scale was learned (nonzero buffer)
+    assert float(net.fc1._buffers["in_scale"].value) > 0
+
+
+def test_qat_conv_swap():
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+    slim.ImperativeQuantAware().quantize(m)
+    names = [type(l).__name__ for l in m.sublayers()]
+    assert "QuantizedConv2D" in names
+    out = m(jnp.asarray(np.random.rand(1, 3, 8, 8), jnp.float32))
+    assert out.shape == (1, 8, 8, 8)
+
+
+def test_quant_int8_roundtrip_error_bounded():
+    w = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+    q, s = slim.quant_int8(w, quant_axis=1)
+    assert q.dtype == np.int8
+    deq = q.astype(np.float32) * s[None, :]
+    assert np.abs(deq - w).max() <= np.abs(w).max() / 127 + 1e-6
+
+
+def test_ptq_convert_and_accuracy():
+    net = Net()
+    net.eval()
+    rng = np.random.RandomState(2)
+    X = rng.rand(64, 8).astype(np.float32)
+    ref = np.asarray(net(jnp.asarray(X)))
+
+    ptq = slim.PostTrainingQuantization(net)
+    for i in range(4):
+        ptq.sample(jnp.asarray(X[i * 16:(i + 1) * 16]))
+    qnet = ptq.convert()
+    assert type(qnet.fc1).__name__ == "Int8Linear"
+    assert qnet.fc1._buffers["w_int8"].value.dtype == jnp.int8
+    got = np.asarray(qnet(jnp.asarray(X)))
+    # int8 serving stays close to float32 reference
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert rel < 0.05, rel
+    # top-1 predictions preserved for the vast majority
+    agree = (got.argmax(1) == ref.argmax(1)).mean()
+    assert agree > 0.95
+
+
+def test_ptq_requires_calibration():
+    net = Net()
+    ptq = slim.PostTrainingQuantization(net)
+    with pytest.raises(RuntimeError, match="calibration"):
+        ptq.convert()
