@@ -28,6 +28,7 @@ from ..parallel.collective import (
     reduce_scatter,
     scatter,
 )
+from ..parallel.data_parallel import DataParallel, apply_collective_grads, scale_loss
 from ..parallel.fleet import DistributedStrategy, fleet
 
 alltoall = all_to_all

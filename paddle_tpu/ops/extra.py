@@ -1,0 +1,515 @@
+"""Long-tail tensor ops completing the paddle.tensor surface.
+
+Reference parity: the remaining python/paddle/tensor/ API (math.py stat
+ops, manipulation.py take/crop/unfold, linalg.py eig/lu/slogdet families,
+complex accessors in paddle/incubate/complex + tensor/attribute.py) and
+their operator/ kernels.  All thin, XLA-lowered jnp/lax compositions —
+elementwise pieces fuse away, linalg lowers to XLA's decomposition custom
+calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    # stats
+    "bincount", "median", "nanmedian", "quantile", "nanquantile", "corrcoef",
+    "cov", "count_nonzero", "diff", "mode",
+    # elementwise / math
+    "frac", "rad2deg", "deg2rad", "gcd", "lcm", "heaviside", "nextafter",
+    "angle", "conj", "real", "imag", "dist", "isclose", "renorm", "lerp",
+    "logaddexp", "ldexp", "copysign", "signbit", "sinc", "i0", "i0e", "i1",
+    "i1e", "polygamma", "digamma", "lgamma", "multigammaln", "erfinv",
+    "hypot", "square_",
+    # manipulation
+    "index_add", "index_put", "take", "bucketize", "crop", "unfold",
+    "as_strided", "view", "view_as", "moveaxis", "rot90", "atleast_1d",
+    "atleast_2d", "atleast_3d", "column_stack", "row_stack", "hstack",
+    "vstack", "dstack", "hsplit", "vsplit", "dsplit", "tensor_split",
+    "diagonal_scatter", "select_scatter", "slice_scatter",
+    # linalg
+    "tensordot", "inner", "mv", "lstsq", "eig", "eigvals", "eigh",
+    "eigvalsh", "lu", "slogdet", "matrix_rank", "vander", "householder_product",
+    "matrix_transpose", "diag_embed", "diagflat",
+]
+
+
+# ------------------------------------------------------------------- stats --
+def bincount(x, weights=None, minlength: int = 0):
+    # XLA needs a static length: use minlength when given, else host max
+    x = jnp.asarray(x)
+    length = int(minlength) if minlength else int(jnp.max(x)) + 1 if x.size else 0
+    return jnp.bincount(x, weights=weights, minlength=length, length=max(length, minlength))
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                        keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                           keepdims=keepdim)
+
+
+def corrcoef(x, rowvar: bool = True):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def diff(x, n: int = 1, axis: int = -1, prepend=None, append=None):
+    return jnp.diff(jnp.asarray(x), n=n, axis=axis, prepend=prepend,
+                    append=append)
+
+
+def mode(x, axis: int = -1, keepdim: bool = False):
+    """Most frequent value along axis (ref mode_op).  Returns (values,
+    indices); ties resolve to the smallest value like the reference."""
+    x = jnp.asarray(x)
+    x_moved = jnp.moveaxis(x, axis, -1)
+    sorted_x = jnp.sort(x_moved, axis=-1)
+    n = sorted_x.shape[-1]
+    # run-length via equality with previous element
+    eq = jnp.concatenate([jnp.zeros_like(sorted_x[..., :1], bool),
+                          sorted_x[..., 1:] == sorted_x[..., :-1]], -1)
+    # count of current run at each position
+    idxs = jnp.arange(n)
+    run_start = jnp.where(eq, 0, 1) * idxs
+    run_start = jax.lax.associative_scan(jnp.maximum, run_start, axis=-1)
+    run_len = idxs - run_start + 1
+    best = jnp.argmax(run_len, axis=-1)
+    values = jnp.take_along_axis(sorted_x, best[..., None], -1)[..., 0]
+    # index of first occurrence of the mode in the ORIGINAL array
+    match = x_moved == values[..., None]
+    indices = jnp.argmax(match, axis=-1)
+    if keepdim:
+        values = jnp.expand_dims(values, axis)
+        indices = jnp.expand_dims(indices, axis)
+    return values, indices
+
+
+# ------------------------------------------------------------- elementwise --
+def frac(x):
+    x = jnp.asarray(x)
+    return x - jnp.trunc(x)
+
+
+def rad2deg(x):
+    return jnp.degrees(jnp.asarray(x))
+
+
+def deg2rad(x):
+    return jnp.radians(jnp.asarray(x))
+
+
+def gcd(x, y):
+    return jnp.gcd(jnp.asarray(x), jnp.asarray(y))
+
+
+def lcm(x, y):
+    return jnp.lcm(jnp.asarray(x), jnp.asarray(y))
+
+
+def heaviside(x, y):
+    return jnp.heaviside(jnp.asarray(x), jnp.asarray(y))
+
+
+def nextafter(x, y):
+    return jnp.nextafter(jnp.asarray(x), jnp.asarray(y))
+
+
+def angle(x):
+    return jnp.angle(jnp.asarray(x))
+
+
+def conj(x):
+    return jnp.conj(jnp.asarray(x))
+
+
+def real(x):
+    return jnp.real(jnp.asarray(x))
+
+
+def imag(x):
+    return jnp.imag(jnp.asarray(x))
+
+
+def dist(x, y, p: float = 2):
+    d = jnp.asarray(x) - jnp.asarray(y)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(d.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(jnp.asarray(x), jnp.asarray(y), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def renorm(x, p: float, axis: int, max_norm: float):
+    """Renormalize sub-tensors along axis to at most max_norm in p-norm
+    (ref renorm_op)."""
+    x = jnp.asarray(x)
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def lerp(x, y, weight):
+    x = jnp.asarray(x)
+    return x + jnp.asarray(weight) * (jnp.asarray(y) - x)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(jnp.asarray(x), jnp.asarray(y))
+
+
+def ldexp(x, y):
+    return jnp.ldexp(jnp.asarray(x), jnp.asarray(y))
+
+
+def copysign(x, y):
+    return jnp.copysign(jnp.asarray(x), jnp.asarray(y))
+
+
+def signbit(x):
+    return jnp.signbit(jnp.asarray(x))
+
+
+def sinc(x):
+    return jnp.sinc(jnp.asarray(x))
+
+
+def i0(x):
+    return jax.scipy.special.i0(jnp.asarray(x))
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(jnp.asarray(x))
+
+
+def i1(x):
+    return jax.scipy.special.i1(jnp.asarray(x))
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(jnp.asarray(x))
+
+
+def polygamma(x, n: int):
+    return jax.scipy.special.polygamma(n, jnp.asarray(x))
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(jnp.asarray(x))
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(jnp.asarray(x))
+
+
+def multigammaln(x, p: int):
+    return jax.scipy.special.multigammaln(jnp.asarray(x), p)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(jnp.asarray(x))
+
+
+def hypot(x, y):
+    return jnp.hypot(jnp.asarray(x), jnp.asarray(y))
+
+
+def square_(x):
+    return jnp.square(jnp.asarray(x))
+
+
+# ------------------------------------------------------------ manipulation --
+def index_add(x, index, axis, value):
+    """x with value rows added at `index` along axis (ref index_add_op)."""
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index)
+    return x.at[tuple(idx)].add(jnp.asarray(value))
+
+
+def index_put(x, indices, value, accumulate: bool = False):
+    x = jnp.asarray(x)
+    indices = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[indices].add(jnp.asarray(value))
+    return x.at[indices].set(jnp.asarray(value))
+
+
+def take(x, index, mode: str = "raise"):
+    """Flattened-gather (ref take_op: treats x as 1-D)."""
+    x = jnp.asarray(x).reshape(-1)
+    index = jnp.asarray(index)
+    if mode == "wrap":
+        index = index % x.shape[0]
+    elif mode == "clip":
+        index = jnp.clip(index, 0, x.shape[0] - 1)
+    return x[index]
+
+
+def bucketize(x, sorted_sequence, out_int32: bool = False, right: bool = False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(x),
+                           side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def crop(x, shape, offsets=None):
+    """Static crop (ref crop_tensor_op)."""
+    x = jnp.asarray(x)
+    shape = [x.shape[i] if s in (-1, None) else int(s)
+             for i, s in enumerate(shape)]
+    offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (ref unfold_op): [N, C, H, W] -> [N, C*kh*kw, L]."""
+    x = jnp.asarray(x)
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    N, C, H, W = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                      j * dw:j * dw + (ow - 1) * sw + 1:sw]
+            patches.append(patch)
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return out.reshape(N, C * kh * kw, oh * ow)
+
+
+def as_strided(x, shape, stride, offset: int = 0):
+    """Strided view materialized as a gather (ref as_strided; jax arrays
+    are immutable so this is a copy with identical semantics)."""
+    x = jnp.asarray(x).reshape(-1)
+    idx = jnp.full(tuple(shape), offset)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        r = r.reshape((1,) * d + (s,) + (1,) * (len(shape) - d - 1))
+        idx = idx + r
+    return x[idx]
+
+
+def view(x, shape_or_dtype):
+    x = jnp.asarray(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(shape_or_dtype)
+    return x.view(shape_or_dtype)
+
+
+def view_as(x, other):
+    return jnp.asarray(x).reshape(jnp.asarray(other).shape)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(jnp.asarray(x), source, destination)
+
+
+def rot90(x, k: int = 1, axes=(0, 1)):
+    return jnp.rot90(jnp.asarray(x), k=k, axes=tuple(axes))
+
+
+def atleast_1d(*xs):
+    out = [jnp.atleast_1d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs):
+    out = [jnp.atleast_2d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs):
+    out = [jnp.atleast_3d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def column_stack(xs):
+    return jnp.column_stack([jnp.asarray(x) for x in xs])
+
+
+def row_stack(xs):
+    return jnp.vstack([jnp.asarray(x) for x in xs])
+
+
+def hstack(xs):
+    return jnp.hstack([jnp.asarray(x) for x in xs])
+
+
+def vstack(xs):
+    return jnp.vstack([jnp.asarray(x) for x in xs])
+
+
+def dstack(xs):
+    return jnp.dstack([jnp.asarray(x) for x in xs])
+
+
+def hsplit(x, num_or_indices):
+    return jnp.hsplit(jnp.asarray(x), num_or_indices)
+
+
+def vsplit(x, num_or_indices):
+    return jnp.vsplit(jnp.asarray(x), num_or_indices)
+
+
+def dsplit(x, num_or_indices):
+    return jnp.dsplit(jnp.asarray(x), num_or_indices)
+
+
+def tensor_split(x, num_or_indices, axis: int = 0):
+    return jnp.array_split(jnp.asarray(x), num_or_indices, axis=axis)
+
+
+def diagonal_scatter(x, y, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    x = jnp.asarray(x)
+    n = jnp.diagonal(x, offset, axis1, axis2).shape[-1]
+    i = jnp.arange(n)
+    r = i + (-offset if offset < 0 else 0)
+    c = i + (offset if offset > 0 else 0)
+    if x.ndim == 2 and axis1 == 0 and axis2 == 1:
+        return x.at[r, c].set(jnp.asarray(y))
+    xm = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    xm = xm.at[..., r, c].set(jnp.asarray(y))
+    return jnp.moveaxis(xm, (-2, -1), (axis1, axis2))
+
+
+def select_scatter(x, y, axis: int, index: int):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(jnp.asarray(y))
+
+
+def slice_scatter(x, y, axis: int = 0, start=None, stop=None, step: int = 1):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(start, stop, step)
+    return x.at[tuple(idx)].set(jnp.asarray(y))
+
+
+# ------------------------------------------------------------------ linalg --
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def inner(x, y):
+    return jnp.inner(jnp.asarray(x), jnp.asarray(y))
+
+
+def mv(x, vec):
+    return jnp.matmul(jnp.asarray(x), jnp.asarray(vec))
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(jnp.asarray(x), jnp.asarray(y),
+                                          rcond=rcond)
+    return sol, res, rank, sv
+
+
+def eig(x):
+    # XLA has no general eig on accelerators; jax routes via CPU callback
+    return jnp.linalg.eig(jnp.asarray(x))
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(jnp.asarray(x))
+
+
+def eigh(x, UPLO: str = "L"):
+    return jnp.linalg.eigh(jnp.asarray(x), UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO: str = "L"):
+    return jnp.linalg.eigvalsh(jnp.asarray(x), UPLO=UPLO)
+
+
+def lu(x, pivot: bool = True):
+    """Returns (LU packed, pivots) like the reference lu_op."""
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(jnp.asarray(x))
+    return lu_, piv
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(jnp.asarray(x))
+    return sign, logdet
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False):
+    return jnp.linalg.matrix_rank(jnp.asarray(x), rtol=tol)
+
+
+def vander(x, n=None, increasing: bool = False):
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def householder_product(x, tau):
+    """Q from Householder reflectors (ref householder_product op)."""
+    x = jnp.asarray(x)
+    tau = jnp.asarray(tau)
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(q, x.shape[:-2] + (m, m))
+    for k in range(n):
+        v = jnp.concatenate([jnp.zeros(x.shape[:-2] + (k,), x.dtype),
+                             jnp.ones(x.shape[:-2] + (1,), x.dtype),
+                             x[..., k + 1:, k]], axis=-1)
+        h = jnp.eye(m, dtype=x.dtype) - tau[..., k, None, None] * \
+            v[..., :, None] * v[..., None, :]
+        q = q @ h
+    return q[..., :, :n] if m > n else q
+
+
+def matrix_transpose(x):
+    return jnp.swapaxes(jnp.asarray(x), -2, -1)
+
+
+def diag_embed(x, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    x = jnp.asarray(x)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + (-offset if offset < 0 else 0)
+    c = i + (offset if offset > 0 else 0)
+    out = out.at[..., r, c].set(x)
+    if (dim1, dim2) not in ((-2, -1), (x.ndim - 1, x.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def diagflat(x, offset: int = 0):
+    return jnp.diagflat(jnp.asarray(x), k=offset)

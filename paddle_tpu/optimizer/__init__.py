@@ -8,6 +8,15 @@ from .grad_clip import (
     GradientClipByNorm,
     GradientClipByValue,
 )
+from .extras import (
+    DGCMomentum,
+    Dpsgd,
+    ExponentialMovingAverage,
+    Ftrl,
+    Lookahead,
+    ModelAverage,
+    dgc_compress,
+)
 from .optimizer import Optimizer
 from .optimizers import (
     SGD,

@@ -1,0 +1,231 @@
+"""Optimizer wrappers & long-tail optimizers.
+
+Reference parity: fluid/optimizer.py — `Ftrl` (ftrl_op.cc), `Dpsgd`
+(dpsgd_op.cc), `DGCMomentumOptimizer` (:1176 + operators/dgc_op.cc top-k
+sparsified momentum-corrected grads), `ModelAverage` (:3102),
+`ExponentialMovingAverage` (:3411), `LookaheadOptimizer` (:4822).
+
+TPU-native notes: DGC's purpose on GPUs is shrinking NCCL allreduce bytes;
+on ICI the same top-k sparsify+error-feedback transform is exposed as a
+gradient transform the caller applies before a psum (the sparse-allreduce
+op-handle has no XLA analogue — SURVEY.md §2.2 DGC row marks it optional);
+EMA/ModelAverage/Lookahead are pure pytree transforms that fuse into the
+update step under jit.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, _tree_map
+
+__all__ = ["Ftrl", "Dpsgd", "DGCMomentum", "dgc_compress",
+           "ExponentialMovingAverage", "ModelAverage", "Lookahead"]
+
+
+class Ftrl(Optimizer):
+    """Follow-the-regularized-leader (ref operators/optimizers/ftrl_op.h)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def init_param_state(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def param_update(self, g, p, s, lr, step):
+        lr = lr.astype(p.dtype)
+        sq_new = s["squared"] + g * g
+        pow_old = s["squared"] ** (-self.lr_power)
+        pow_new = sq_new ** (-self.lr_power)
+        sigma = (pow_new - jnp.where(s["squared"] > 0, pow_old, 0.0)) / lr
+        lin_new = s["linear"] + g - sigma * p
+        quad = pow_new / lr + 2 * self.l2
+        pre = jnp.clip(lin_new, -self.l1, self.l1) - lin_new
+        p_new = jnp.where(jnp.abs(lin_new) > self.l1, pre / quad,
+                          jnp.zeros_like(p))
+        return p_new, {"squared": sq_new, "linear": lin_new}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (ref dpsgd_op.cc: clip + gaussian noise).
+    Noise is drawn from a fold of the step count for trace stability."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16,
+                 sigma=1.0, parameters=None, seed: int = 0, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self.clip = clip
+        self.batch_size = batch_size
+        self.sigma = sigma
+        self.seed = seed
+
+    def init_param_state(self, p):
+        return None
+
+    def param_update(self, g, p, s, lr, step):
+        lr = lr.astype(p.dtype)
+        norm = jnp.sqrt(jnp.sum(g * g))
+        g = g * jnp.minimum(1.0, self.clip / jnp.maximum(norm, 1e-12))
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+        key = jax.random.fold_in(key, p.size)
+        noise = jax.random.normal(key, g.shape, g.dtype) * \
+            (self.sigma * self.clip / self.batch_size)
+        return p - lr * (g + noise), s
+
+
+def dgc_compress(grad, velocity, error, sparsity: float, momentum: float = 0.9):
+    """Deep-gradient-compression transform (ref dgc_op.cc:23): momentum
+    correction + error feedback + top-k sparsification.
+
+    Returns (sparse_grad, new_velocity, new_error): sparse_grad has the
+    bottom (sparsity) fraction zeroed and is what should ride the
+    allreduce; the residual accumulates in `error`.
+    """
+    v_new = momentum * velocity + grad
+    acc = v_new + error
+    flat = jnp.abs(acc).reshape(-1)
+    k = max(1, int(flat.shape[0] * (1.0 - sparsity)))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(acc) >= thresh
+    sparse = jnp.where(mask, acc, 0.0)
+    err_new = acc - sparse
+    v_new = jnp.where(mask, 0.0, v_new)  # momentum correction: sent, so reset
+    return sparse, v_new, err_new
+
+
+class DGCMomentum(Optimizer):
+    """Momentum with DGC gradient compression (ref fluid/optimizer.py:1176).
+    `rampup_begin_step` delays compression like the reference; before it the
+    update is plain momentum."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 sparsity=0.999, rampup_begin_step=0, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.momentum = momentum
+        self.sparsity = sparsity
+        self.rampup_begin_step = rampup_begin_step
+        self.use_nesterov = use_nesterov
+
+    def init_param_state(self, p):
+        return {"velocity": jnp.zeros_like(p),
+                "dgc_velocity": jnp.zeros_like(p),
+                "error": jnp.zeros_like(p)}
+
+    def param_update(self, g, p, s, lr, step):
+        lr = lr.astype(p.dtype)
+        sparse, dgc_v, err = dgc_compress(g, s["dgc_velocity"], s["error"],
+                                          self.sparsity, self.momentum)
+        use_dgc = step >= self.rampup_begin_step
+        # DGC folds momentum into its own velocity (momentum correction), so
+        # the sparse tensor IS the update — applying the outer momentum on
+        # top would compound it and diverge.
+        p_dgc = p - lr * sparse
+        v_plain = self.momentum * s["velocity"] + g
+        if self.use_nesterov:
+            p_plain = p - lr * (g + self.momentum * v_plain)
+        else:
+            p_plain = p - lr * v_plain
+        p_new = jnp.where(use_dgc, p_dgc, p_plain)
+        return p_new, {
+            "velocity": jnp.where(use_dgc, s["velocity"], v_plain),
+            "dgc_velocity": jnp.where(use_dgc, dgc_v, s["dgc_velocity"]),
+            "error": jnp.where(use_dgc, err, s["error"]),
+        }
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (ref fluid/optimizer.py:3411): `update(params)`
+    after each step; `apply(params)` returns the shadow params (use inside
+    an `with ema.apply_guard(...)` style swap in eager code)."""
+
+    def __init__(self, decay: float = 0.999, thres_steps: bool = True):
+        self.decay = decay
+        self.thres_steps = thres_steps
+        self._shadow = None
+        self._step = 0
+
+    def update(self, params):
+        self._step += 1
+        d = self.decay
+        if self.thres_steps:
+            # ref: min(decay, (1+steps)/(10+steps)) warmup
+            d = min(self.decay, (1 + self._step) / (10 + self._step))
+        if self._shadow is None:
+            self._shadow = _tree_map(jnp.asarray, params)
+        else:
+            self._shadow = _tree_map(
+                lambda s, p: d * s + (1 - d) * jnp.asarray(p),
+                self._shadow, params)
+        return self._shadow
+
+    def apply(self, params=None):
+        """Returns the EMA weights (the reference swaps them in-place under
+        a guard; functionally you just evaluate with these)."""
+        if self._shadow is None:
+            raise RuntimeError("EMA has no state; call update() first")
+        return self._shadow
+
+    def state_dict(self):
+        return {"shadow": self._shadow, "step": self._step}
+
+    def set_state_dict(self, sd):
+        self._shadow = sd["shadow"]
+        self._step = sd["step"]
+
+
+class ModelAverage(ExponentialMovingAverage):
+    """Uniform average of recent parameters (ref fluid/optimizer.py:3102) —
+    implemented as the running mean over the last `average_window` updates."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000):
+        super().__init__(decay=0.0, thres_steps=False)
+        self.max_average_window = max_average_window
+
+    def update(self, params):
+        self._step += 1
+        n = min(self._step, self.max_average_window)
+        if self._shadow is None:
+            self._shadow = _tree_map(jnp.asarray, params)
+        else:
+            self._shadow = _tree_map(
+                lambda s, p: s + (jnp.asarray(p) - s) / n,
+                self._shadow, params)
+        return self._shadow
+
+
+class Lookahead:
+    """Lookahead wrapper (ref fluid/optimizer.py:4822 LookaheadOptimizer):
+    every k fast steps, slow weights move alpha toward fast weights and the
+    fast weights reset to slow."""
+
+    def __init__(self, inner: Optimizer, alpha: float = 0.5, k: int = 5):
+        self.inner = inner
+        self.alpha = alpha
+        self.k = k
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "slow": _tree_map(jnp.asarray, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr=None):
+        new_params, inner_state = self.inner.update(grads, state["inner"],
+                                                    params, lr)
+        step = state["step"] + 1
+        sync = (step % self.k) == 0
+        slow = _tree_map(
+            lambda s, f: jnp.where(sync, s + self.alpha * (f - s), s),
+            state["slow"], new_params)
+        fast = _tree_map(
+            lambda s, f: jnp.where(sync, s + self.alpha * (f - s), f),
+            state["slow"], new_params)
+        return fast, {"inner": inner_state, "slow": slow, "step": step}
+
+    def get_lr(self, step=None):
+        return self.inner.get_lr(step)

@@ -16,7 +16,8 @@ Axes (any subset, any sizes):
   sp — sequence/context parallel (ring attention over sequence shards)
   ep — expert parallel (MoE expert sharding)
 """
-from . import collective, mesh, sharding
+from . import collective, mesh, metrics, sharding
+from .data_parallel import DataParallel, apply_collective_grads, scale_loss
 from .mesh import (
     DP_AXIS,
     EP_AXIS,

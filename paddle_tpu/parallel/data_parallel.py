@@ -1,0 +1,108 @@
+"""Dygraph DataParallel face.
+
+Reference parity: fluid/dygraph/parallel.py:236 `DataParallel` — wraps a
+Layer; after backward, `apply_collective_grads` coalesces gradient buckets
+and allreduces them over NCCL (imperative/all_reduce.cc).
+
+TPU-native design: under pjit/shard_map, gradient averaging is just `pmean`
+over the data mesh axis and XLA fuses/schedules the collectives — the
+reference's hand-managed bucket coalescing (_coalesce_tensors) exists to
+amortize NCCL launch overhead, which has no ICI analogue, so the wrapper is
+thin: it scales the loss (1/n like the reference's scale_loss), exposes
+`apply_collective_grads` as a pmean over the live data axis, and is an
+identity in single-process eager mode so the same script runs anywhere.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed import env as _env
+from ..nn.layer.base import Layer
+from . import collective as _coll
+
+__all__ = ["DataParallel", "scale_loss", "apply_collective_grads"]
+
+
+def _live_axis(axis: Optional[str] = None) -> Optional[str]:
+    """The mesh axis to reduce over: explicit arg, else the axis set by the
+    enclosing shard_map scope (distributed.env.data_axis_scope)."""
+    return axis or _env.current_data_axis()
+
+
+def scale_loss(loss, axis: Optional[str] = None):
+    """ref parallel.py scale_loss: divide by trainer count.  Under psum-based
+    averaging (pmean) this is unnecessary; kept for scripts that pair it
+    with a raw SUM allreduce."""
+    ax = _live_axis(axis)
+    if ax is None:
+        n = _env.get_world_size()
+        return loss / n if n > 1 else loss
+    return loss / jax.lax.psum(1, ax)
+
+
+def apply_collective_grads(grads: Any, axis: Optional[str] = None):
+    """Average a gradient pytree across data-parallel workers
+    (ref DataParallel.apply_collective_grads).
+
+    Inside shard_map the right collective depends on how the grad was made:
+    differentiating w.r.t. REPLICATED params auto-inserts a psum in the
+    backward pass (jax's varying-manual-axes rule), so those grads arrive
+    already summed and only need dividing by the axis size; grads that still
+    vary over the axis (e.g. ZeRO-sharded params) need a true pmean.  The
+    value's vma set distinguishes the two exactly.  Outside any mesh
+    context: identity (single process).
+    """
+    ax = _live_axis(axis)
+    if ax is None:
+        return grads
+
+    def avg(g):
+        varying = ax in jax.typeof(g).vma
+        if varying:
+            return jax.lax.pmean(g, ax)
+        return g / jax.lax.psum(1, ax)
+
+    return jax.tree_util.tree_map(avg, grads)
+
+
+class DataParallel(Layer):
+    """ref fluid/dygraph/parallel.py:236.
+
+    Usage (mirrors the reference)::
+
+        model = DataParallel(model)
+        loss = loss_fn(model(x))
+        grads = ...                      # functional backward
+        grads = model.apply_collective_grads(grads)
+
+    Inside a shard_map'd train step the wrapper's pmean rides ICI; in a
+    plain single-process script every method degrades to identity, so code
+    written against this API is portable between the two.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, data_axis: Optional[str] = None):
+        super().__init__()
+        # comm_buffer sizes are accepted for API parity; bucketing is an
+        # NCCL-launch-overhead workaround with no ICI equivalent
+        self._layers = layers
+        self.data_axis = data_axis
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return scale_loss(loss, self.data_axis)
+
+    def apply_collective_grads(self, grads):
+        return apply_collective_grads(grads, self.data_axis)
+
+    # delegate the Layer surface to the wrapped model (ref behavior)
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

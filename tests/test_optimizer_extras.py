@@ -1,0 +1,115 @@
+"""Optimizer extras: Ftrl, Dpsgd, DGC, EMA, ModelAverage, Lookahead.
+
+Mirrors reference unittests (test_ftrl_op.py, test_dgc_op.py,
+test_ema.py, test_lookahead.py) with numpy-oracle/property checks."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.optimizer import (
+    SGD,
+    DGCMomentum,
+    Dpsgd,
+    ExponentialMovingAverage,
+    Ftrl,
+    Lookahead,
+    ModelAverage,
+    dgc_compress,
+)
+
+
+def _quadratic_converges(opt, steps=120, tol=0.15, lr_check=True):
+    """Property check: optimizer minimizes ||p - target||^2."""
+    target = jnp.asarray([1.0, -2.0, 3.0])
+    params = {"w": jnp.zeros(3)}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(lambda q: jnp.sum((q["w"] - target) ** 2))(p)
+        return opt.update(g, s, p)
+
+    for _ in range(steps):
+        params, state = step(params, state)
+    return float(jnp.max(jnp.abs(params["w"] - target)))
+
+
+def test_ftrl_converges():
+    assert _quadratic_converges(Ftrl(learning_rate=0.5)) < 0.2
+
+
+def test_ftrl_l1_produces_sparsity():
+    # strong l1 pins small-gradient coordinates at exactly zero
+    opt = Ftrl(learning_rate=0.1, l1=50.0)
+    params = {"w": jnp.zeros(2)}
+    state = opt.init(params)
+    g = {"w": jnp.asarray([0.01, 0.01])}  # tiny gradient vs huge l1
+    for _ in range(5):
+        params, state = opt.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
+
+
+def test_dpsgd_clips_and_noises_but_converges_in_expectation():
+    err = _quadratic_converges(Dpsgd(learning_rate=0.05, clip=5.0,
+                                     sigma=0.01, batch_size=64), steps=300)
+    assert err < 0.5  # noisy, but near the optimum
+
+
+def test_dgc_compress_sparsity_and_error_feedback():
+    g = jnp.asarray(np.random.RandomState(0).randn(100).astype(np.float32))
+    v = jnp.zeros(100)
+    e = jnp.zeros(100)
+    sparse, v2, e2 = dgc_compress(g, v, e, sparsity=0.9)
+    nnz = int((np.asarray(sparse) != 0).sum())
+    assert nnz <= 11  # top 10% kept (ties may add one)
+    # nothing lost: sparse + error == momentum-corrected accumulation
+    np.testing.assert_allclose(np.asarray(sparse + e2), np.asarray(g),
+                               rtol=1e-5, atol=1e-6)
+    # velocity reset where sent
+    assert np.all(np.asarray(v2)[np.asarray(sparse) != 0] == 0)
+
+
+def test_dgc_momentum_converges_despite_sparsity():
+    err = _quadratic_converges(
+        DGCMomentum(learning_rate=0.05, sparsity=0.5), steps=250)
+    assert err < 0.2
+
+
+def test_ema_tracks_params():
+    ema = ExponentialMovingAverage(decay=0.5, thres_steps=False)
+    p = {"w": jnp.asarray([0.0])}
+    ema.update(p)
+    ema.update({"w": jnp.asarray([10.0])})
+    # shadow = 0.5*0 + 0.5*10
+    np.testing.assert_allclose(np.asarray(ema.apply()["w"]), [5.0])
+    sd = ema.state_dict()
+    ema2 = ExponentialMovingAverage(decay=0.5)
+    ema2.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(ema2.apply()["w"]), [5.0])
+
+
+def test_model_average_is_running_mean():
+    ma = ModelAverage(max_average_window=100)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        ma.update({"w": jnp.asarray([v])})
+    np.testing.assert_allclose(np.asarray(ma.apply()["w"]), [2.5])
+
+
+def test_lookahead_sync_semantics():
+    inner = SGD(learning_rate=0.1)
+    la = Lookahead(inner, alpha=0.5, k=2)
+    params = {"w": jnp.asarray([0.0])}
+    state = la.init(params)
+    g = {"w": jnp.asarray([-1.0])}  # SGD moves +0.1 per step
+    params, state = la.update(g, state, params)       # fast: 0.1
+    np.testing.assert_allclose(np.asarray(params["w"]), [0.1], rtol=1e-6)
+    params, state = la.update(g, state, params)       # fast: 0.2 -> sync
+    # slow = 0 + 0.5*(0.2-0) = 0.1; fast resets to slow
+    np.testing.assert_allclose(np.asarray(params["w"]), [0.1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(state["slow"]["w"]), [0.1], rtol=1e-6)
+
+
+def test_lookahead_converges():
+    la = Lookahead(SGD(learning_rate=0.3), alpha=0.5, k=5)
+    assert _quadratic_converges(la, steps=200) < 0.1
