@@ -72,18 +72,22 @@ def main():
     }
     key = jax.random.PRNGKey(0)
 
-    # Synchronize on every step via a host read of the (scalar) loss: on the
-    # axon TPU tunnel, block_until_ready does not reliably wait and deep
-    # unsynchronized dispatch chains wedge the device, so per-step sync is
-    # both the safe and the honest measurement (it includes dispatch latency).
+    # Sync via a host read of the (scalar) loss every k steps: on the axon
+    # TPU tunnel, block_until_ready does not reliably wait and deep
+    # unsynchronized dispatch chains wedge the device.  Steps already chain
+    # through donated params, so a sync every k steps bounds the outstanding
+    # dispatch depth while amortizing the tunnel round-trip (VERDICT r1
+    # weak #2b: per-step float(loss) dominated step time).
+    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "4"))
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch_data, key)
         float(loss)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         params, opt_state, loss = step(params, opt_state, batch_data, key)
-        float(loss)
+        if (i + 1) % sync_every == 0 or i == iters - 1:
+            float(loss)
     dt = time.perf_counter() - t0
 
     n_chips = jax.local_device_count() if on_tpu else 1

@@ -44,23 +44,55 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _as_padding_bias(attn_mask, b, s):
+    """If ``attn_mask`` is a k-position-only mask — shape broadcastable to
+    (b, 1, 1, s) — return the equivalent additive (b, s) bias; else None.
+    This is the BERT/ERNIE padding-mask shape the Pallas kernel streams
+    in-kernel instead of materializing an O(S^2) score mask."""
+    if attn_mask is None:
+        return jnp.zeros((b, s), jnp.float32)
+    if attn_mask.ndim != 4 or attn_mask.shape[1] != 1 or attn_mask.shape[2] != 1:
+        return None
+    if attn_mask.shape[0] not in (1, b) or attn_mask.shape[3] != s:
+        return None
+    m = attn_mask[:, 0, 0, :]
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, 0.0, -1e30)
+    return jnp.broadcast_to(m.astype(jnp.float32), (b, s))
+
+
 def flash_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                     scale=None, training=True):
     """Dispatch to the Pallas flash-attention kernel when the backend/shape
-    allow; otherwise fall back to the jnp reference implementation."""
+    allow; otherwise fall back to the jnp reference implementation.
+
+    Kernel-eligible masks are k-position padding masks (shape (b,1,1,s));
+    arbitrary (b,h,sq,sk) masks fall back.  Dropout runs in-kernel with a
+    replayable position-keyed RNG."""
     from ..core import flags
     from .pallas import flash_attention as fa
 
     b, h, s, d = q.shape
+    rate = float(dropout_p) if training else 0.0
+    bias = _as_padding_bias(attn_mask, b, s)
     use_kernel = (
         flags.get_flag("use_flash_attention")
         and _is_tpu()
-        and attn_mask is None
-        and dropout_p == 0.0
+        and bias is not None
+        and q.shape == k.shape == v.shape
         and fa.supported(s, d)
     )
     if use_kernel:
-        return fa.flash_attention(q, k, v, sm_scale=scale, causal=is_causal)
+        seed = None
+        if rate > 0.0:
+            from ..core import random as _random
+
+            seed = jax.random.randint(_random.next_key(), (1,),
+                                      jnp.iinfo(jnp.int32).min,
+                                      jnp.iinfo(jnp.int32).max, jnp.int32)
+        return fa.flash_attention(q, k, v, bias=bias, sm_scale=scale,
+                                  causal=is_causal, dropout_rate=rate,
+                                  seed=seed)
     return scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                         dropout_p=dropout_p, is_causal=is_causal,
                                         scale=scale, training=training)

@@ -1,13 +1,26 @@
-"""Pallas TPU flash-attention (forward) kernel.
+"""Pallas TPU flash-attention: forward + backward kernels.
 
 The reference has no flash attention (SURVEY.md §5.7 — its transformer is
 plain full attention, python/paddle/nn/layer/transformer.py); this is a new
-TPU-native capability.  Design: block-wise online-softmax forward in VMEM with
-float32 accumulators (MXU matmuls via jnp.dot with preferred_element_type),
-grid over (batch*heads, q_blocks); K/V stream through a fori_loop of VMEM
-dynamic slices.  Backward is provided via recompute (jax.custom_vjp whose bwd
-re-runs a jnp reference attention under grad) — a dedicated backward kernel is
-a later-round optimisation.
+TPU-native capability.  Design:
+
+* Forward: block-wise online-softmax in VMEM with float32 accumulators (MXU
+  matmuls via jnp.dot with preferred_element_type), grid over
+  (batch*heads, q_blocks); K/V stream through a fori_loop of VMEM dynamic
+  slices.  Emits the per-row logsumexp for the backward pass.
+* Backward: two kernels — dK/dV over a (batch*heads, k_blocks) grid and dQ
+  over (batch*heads, q_blocks) — recomputing probabilities from the stored
+  logsumexp (no S matrix ever materialized in HBM).
+* Padding mask: an additive k-position bias of shape (batch, seq_k) streams
+  through both passes, which covers the BERT/ERNIE padding-mask case without
+  falling back to the O(S^2) jnp path.
+* Dropout: applied inside the kernel with a counter-based hash RNG keyed on
+  (seed, batch*head, q_pos, k_pos) so forward and backward replay identical
+  keep masks with no mask tensor in HBM.  (pltpu.prng_* is TPU-only and not
+  replayable across the two backward kernels; a position-keyed hash is.)
+
+Numerics: probabilities use softmax-then-dropout semantics; sum `l` is taken
+over the *undropped* probabilities, matching the jnp reference path.
 """
 from __future__ import annotations
 
@@ -22,15 +35,45 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
+import numpy as np
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, block_k,
+# murmur3 fmix32 constants for the dropout hash (numpy scalars embed as
+# literals inside pallas kernels; jnp constants would be captured consts)
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+_P1 = np.uint32(0x9E3779B1)  # golden-ratio primes to decorrelate axes
+_P2 = np.uint32(0x85EBCA77)
+_P3 = np.uint32(0xC2B2AE3D)
+
+
+def _dropout_keep(seed, bh, q_pos, k_pos, rate):
+    """Deterministic keep-mask: murmur3-finalizer hash of global positions.
+
+    Identical values in forward and both backward kernels for the same
+    (seed, bh, q_pos, k_pos), independent of block sizes.
+    """
+    h = (seed.astype(jnp.uint32)
+         + bh.astype(jnp.uint32) * _P3
+         + q_pos.astype(jnp.uint32) * _P1
+         + k_pos.astype(jnp.uint32) * _P2)
+    h = h ^ (h >> 16)
+    h = h * _M1
+    h = h ^ (h >> 13)
+    h = h * _M2
+    h = h ^ (h >> 16)
+    threshold = np.uint32(min(int(rate * 2**32), 2**32 - 1))
+    return h >= threshold  # keep with prob (1 - rate)
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                      *, sm_scale, causal, dropout_rate, block_q, block_k,
                       seq_len):
+    bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
 
     num_kv = seq_len // block_k
     if causal:
-        # Only iterate over kv blocks at or before this q block's diagonal.
         num_kv_iter = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
         num_kv_iter = jnp.minimum(num_kv_iter, num_kv)
     else:
@@ -38,19 +81,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, 
 
     def body(kv_idx, carry):
         acc, m_prev, l_prev = carry
-        k = pl.load(k_ref, (0, pl.dslice(kv_idx * block_k, block_k), slice(None)))
-        v = pl.load(v_ref, (0, pl.dslice(kv_idx * block_k, block_k), slice(None)))
+        k = k_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
         s = jnp.dot(q, k.astype(jnp.float32).T,
                     preferred_element_type=jnp.float32)  # (block_q, block_k)
+        bias = bias_ref[0, 0, pl.dslice(kv_idx * block_k, block_k)]
+        s = s + bias.astype(jnp.float32)[None, :]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc = acc * alpha[:, None] + jnp.dot(
             p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
         return acc, m_new, l_new
@@ -59,70 +107,241 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, num_kv_iter, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    acc, m, l = jax.lax.fori_loop(0, num_kv_iter, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[None, :]
 
 
-def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k):
-    """q,k,v: (bh, seq, d) — batch and heads pre-flattened."""
+def _flash_forward(q, k, v, bias, seed, sm_scale, causal, dropout_rate,
+                   block_q, block_k):
+    """q,k,v: (bh, seq, d); bias: (b, seq); seed: int32 scalar array."""
     bh, seq_len, d = q.shape
-    block_q = min(block_q, seq_len)
-    block_k = min(block_k, seq_len)
+    b = bias.shape[0]
+    h = bh // b
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(
-        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=seq_len)
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        dropout_rate=dropout_rate, block_q=block_q, block_k=block_k,
+        seq_len=seq_len)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(memory_space=_smem()),
+            pl.BlockSpec((1, block_q, d), lambda bh_i, i: (bh_i, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda bh_i, i: (bh_i, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda bh_i, i: (bh_i, 0, 0)),
+            pl.BlockSpec((1, 1, seq_len), lambda bh_i, i: (bh_i // h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-    )(q, k, v)
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_i, i: (bh_i, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh_i, i: (bh_i, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq_len), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias.reshape(b, 1, seq_len))
 
 
-def _reference_attention(q, k, v, sm_scale, causal):
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                           lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
+                           causal, dropout_rate, block_q, block_k, seq_len):
+    bh_idx = pl.program_id(0)
+    kv_idx = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0, 0].astype(jnp.float32)  # (block_k,)
+
+    num_q = seq_len // block_q
+    qi_start = (kv_idx * block_k) // block_q if causal else 0
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.dslice(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = s + bias[None, :]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.exp(s - lse[:, None])  # true softmax probs (block_q, block_k)
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_d = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_d = p
+        dv_acc = dv_acc + jnp.dot(p_d.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    d = k_ref.shape[-1]
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qi_start, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                         lse_ref, delta_ref, dq_ref, *, sm_scale, causal,
+                         dropout_rate, block_q, block_k, seq_len):
+    bh_idx = pl.program_id(0)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    num_kv = seq_len // block_k
     if causal:
-        seq_q, seq_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
-        s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+        num_kv_iter = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+        num_kv_iter = jnp.minimum(num_kv_iter, num_kv)
+    else:
+        num_kv_iter = num_kv
+
+    def body(kv_idx, dq_acc):
+        k = k_ref[0, pl.dslice(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        bias = bias_ref[0, 0, pl.dslice(kv_idx * block_k, block_k)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = s + bias.astype(jnp.float32)[None, :]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq_acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv_iter, body,
+                           jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k):
-    return _flash_forward(q, k, v, sm_scale, causal, block_q, block_k)
+def _flash_backward(q, k, v, bias, seed, o, lse, do, sm_scale, causal,
+                    dropout_rate, block_q, block_k):
+    bh, seq_len, d = q.shape
+    b = bias.shape[0]
+    h = bh // b
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(bh, 1, seq_len)
+    bias3 = bias.reshape(b, 1, seq_len)
+
+    common = dict(sm_scale=sm_scale, causal=causal, dropout_rate=dropout_rate,
+                  block_q=block_q, block_k=block_k, seq_len=seq_len)
+    seq_spec = lambda: pl.BlockSpec((1, seq_len, d), lambda bh_i, i: (bh_i, 0, 0))
+    row_spec = lambda: pl.BlockSpec((1, 1, seq_len), lambda bh_i, i: (bh_i, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, **common),
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=_smem()),
+            seq_spec(),  # q
+            pl.BlockSpec((1, block_k, d), lambda bh_i, i: (bh_i, i, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda bh_i, i: (bh_i, i, 0)),  # v
+            pl.BlockSpec((1, 1, block_k), lambda bh_i, i: (bh_i // h, 0, i)),  # bias
+            seq_spec(),  # do
+            row_spec(),  # lse
+            row_spec(),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh_i, i: (bh_i, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_i, i: (bh_i, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias3, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=_smem()),
+            pl.BlockSpec((1, block_q, d), lambda bh_i, i: (bh_i, i, 0)),  # q
+            seq_spec(),  # k
+            seq_spec(),  # v
+            pl.BlockSpec((1, 1, seq_len), lambda bh_i, i: (bh_i // h, 0, 0)),  # bias
+            pl.BlockSpec((1, block_q, d), lambda bh_i, i: (bh_i, i, 0)),  # do
+            pl.BlockSpec((1, 1, block_q), lambda bh_i, i: (bh_i, 0, i)),  # lse
+            pl.BlockSpec((1, 1, block_q), lambda bh_i, i: (bh_i, 0, i)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_i, i: (bh_i, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(seed, q, k, v, bias3, do, lse, delta)
+    return dq, dk, dv
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v)
+def _smem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference_attention(q_, k_, v_, sm_scale, causal),
-                     q, k, v)
-    return vjp(g)
+_INTERPRET = False
+
+
+def _interpret() -> bool:
+    """Interpret mode for CPU testing (TPU-only Mosaic otherwise)."""
+    return _INTERPRET or jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_bhsd(q, k, v, bias, seed, sm_scale, causal, dropout_rate,
+                          block_q, block_k):
+    out, _ = _flash_forward(q, k, v, bias, seed, sm_scale, causal,
+                            dropout_rate, block_q, block_k)
+    return out
+
+
+def _fwd(q, k, v, bias, seed, sm_scale, causal, dropout_rate, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, bias, seed, sm_scale, causal,
+                              dropout_rate, block_q, block_k)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _bwd(sm_scale, causal, dropout_rate, block_q, block_k, res, g):
+    q, k, v, bias, seed, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, bias, seed, out, lse, g, sm_scale,
+                                 causal, dropout_rate, block_q, block_k)
+    # Padding bias carries no trainable state; seed is integer (no cotangent).
+    return dq, dk, dv, jnp.zeros_like(bias), None
 
 
 _flash_attention_bhsd.defvjp(_fwd, _bwd)
 
 
 def supported(seq_len: int, head_dim: int) -> bool:
-    """Shapes the kernel handles: lane-aligned head_dim, block-divisible seq."""
-    return head_dim % 128 == 0 and seq_len % 128 == 0 and seq_len >= 128
+    """Shapes the kernel handles: sublane-aligned head_dim (64 covers the
+    BERT/ERNIE family; Mosaic pads lanes), block-divisible seq."""
+    return head_dim % 64 == 0 and seq_len % 128 == 0 and seq_len >= 128
 
 
-def flash_attention(q, k, v, sm_scale=None, causal=False,
+def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
+                    dropout_rate=0.0, seed=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention over (batch, heads, seq, head_dim) inputs."""
+    """Flash attention over (batch, heads, seq, head_dim) inputs.
+
+    ``bias`` is an optional additive k-position bias of shape (batch, seq_k)
+    — the padding-mask case.  ``seed`` (int32 scalar array) drives in-kernel
+    dropout when ``dropout_rate > 0``.
+    """
     b, h, s, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -132,6 +351,27 @@ def flash_attention(q, k, v, sm_scale=None, causal=False,
         bq //= 2
     while s % bk:
         bk //= 2
+    if not _interpret() and (bq < 128 or bk < 128):
+        # Mosaic lane constraint: the (1, 1, block) lse/bias/delta blocks
+        # need block % 128 == 0.  supported() guarantees s % 128 == 0, so
+        # 128 always divides s here; reject explicit smaller blocks.
+        if s % 128:
+            raise ValueError(
+                f"flash_attention requires seq_len % 128 == 0 on TPU, got {s}")
+        bq, bk = max(bq, 128), max(bk, 128)
+    if bias is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    else:
+        # The kernel does not emit a bias gradient (padding masks carry no
+        # trainable state); enforce that contract rather than silently
+        # returning zero grads for learned-bias (ALiBi-style) uses.
+        bias = jax.lax.stop_gradient(
+            jnp.broadcast_to(bias.astype(jnp.float32), (b, s)))
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
     merged = lambda x: x.reshape(b * h, s, d)
-    out = _flash_attention_bhsd(merged(q), merged(k), merged(v), sm_scale, causal, bq, bk)
+    out = _flash_attention_bhsd(merged(q), merged(k), merged(v), bias, seed,
+                                sm_scale, causal, float(dropout_rate), bq, bk)
     return out.reshape(b, h, s, d)

@@ -1,0 +1,160 @@
+"""Pallas flash-attention kernel: interpret-mode numerics vs the jnp
+reference (ops/attention.py), including padding bias, causal, dropout replay,
+and the backward kernels.
+
+The reference framework has no flash attention (SURVEY.md §5.7); the oracle
+here is the O(S^2) reference implementation the kernel must agree with.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.ops.attention import scaled_dot_product_attention as sdpa
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+B, H, S, D = 2, 3, 128, 64
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.fixture
+def pad_bias():
+    bias = np.zeros((B, S), np.float32)
+    bias[0, 100:] = -1e4  # batch 0: 100 valid tokens
+    return jnp.asarray(bias)
+
+
+def _mask4d(bias):
+    return bias[:, None, None, :]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(qkv, pad_bias, causal):
+    q, k, v = qkv
+    out = fa.flash_attention(q, k, v, bias=pad_bias, causal=causal,
+                             block_q=64, block_k=64)
+    ref = sdpa(q, k, v, attn_mask=_mask4d(pad_bias), is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_no_bias_uneven_blocks(qkv):
+    q, k, v = qkv
+    out = fa.flash_attention(q, k, v, block_q=128, block_k=32)
+    ref = sdpa(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_reference(qkv, pad_bias, causal):
+    q, k, v = qkv
+
+    def loss_k(q, k, v):
+        return (fa.flash_attention(q, k, v, bias=pad_bias, causal=causal,
+                                   block_q=64, block_k=64) ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (sdpa(q, k, v, attn_mask=_mask4d(pad_bias),
+                     is_causal=causal) ** 2).sum()
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        scale = float(jnp.abs(b).max())
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=scale * 1e-5)
+
+
+def test_dropout_deterministic_and_block_independent(qkv, pad_bias):
+    q, k, v = qkv
+    seed = jnp.array([1234], jnp.int32)
+    args = dict(bias=pad_bias, dropout_rate=0.3, seed=seed)
+    o1 = fa.flash_attention(q, k, v, block_q=64, block_k=64, **args)
+    o2 = fa.flash_attention(q, k, v, block_q=64, block_k=64, **args)
+    assert bool((o1 == o2).all())
+    o3 = fa.flash_attention(q, k, v, block_q=32, block_k=128, **args)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o3),
+                               rtol=1e-5, atol=1e-5)
+    o4 = fa.flash_attention(q, k, v, block_q=64, block_k=64, bias=pad_bias,
+                            dropout_rate=0.3, seed=jnp.array([9], jnp.int32))
+    assert bool((o1 != o4).any())
+
+
+def test_dropout_grads_match_same_mask_reference(qkv, pad_bias):
+    """Backward with dropout replays the identical keep mask: compare against
+    a jnp attention using the hash-derived mask computed outside the kernel."""
+    q, k, v = qkv
+    seed = jnp.array([77], jnp.int32)
+    rate = 0.3
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    keeps = jnp.stack([
+        fa._dropout_keep(seed[0], jnp.int32(i), qpos, kpos, rate)
+        for i in range(B * H)]).reshape(B, H, S, S)
+
+    def ref(q, k, v):
+        sm = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        sm = sm + pad_bias[:, None, None, :]
+        p = jax.nn.softmax(sm, -1)
+        p = jnp.where(keeps, p / (1 - rate), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def loss_k(*a):
+        return (fa.flash_attention(*a, bias=pad_bias, dropout_rate=rate,
+                                   seed=seed, block_q=64, block_k=64) ** 2).sum()
+
+    out_k = fa.flash_attention(q, k, v, bias=pad_bias, dropout_rate=rate,
+                               seed=seed, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref(q, k, v)),
+                               rtol=1e-5, atol=1e-5)
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        scale = float(jnp.abs(b).max())
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=scale * 1e-5)
+
+
+def test_dropout_keep_rate():
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 1)
+    keep = fa._dropout_keep(jnp.int32(42), jnp.int32(0), qpos, kpos, 0.3)
+    rate = 1.0 - float(keep.mean())
+    assert abs(rate - 0.3) < 0.01
+
+
+class TestDispatch:
+    def test_padding_bias_extraction(self):
+        b, s = 2, 128
+        add = jnp.zeros((b, 1, 1, s), jnp.float32)
+        assert attn_ops._as_padding_bias(add, b, s).shape == (b, s)
+        boolm = jnp.ones((1, 1, 1, s), bool)
+        out = attn_ops._as_padding_bias(boolm, b, s)
+        assert out.shape == (b, s) and float(out.max()) == 0.0
+        # full (b, h, sq, sk) masks are not kernel-eligible
+        assert attn_ops._as_padding_bias(
+            jnp.zeros((b, 1, s, s)), b, s) is None
+        assert attn_ops._as_padding_bias(
+            jnp.zeros((b, 4, 1, s)), b, s) is None
+
+    def test_none_mask_gives_zero_bias(self):
+        out = attn_ops._as_padding_bias(None, 3, 64)
+        assert out.shape == (3, 64) and float(jnp.abs(out).max()) == 0.0
+
+    def test_flash_fallback_matches_sdpa_with_general_mask(self):
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 2, 64, 32)), jnp.float32)
+        mask = jnp.asarray(rng.normal(size=(1, 2, 64, 64)), jnp.float32)
+        out = attn_ops.flash_attention(q, q, q, attn_mask=mask)
+        ref = sdpa(q, q, q, attn_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
