@@ -126,3 +126,44 @@ def no_grad():
     """API-parity context (ref: paddle.no_grad).  Gradients in this framework
     are explicit functional transforms, so this is a no-op marker."""
     yield
+
+
+_CHECKPOINT_POLICIES = {
+    None: None,
+    "": None,
+    "nothing_saveable": "nothing_saveable",
+    "dots_saveable": "dots_saveable",
+    "everything_saveable": "everything_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def checkpoint_policy(name):
+    """Resolve a RecomputeConfig.policy name to a jax.checkpoint policy."""
+    import jax
+
+    if name not in _CHECKPOINT_POLICIES:
+        raise ValueError(
+            f"unknown recompute policy {name!r}; one of "
+            f"{sorted(k for k in _CHECKPOINT_POLICIES if k)}")
+    resolved = _CHECKPOINT_POLICIES[name]
+    if resolved is None:
+        return None
+    return getattr(jax.checkpoint_policies, resolved)
+
+
+def recompute(fn, *args, policy=None, **kwargs):
+    """Activation checkpointing: run ``fn`` now, rematerialize its
+    intermediates during backward instead of storing them.
+
+    Reference parity: fleet.utils.recompute / RecomputeOptimizer
+    (fluid/optimizer.py:4513, fluid/backward.py:629
+    `_append_backward_ops_with_checkpoints_`) — on TPU this is jax.checkpoint,
+    which XLA turns into a fused rematerialized backward region.
+
+    ``policy`` is a RecomputeConfig.policy name (e.g. "dots_saveable") or
+    None for full rematerialization.
+    """
+    import jax
+
+    return jax.checkpoint(fn, policy=checkpoint_policy(policy))(*args, **kwargs)

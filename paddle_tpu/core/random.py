@@ -31,11 +31,20 @@ class KeyStream:
 
 class _State(threading.local):
     def __init__(self):
-        self.global_stream = KeyStream(jax.random.key(0))
+        # Lazy: creating a key initializes the XLA backend, which must not
+        # happen at import time (jax.distributed.initialize requires a
+        # pristine backend — multi-host bootstrap would break otherwise).
+        self.global_stream: Optional[KeyStream] = None
         self.stack: List[KeyStream] = []
 
 
 _state = _State()
+
+
+def _global_stream() -> KeyStream:
+    if _state.global_stream is None:
+        _state.global_stream = KeyStream(jax.random.key(0))
+    return _state.global_stream
 
 
 def seed(value: int) -> None:
@@ -47,7 +56,7 @@ def next_key():
     """Draw the next subkey from the innermost active stream."""
     if _state.stack:
         return _state.stack[-1].next_key()
-    return _state.global_stream.next_key()
+    return _global_stream().next_key()
 
 
 class rng_scope:
@@ -66,7 +75,8 @@ class rng_scope:
 
 
 def get_rng_state():
-    return (_state.global_stream._key, _state.global_stream._counter)
+    s = _global_stream()
+    return (s._key, s._counter)
 
 
 def set_rng_state(state):

@@ -142,7 +142,13 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    """``enable_recompute`` applies per-layer activation checkpointing
+    (ref: RecomputeOptimizer fluid/optimizer.py:4513 with the encoder layers
+    as the checkpoint variables; here each layer body is a jax.checkpoint
+    region rematerialized during backward)."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None,
+                 enable_recompute=False, recompute_policy=None):
         super().__init__()
         import copy
 
@@ -153,13 +159,23 @@ class TransformerEncoder(Layer):
             _reinit(layer)
         self.num_layers = num_layers
         self.norm = norm
+        self.enable_recompute = enable_recompute
+        self.recompute_policy = recompute_policy
 
     def forward(self, src, src_mask=None, cache=None):
+        from ...autograd import recompute as _recompute
+
         output = src
         new_caches = []
+        remat = self.enable_recompute and self.training and cache is None
         for i, layer in enumerate(self.layers):
             if cache is None:
-                output = layer(output, src_mask=src_mask)
+                if remat:
+                    output = _recompute(
+                        lambda x, m, _l=layer: _l(x, src_mask=m),
+                        output, src_mask, policy=self.recompute_policy)
+                else:
+                    output = layer(output, src_mask=src_mask)
             else:
                 output, c = layer(output, src_mask=src_mask, cache=cache[i])
                 new_caches.append(c)

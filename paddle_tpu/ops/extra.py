@@ -16,10 +16,10 @@ import numpy as np
 __all__ = [
     # stats
     "bincount", "median", "nanmedian", "quantile", "nanquantile", "corrcoef",
-    "cov", "count_nonzero", "diff", "mode",
+    "cov", "count_nonzero", "diff",
     # elementwise / math
     "frac", "rad2deg", "deg2rad", "gcd", "lcm", "heaviside", "nextafter",
-    "angle", "conj", "real", "imag", "dist", "isclose", "renorm", "lerp",
+    "angle", "conj", "real", "imag", "dist", "isclose", "renorm",
     "logaddexp", "ldexp", "copysign", "signbit", "sinc", "i0", "i0e", "i1",
     "i1e", "polygamma", "digamma", "lgamma", "multigammaln", "erfinv",
     "hypot", "square_",
@@ -38,10 +38,14 @@ __all__ = [
 
 # ------------------------------------------------------------------- stats --
 def bincount(x, weights=None, minlength: int = 0):
-    # XLA needs a static length: use minlength when given, else host max
+    """ref bincount_op: output length max(minlength, max(x)+1) — every value
+    is counted, minlength only pads.  XLA needs a static length, so the data
+    max is read on the host (eager-only op, like the reference's dynamic
+    output shape)."""
     x = jnp.asarray(x)
-    length = int(minlength) if minlength else int(jnp.max(x)) + 1 if x.size else 0
-    return jnp.bincount(x, weights=weights, minlength=length, length=max(length, minlength))
+    data_len = int(jnp.max(x)) + 1 if x.size else 0
+    length = max(int(minlength), data_len)
+    return jnp.bincount(x, weights=weights, length=length)
 
 
 def median(x, axis=None, keepdim=False):
@@ -79,32 +83,6 @@ def count_nonzero(x, axis=None, keepdim=False):
 def diff(x, n: int = 1, axis: int = -1, prepend=None, append=None):
     return jnp.diff(jnp.asarray(x), n=n, axis=axis, prepend=prepend,
                     append=append)
-
-
-def mode(x, axis: int = -1, keepdim: bool = False):
-    """Most frequent value along axis (ref mode_op).  Returns (values,
-    indices); ties resolve to the smallest value like the reference."""
-    x = jnp.asarray(x)
-    x_moved = jnp.moveaxis(x, axis, -1)
-    sorted_x = jnp.sort(x_moved, axis=-1)
-    n = sorted_x.shape[-1]
-    # run-length via equality with previous element
-    eq = jnp.concatenate([jnp.zeros_like(sorted_x[..., :1], bool),
-                          sorted_x[..., 1:] == sorted_x[..., :-1]], -1)
-    # count of current run at each position
-    idxs = jnp.arange(n)
-    run_start = jnp.where(eq, 0, 1) * idxs
-    run_start = jax.lax.associative_scan(jnp.maximum, run_start, axis=-1)
-    run_len = idxs - run_start + 1
-    best = jnp.argmax(run_len, axis=-1)
-    values = jnp.take_along_axis(sorted_x, best[..., None], -1)[..., 0]
-    # index of first occurrence of the mode in the ORIGINAL array
-    match = x_moved == values[..., None]
-    indices = jnp.argmax(match, axis=-1)
-    if keepdim:
-        values = jnp.expand_dims(values, axis)
-        indices = jnp.expand_dims(indices, axis)
-    return values, indices
 
 
 # ------------------------------------------------------------- elementwise --
@@ -175,11 +153,6 @@ def renorm(x, p: float, axis: int, max_norm: float):
     norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
     factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
     return x * factor
-
-
-def lerp(x, y, weight):
-    x = jnp.asarray(x)
-    return x + jnp.asarray(weight) * (jnp.asarray(y) - x)
 
 
 def logaddexp(x, y):

@@ -60,15 +60,26 @@ def kthvalue(x, k, axis=-1, keepdim=False):
 
 
 def mode(x, axis=-1, keepdim=False):
-    # O(n^2) comparison-matrix count; fine for API-parity use cases.
-    if axis not in (-1, x.ndim - 1):
-        raise NotImplementedError("mode only supports the last axis")
-    counts = jnp.sum(jnp.expand_dims(x, -1) == jnp.expand_dims(x, -2), axis=-1)
-    idx = jnp.argmax(counts, axis=-1)
-    vals = jnp.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+    """Most frequent value along axis (ref mode_op).  Returns (values,
+    indices); ties resolve to the smallest value like the reference.
+    Run-length count over a sort: O(n log n) and any-axis."""
+    x = jnp.asarray(x)
+    x_moved = jnp.moveaxis(x, axis, -1)
+    sorted_x = jnp.sort(x_moved, axis=-1)
+    n = sorted_x.shape[-1]
+    eq = jnp.concatenate([jnp.zeros_like(sorted_x[..., :1], bool),
+                          sorted_x[..., 1:] == sorted_x[..., :-1]], -1)
+    idxs = jnp.arange(n)
+    run_start = jnp.where(eq, 0, 1) * idxs
+    run_start = jax.lax.associative_scan(jnp.maximum, run_start, axis=-1)
+    run_len = idxs - run_start + 1
+    best = jnp.argmax(run_len, axis=-1)
+    values = jnp.take_along_axis(sorted_x, best[..., None], -1)[..., 0]
+    indices = jnp.argmax(x_moved == values[..., None], axis=-1)
     if keepdim:
-        vals, idx = vals[..., None], idx[..., None]
-    return vals, idx.astype(_i64)
+        values = jnp.expand_dims(values, axis)
+        indices = jnp.expand_dims(indices, axis)
+    return values, indices.astype(_i64)
 
 
 def nonzero(x, as_tuple=False):

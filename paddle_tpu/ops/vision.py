@@ -456,6 +456,13 @@ def roi_align(input, rois, output_size, spatial_scale: float = 1.0,
     input: [C, H, W]; rois: [R, 4] xyxy in input-image coords.
     Returns [R, C, out_h, out_w].  Bilinear sampling over a fixed
     sampling grid, fully vectorized (gather + weighted sum).
+
+    Static-shape policy: with ``sampling_ratio=-1`` the reference
+    (roi_align_op) derives ceil(roi_h/pooled_h) samples *per ROI*; that is a
+    data-dependent shape XLA cannot compile, so this implementation uses a
+    fixed ratio of 2 (detectron2's default).  Outputs diverge from the
+    reference for ROIs larger than 2x the output grid; pass an explicit
+    ``sampling_ratio`` sized for your expected max ROI if that matters.
     """
     input = jnp.asarray(input)
     rois = jnp.asarray(rois, jnp.float32)

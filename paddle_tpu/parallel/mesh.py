@@ -117,8 +117,12 @@ def init_parallel_env(strategy=None, *, dp: Optional[int] = None, pp: int = 1,
     if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
         # fleetrun-style multi-process launch: defer to jax.distributed using
         # the same env contract as the reference's launch_utils endpoints.
-        coord = os.environ.get("PADDLE_MASTER", os.environ.get(
-            "MASTER_ADDR", "127.0.0.1") + ":" + os.environ.get("MASTER_PORT", "8271"))
+        # launch.py exports PADDLE_COORDINATOR; PADDLE_MASTER / MASTER_ADDR
+        # are accepted for reference/torchrun-style launchers.
+        coord = (os.environ.get("PADDLE_COORDINATOR")
+                 or os.environ.get("PADDLE_MASTER")
+                 or os.environ.get("MASTER_ADDR", "127.0.0.1") + ":"
+                 + os.environ.get("MASTER_PORT", "8271"))
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,

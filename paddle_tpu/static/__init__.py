@@ -10,7 +10,23 @@ static.layers, append_backward via an optimizer, train with Executor.run —
 tests/test_static.py demonstrates exactly this.
 """
 from . import layers, optimizer
+from . import control_flow
 from .backward import append_backward, gradients
+from .control_flow import (
+    cond,
+    equal,
+    greater_equal,
+    greater_than,
+    increment,
+    less_equal,
+    less_than,
+    logical_and,
+    logical_not,
+    logical_or,
+    logical_xor,
+    not_equal,
+    while_loop,
+)
 from .executor import Executor, Scope, global_scope, scope_guard
 from .framework import (
     Block,

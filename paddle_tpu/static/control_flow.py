@@ -1,0 +1,242 @@
+"""Static-graph control flow: cond / while_loop + compare/logical DSL.
+
+Reference parity: fluid/layers/control_flow.py (`cond`, `while_loop`,
+`While`, `increment`, `less_than` ...) lowering to
+operators/controlflow/conditional_block_op.cc and while_op.cc, which run
+sub-blocks through a scoped Executor with mutable Scopes.
+
+TPU-native design (SURVEY.md §7 "hard parts"): sub-blocks are real
+`Block`s in the Program (built by running the user callbacks under
+`Program._create_block`), and the Executor lowers the ops to
+`jax.lax.cond` / `jax.lax.while_loop` — the reference's mutable-Scope
+semantics become a functional environment snapshot: sub-block ops may read
+any outer variable (closure capture), and the loop state is exactly the
+`loop_vars` carry.  Consequences of the XLA model (documented contract):
+  * both cond branches must produce matching shapes/dtypes,
+  * while-loop carries are shape-invariant,
+  * loop trip counts are data-dependent at *runtime* but the body is traced
+    once (no Python side effects per iteration).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .framework import Program, Variable, default_main_program
+from .layers import _append, _main_block, _out, fill_constant
+
+__all__ = [
+    "cond", "while_loop", "increment", "less_than", "less_equal",
+    "greater_than", "greater_equal", "equal", "not_equal", "logical_and",
+    "logical_or", "logical_xor", "logical_not",
+]
+
+
+# -- compare / logical DSL (ref layers/control_flow.py less_than :1262 etc.) --
+def _cmp(op_type, x: Variable, y: Variable) -> Variable:
+    out = _out("bool", np.broadcast_shapes(x.shape, y.shape))
+    _append(op_type, {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]})
+    return out
+
+
+def less_than(x, y):
+    return _cmp("less_than", x, y)
+
+
+def less_equal(x, y):
+    return _cmp("less_equal", x, y)
+
+
+def greater_than(x, y):
+    return _cmp("greater_than", x, y)
+
+
+def greater_equal(x, y):
+    return _cmp("greater_equal", x, y)
+
+
+def equal(x, y):
+    return _cmp("equal", x, y)
+
+
+def not_equal(x, y):
+    return _cmp("not_equal", x, y)
+
+
+def logical_and(x, y):
+    return _cmp("logical_and", x, y)
+
+
+def logical_or(x, y):
+    return _cmp("logical_or", x, y)
+
+
+def logical_xor(x, y):
+    return _cmp("logical_xor", x, y)
+
+
+def logical_not(x):
+    out = _out("bool", x.shape)
+    _append("logical_not", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def increment(x: Variable, value: float = 1.0, in_place: bool = True) -> Variable:
+    """ref layers/control_flow.py increment :1203 — writes back to the same
+    variable name so while-loop counters advance through the env."""
+    out_name = x.name if in_place else None
+    if in_place:
+        _append("increment", {"X": [x.name]}, {"Out": [x.name]},
+                {"step": float(value)})
+        return x
+    out = _out(x.dtype, x.shape)
+    _append("increment", {"X": [x.name]}, {"Out": [out.name]},
+            {"step": float(value)})
+    return out
+
+
+# -- structure helpers --------------------------------------------------------
+def _flatten_vars(out) -> List[Variable]:
+    if out is None:
+        return []
+    if isinstance(out, Variable):
+        return [out]
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_flatten_vars(o))
+        return res
+    raise TypeError(f"control-flow branch returned non-Variable {type(out)}")
+
+
+def _pack_like(template, flat: List[Variable]):
+    """Rebuild the user's structure from a flat var list."""
+    if template is None:
+        return None
+    if isinstance(template, Variable):
+        return flat.pop(0)
+    if isinstance(template, tuple):
+        return tuple(_pack_like(t, flat) for t in template)
+    if isinstance(template, list):
+        return [_pack_like(t, flat) for t in template]
+    raise TypeError(type(template))
+
+
+# -- cond ---------------------------------------------------------------------
+def cond(pred: Variable, true_fn: Callable, false_fn: Callable,
+         name: Optional[str] = None):
+    """ref layers/control_flow.py cond :2313 → conditional_block_op.cc.
+
+    Both branches build real sub-blocks; the Executor lowers to
+    jax.lax.cond over a snapshot of the enclosing environment.
+    """
+    prog = pred.block.program
+    parent = prog.current_block()
+
+    tb = prog._create_block()
+    t_out = true_fn()
+    prog._rollback()
+    fb = prog._create_block()
+    f_out = false_fn()
+    prog._rollback()
+
+    t_list = _flatten_vars(t_out)
+    f_list = _flatten_vars(f_out)
+    if len(t_list) != len(f_list):
+        raise ValueError(
+            f"cond branches returned {len(t_list)} vs {len(f_list)} outputs; "
+            "they must match (lax.cond requires identical output structure)")
+    for tv, fv in zip(t_list, f_list):
+        if tv.shape != fv.shape or tv.dtype != fv.dtype:
+            raise ValueError(
+                f"cond branch outputs mismatch: {tv.name}{tv.shape}:"
+                f"{tv.dtype} vs {fv.name}{fv.shape}:{fv.dtype}")
+
+    outs = [parent.create_var(shape=v.shape, dtype=v.dtype) for v in t_list]
+    parent.append_op(
+        "conditional_block",
+        inputs={"Cond": [pred.name]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"true_block": tb.idx, "false_block": fb.idx,
+               "true_outs": [v.name for v in t_list],
+               "false_outs": [v.name for v in f_list]})
+    flat = list(outs)
+    return _pack_like(t_out, flat)
+
+
+# -- while_loop ---------------------------------------------------------------
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence[Variable], is_test: bool = False,
+               name: Optional[str] = None):
+    """ref layers/control_flow.py while_loop :1085 → while_op.cc.
+
+    `loop_vars` is the carried state (shape-invariant).  `body_fn` must
+    return the next carry with matching structure; the Executor lowers to
+    jax.lax.while_loop.
+    """
+    loop_vars = list(loop_vars)
+    if not loop_vars:
+        raise ValueError("while_loop requires at least one loop variable")
+    prog = loop_vars[0].block.program
+    parent = prog.current_block()
+
+    cb = prog._create_block()
+    c_out = cond_fn(*loop_vars)
+    prog._rollback()
+    if not isinstance(c_out, Variable):
+        raise TypeError("while_loop cond_fn must return a boolean Variable")
+
+    bb = prog._create_block()
+    b_out = body_fn(*loop_vars)
+    prog._rollback()
+    if isinstance(b_out, Variable):
+        b_out = [b_out]
+    b_list = _flatten_vars(list(b_out))
+    if len(b_list) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body returned {len(b_list)} vars for "
+            f"{len(loop_vars)} loop_vars")
+    for lv, bv in zip(loop_vars, b_list):
+        if lv.shape != bv.shape or lv.dtype != bv.dtype:
+            raise ValueError(
+                f"loop var {lv.name}{lv.shape}:{lv.dtype} vs body output "
+                f"{bv.name}{bv.shape}:{bv.dtype} — carries must be "
+                "shape-invariant (XLA while_loop)")
+
+    outs = [parent.create_var(shape=v.shape, dtype=v.dtype)
+            for v in loop_vars]
+    parent.append_op(
+        "while",
+        inputs={"X": [v.name for v in loop_vars]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"cond_block": cb.idx, "body_block": bb.idx,
+               "cond_out": c_out.name,
+               "body_outs": [v.name for v in b_list]})
+    return outs
+
+
+class While:
+    """Legacy block-style While (ref layers/control_flow.py While :1005):
+
+        i = fill_constant(shape=[1], dtype='int64', value=0)
+        cond = less_than(i, limit)
+        w = While(cond)
+        with w.block():
+            ...body ops...
+            increment(i)
+            # body must recompute the condition in-place:
+            less_than(i, limit, out=cond)   # here: assign via cond.update()
+
+    The TPU lowering requires the carried state to be explicit, which the
+    legacy mutable-Scope API hides; prefer ``while_loop``.  This shim
+    supports the common counter pattern by tracking variables written
+    in-place inside the block.
+    """
+
+    def __init__(self, cond_var: Variable):
+        raise NotImplementedError(
+            "the legacy While block API relies on mutable-Scope semantics "
+            "that do not map to XLA; use paddle_tpu.static.while_loop("
+            "cond_fn, body_fn, loop_vars) instead (same expressive power, "
+            "explicit carried state)")

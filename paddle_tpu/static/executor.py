@@ -79,13 +79,14 @@ class scope_guard:
         _scope_stack.pop()
 
 
-def _run_op_traced(op, env, base_key, idx):
+def _run_op_traced(op, env, base_key, salt):
     """Execute one op's lowering under a per-op PRNG scope (deterministic
-    replay for the backward region)."""
+    replay for the backward region).  `salt` is unique per (block, op index)
+    so sub-block randomness is trace-stable too."""
     lowering = get_lowering(op.type)
     ins = {slot: [env[n] for n in names] if names else []
            for slot, names in op.inputs.items()}
-    with _random.rng_scope(jax.random.fold_in(base_key, idx)):
+    with _random.rng_scope(jax.random.fold_in(base_key, salt)):
         outs = lowering(ins, op.attrs, op)
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
@@ -93,19 +94,98 @@ def _run_op_traced(op, env, base_key, idx):
             env[name] = val
 
 
-def _trace_block(program: Program, env: Dict[str, Any], base_key):
-    """Walk block 0 building the computation into env."""
-    ops = program.global_block().ops
+def _op_salt(block_idx: int, op_idx: int) -> int:
+    return block_idx * 65536 + op_idx
+
+
+def _trace_ops(program: Program, block_idx: int, ops, env, base_key):
+    """Trace a list of ops (any block) with control-flow dispatch."""
     for idx, op in enumerate(ops):
         if op.type in ("feed", "fetch"):
             continue
         if op.type == "backward_region":
-            _lower_backward(program, ops, idx, env, base_key)
+            _lower_backward(program, block_idx, ops, idx, env, base_key)
             continue
-        _run_op_traced(op, env, base_key, idx)
+        if op.type == "conditional_block":
+            _lower_cond(program, op, env, base_key)
+            continue
+        if op.type == "while":
+            _lower_while(program, op, env, base_key)
+            continue
+        _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
 
 
-def _lower_backward(program, ops, bw_idx, env, base_key):
+def _trace_block(program: Program, env: Dict[str, Any], base_key):
+    """Walk block 0 building the computation into env."""
+    _trace_ops(program, 0, program.global_block().ops, env, base_key)
+
+
+def _arrays_only(env: Dict[str, Any]) -> Dict[str, Any]:
+    """The sub-block closure snapshot passed through lax.cond/while must be a
+    pytree of arrays."""
+    out = {}
+    for k, v in env.items():
+        if hasattr(v, "dtype") or isinstance(v, (int, float, bool)):
+            out[k] = jnp.asarray(v)
+    return out
+
+
+def _lower_cond(program, op, env, base_key):
+    """conditional_block → jax.lax.cond over an env snapshot (ref
+    operators/controlflow/conditional_block_op.cc — scoped sub-block run)."""
+    tb = program.blocks[op.attrs["true_block"]]
+    fb = program.blocks[op.attrs["false_block"]]
+    pred = jnp.reshape(env[op.inputs["Cond"][0]], ()).astype(bool)
+    snapshot = _arrays_only(env)
+
+    def branch(block, out_names):
+        def fn(captured):
+            env2 = dict(captured)
+            _trace_ops(program, block.idx, block.ops, env2, base_key)
+            return tuple(env2[n] for n in out_names)
+        return fn
+
+    outs = jax.lax.cond(pred,
+                        branch(tb, op.attrs["true_outs"]),
+                        branch(fb, op.attrs["false_outs"]),
+                        snapshot)
+    for name, val in zip(op.outputs["Out"], outs):
+        env[name] = val
+
+
+def _lower_while(program, op, env, base_key):
+    """while → jax.lax.while_loop with loop_vars as the carry (ref
+    operators/controlflow/while_op.cc — here the carried Scope is explicit)."""
+    cb = program.blocks[op.attrs["cond_block"]]
+    bb = program.blocks[op.attrs["body_block"]]
+    loop_names = op.inputs["X"]
+    body_outs = op.attrs["body_outs"]
+    cond_out = op.attrs["cond_out"]
+    outer = _arrays_only(env)
+    carry0 = tuple(jnp.asarray(env[n]) for n in loop_names)
+
+    def with_carry(carry):
+        env2 = dict(outer)
+        env2.update(zip(loop_names, carry))
+        return env2
+
+    def cond_fun(carry):
+        env2 = with_carry(carry)
+        _trace_ops(program, cb.idx, cb.ops, env2, base_key)
+        return jnp.reshape(env2[cond_out], ()).astype(bool)
+
+    def body_fun(carry):
+        env2 = with_carry(carry)
+        _trace_ops(program, bb.idx, bb.ops, env2, base_key)
+        return tuple(jnp.asarray(env2[n], carry[i].dtype)
+                     for i, n in enumerate(body_outs))
+
+    final = jax.lax.while_loop(cond_fun, body_fun, carry0)
+    for name, val in zip(op.outputs["Out"], final):
+        env[name] = val
+
+
+def _lower_backward(program, block_idx, ops, bw_idx, env, base_key):
     op = ops[bw_idx]
     loss_names = op.inputs["Loss"]
     param_names = op.inputs["Params"]
@@ -117,10 +197,7 @@ def _lower_backward(program, ops, bw_idx, env, base_key):
     def replay(param_values: Dict[str, Any]):
         env2 = dict(init_env)
         env2.update(param_values)
-        for idx2, prev in enumerate(ops[:bw_idx]):
-            if prev.type in ("feed", "fetch", "backward_region"):
-                continue
-            _run_op_traced(prev, env2, base_key, idx2)
+        _trace_ops(program, block_idx, ops[:bw_idx], env2, base_key)
         total = 0.0
         for ln in loss_names:
             total = total + jnp.sum(env2[ln].astype(jnp.float32))

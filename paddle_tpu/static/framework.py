@@ -190,12 +190,30 @@ class Program:
         self._parameters: Dict[str, Parameter] = {}
         self._version = 0
         self.random_seed: Optional[int] = None
+        self._current_block_idx = 0
 
     def global_block(self) -> Block:
         return self.blocks[0]
 
     def current_block(self) -> Block:
-        return self.blocks[-1]
+        return self.blocks[self._current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        """Open a sub-block (ref Program._create_block): ops appended while it
+        is current land in it — the control-flow builders (cond/while_loop)
+        wrap callbacks with this."""
+        parent = self._current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self._current_block_idx = b.idx
+        self._version += 1
+        return b
+
+    def _rollback(self) -> None:
+        """Close the current sub-block (ref Program._rollback)."""
+        self._current_block_idx = self.current_block().parent_idx
+        if self._current_block_idx < 0:
+            self._current_block_idx = 0
 
     def all_parameters(self) -> List[Parameter]:
         return list(self._parameters.values())

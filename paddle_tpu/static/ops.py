@@ -419,3 +419,260 @@ def _adam(ins, attrs, op):
     return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m_new],
             "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
             "Beta2PowOut": [b2p * b2]}
+
+
+# -- comparisons / logicals (ref operators/controlflow/compare_op.cc,
+#    logical_op.cc) — booleans feed cond/while lowerings -----------------------
+def _compare(fn):
+    def rule(ins, attrs, op):
+        x, y = _one(ins, "X"), _one(ins, "Y")
+        return {"Out": [fn(x, y)]}
+    return rule
+
+
+for _name, _fn in [
+    ("less_than", lambda x, y: x < y),
+    ("less_equal", lambda x, y: x <= y),
+    ("greater_than", lambda x, y: x > y),
+    ("greater_equal", lambda x, y: x >= y),
+    ("equal", lambda x, y: x == y),
+    ("not_equal", lambda x, y: x != y),
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    register_op(_name)(_compare(_fn))
+
+
+@register_op("logical_not")
+def _logical_not(ins, attrs, op):
+    return {"Out": [jnp.logical_not(_one(ins, "X"))]}
+
+
+@register_op("increment")
+def _increment(ins, attrs, op):
+    # ref increment_op: in-place X += step (functional here; the DSL reuses
+    # the input name so while-loop counters carry through the env)
+    x = _one(ins, "X")
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+# -- long-tail elementwise / manipulation (ref operators/*.cc) ---------------
+def _unary_rule(fn):
+    def rule(ins, attrs, op):
+        return {"Out": [fn(_one(ins, "X"))]}
+    return rule
+
+
+for _name, _fn in [
+    ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+    ("asin", jnp.arcsin), ("acos", jnp.arccos), ("atan", jnp.arctan),
+    ("sinh", jnp.sinh), ("cosh", jnp.cosh),
+    ("rsqrt", jax.lax.rsqrt), ("reciprocal", lambda x: 1.0 / x),
+    ("round", jnp.round), ("sign", jnp.sign),
+    ("log2", jnp.log2), ("log10", jnp.log10), ("log1p", jnp.log1p),
+    ("expm1", jnp.expm1), ("erf", jax.scipy.special.erf),
+    ("softplus", jax.nn.softplus), ("silu", jax.nn.silu),
+    ("swish", jax.nn.silu), ("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x))),
+    ("relu6", lambda x: jnp.clip(x, 0.0, 6.0)),
+    ("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0)),
+    ("hard_swish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0),
+    ("elu", jax.nn.elu), ("selu", jax.nn.selu),
+    ("logsigmoid", jax.nn.log_sigmoid),
+]:
+    register_op(_name)(_unary_rule(_fn))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ins, attrs, op):
+    a = attrs.get("alpha", 0.02)
+    x = _one(ins, "X")
+    return {"Out": [jnp.where(x >= 0, x, a * x)]}
+
+
+@register_op("pow")
+def _pow(ins, attrs, op):
+    return {"Out": [jnp.power(_one(ins, "X"), attrs.get("factor", 1.0))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ins, attrs, op):
+    return {"Out": [jax.nn.log_softmax(_one(ins, "X"),
+                                       axis=attrs.get("axis", -1))]}
+
+
+@register_op("arg_min")
+def _arg_min(ins, attrs, op):
+    x = _one(ins, "X")
+    return {"Out": [jnp.argmin(x, axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("cumsum")
+def _cumsum(ins, attrs, op):
+    x = _one(ins, "X")
+    axis = attrs.get("axis")
+    if attrs.get("flatten", False) or axis is None:
+        x, axis = x.reshape(-1), 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * out.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * out.ndim
+        sl[axis] = slice(0, -1)
+        out = jnp.pad(out, pad)[tuple(sl)] if not attrs.get("reverse", False) \
+            else out  # exclusive+reverse uncommon; forward semantics kept
+    return {"Out": [out]}
+
+
+@register_op("gather")
+def _gather(ins, attrs, op):
+    x, idx = _one(ins, "X"), _one(ins, "Index")
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32),
+                             axis=attrs.get("axis", 0))]}
+
+
+@register_op("gather_nd")
+def _gather_nd(ins, attrs, op):
+    x, idx = _one(ins, "X"), _one(ins, "Index")
+    idx = idx.astype(jnp.int32)
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter")
+def _scatter(ins, attrs, op):
+    x, ids, upd = _one(ins, "X"), _one(ins, "Ids"), _one(ins, "Updates")
+    ids = ids.astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(upd)]}
+    return {"Out": [x.at[ids].add(upd)]}
+
+
+@register_op("slice")
+def _slice(ins, attrs, op):
+    x = _one(ins, "Input")
+    axes = attrs["axes"]
+    starts, ends = attrs["starts"], attrs["ends"]
+    sl = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = slice(s, e)
+    return {"Out": [x[tuple(sl)]]}
+
+
+@register_op("expand_v2")
+def _expand_v2(ins, attrs, op):
+    x = _one(ins, "X")
+    shape = [x.shape[i] if s == -1 else s
+             for i, s in enumerate(attrs["shape"])]
+    return {"Out": [jnp.broadcast_to(x, shape)]}
+
+
+@register_op("tile")
+def _tile(ins, attrs, op):
+    return {"Out": [jnp.tile(_one(ins, "X"), attrs["repeat_times"])]}
+
+
+@register_op("where")
+def _where(ins, attrs, op):
+    c, x, y = _one(ins, "Condition"), _one(ins, "X"), _one(ins, "Y")
+    return {"Out": [jnp.where(c, x, y)]}
+
+
+@register_op("one_hot_v2")
+def _one_hot(ins, attrs, op):
+    x = _one(ins, "X")
+    return {"Out": [jax.nn.one_hot(x.astype(jnp.int32), attrs["depth"])]}
+
+
+@register_op("range")
+def _range(ins, attrs, op):
+    s, e, st = _one(ins, "Start"), _one(ins, "End"), _one(ins, "Step")
+    # static-shape contract: bounds must be compile-time constants
+    return {"Out": [jnp.arange(float(s), float(e), float(st))
+                    .astype(s.dtype)]}
+
+
+@register_op("shape")
+def _shape(ins, attrs, op):
+    x = _one(ins, "Input")
+    return {"Out": [jnp.asarray(x.shape, jnp.int32)]}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_like(ins, attrs, op):
+    ref_arr = _one(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref_arr.shape[
+        attrs.get("input_dim_idx", 0)]
+    return {"Out": [jnp.full(shape, attrs["value"],
+                             _dtype_mod.convert_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ins, attrs, op):
+    return {"Out": [jnp.zeros_like(_one(ins, "X"))]}
+
+
+@register_op("pad2d")
+def _pad2d(ins, attrs, op):
+    x = _one(ins, "X")
+    p = attrs["paddings"]  # [top, bottom, left, right], NCHW
+    return {"Out": [jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])),
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad")
+def _pad(ins, attrs, op):
+    x = _one(ins, "X")
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("maximum")
+def _maximum(ins, attrs, op):
+    return {"Out": [jnp.maximum(_one(ins, "X"), _one(ins, "Y"))]}
+
+
+@register_op("minimum")
+def _minimum(ins, attrs, op):
+    return {"Out": [jnp.minimum(_one(ins, "X"), _one(ins, "Y"))]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ins, attrs, op):
+    x = _one(ins, "X")
+    return {"Out": [jnp.sum(jnp.square(x)).reshape(1)]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ins, attrs, op):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    d = attrs.get("delta", 1.0)
+    r = jnp.abs(x - y)
+    loss = jnp.where(r <= d, 0.5 * r * r, d * (r - 0.5 * d))
+    return {"Out": [loss], "Residual": [x - y]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ins, attrs, op):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = jnp.abs(x - y)
+    loss = jnp.where(d < 1.0 / sigma2, 0.5 * d * d * sigma2, d - 0.5 / sigma2)
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, x.ndim)),
+                            keepdims=True)], "Diff": [x - y]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ins, attrs, op):
+    x, y = _one(ins, "X"), _one(ins, "Label")
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("relu_grad_passthrough")  # reserved (grad ops are jax.grad'd)
+def _relu_grad_passthrough(ins, attrs, op):
+    return {"Out": [_one(ins, "X")]}

@@ -25,7 +25,8 @@ class ErnieConfig:
                  num_attention_heads=12, intermediate_size=3072,
                  hidden_act="gelu", hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, max_position_embeddings=513,
-                 type_vocab_size=2, initializer_range=0.02, pad_token_id=0):
+                 type_vocab_size=2, initializer_range=0.02, pad_token_id=0,
+                 enable_recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -38,6 +39,7 @@ class ErnieConfig:
         self.type_vocab_size = type_vocab_size
         self.initializer_range = initializer_range
         self.pad_token_id = pad_token_id
+        self.enable_recompute = enable_recompute
 
 
 class ErnieEmbeddings(Layer):
@@ -89,7 +91,9 @@ class ErnieModel(Layer):
             config.intermediate_size, dropout=config.hidden_dropout_prob,
             activation=config.hidden_act,
             attn_dropout=config.attention_probs_dropout_prob, act_dropout=0.0)
-        self.encoder = nn.TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.encoder = nn.TransformerEncoder(
+            enc_layer, config.num_hidden_layers,
+            enable_recompute=config.enable_recompute)
         self.pooler = ErniePooler(config.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,

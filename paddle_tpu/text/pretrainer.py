@@ -72,12 +72,20 @@ class HybridPretrainer:
 
     def __init__(self, config: Optional[ErnieConfig] = None, *,
                  mesh=None, num_micro: int = 1, moe_experts: int = 0,
-                 rules=TRANSFORMER_RULES):
+                 rules=TRANSFORMER_RULES, recompute: bool = False,
+                 recompute_policy: Optional[str] = None, strategy=None):
         self.cfg = config or ErnieConfig()
         self.mesh = mesh or _mesh.current_mesh()
         self.num_micro = num_micro
         self.rules = rules
         self.moe_experts = moe_experts
+        # fleet wiring: DistributedStrategy.recompute(_configs) drives
+        # per-block jax.checkpoint (ref RecomputeOptimizer optimizer.py:4513)
+        if strategy is not None and getattr(strategy, "recompute", False):
+            recompute = True
+            recompute_policy = strategy.recompute_configs.policy
+        self.recompute = recompute or getattr(self.cfg, "enable_recompute", False)
+        self.recompute_policy = recompute_policy
         cfg = self.cfg
 
         self.embeddings = ErnieEmbeddings(cfg)
@@ -150,6 +158,12 @@ class HybridPretrainer:
         def block_fn(blk, x):
             return functional_call(template, blk, (x,))
 
+        if self.recompute:
+            from ..autograd import checkpoint_policy
+
+            block_fn = jax.checkpoint(
+                block_fn, policy=checkpoint_policy(self.recompute_policy))
+
         if pp == 1:
             stage = blockwise_stage_fn(block_fn)
             return stage(blocks, h)
@@ -177,7 +191,8 @@ class HybridPretrainer:
             h = self._encode(params["blocks"], h)
             head_params = dict(params["head"])
             head_params[self._TIED] = params["embed"][self._EMB]
-            logits, nsp = functional_call(self.head, head_params, (h,))
+            logits, nsp = functional_call(
+                self.head, head_params, (h, batch.get("masked_positions")))
         loss = self.criterion(logits.astype(jnp.float32),
                               nsp.astype(jnp.float32),
                               batch["mlm_labels"], batch["nsp_labels"])
@@ -217,8 +232,13 @@ class HybridPretrainer:
         tok = _mesh.data_sharding(m, seq_axis=_mesh.SP_AXIS)
         lab = NamedSharding(m, PartitionSpec(
             _mesh.DP_AXIS if _mesh.DP_AXIS in m.axis_names else None))
+        dp_only = NamedSharding(m, PartitionSpec(
+            _mesh.DP_AXIS if _mesh.DP_AXIS in m.axis_names else None))
         return {"input_ids": tok, "token_type_ids": tok,
-                "mlm_labels": tok, "nsp_labels": lab}
+                "mlm_labels": tok, "nsp_labels": lab,
+                # (b, n_mask) indices: batch-sharded only (indices address
+                # the full sequence, so no seq-axis sharding)
+                "masked_positions": dp_only}
 
 
 class _PretrainHead(nn.Layer):
@@ -230,9 +250,9 @@ class _PretrainHead(nn.Layer):
         self.pooler = ErniePooler(cfg.hidden_size)
         self.cls = ErniePretrainingHeads(cfg, embedding_weight)
 
-    def forward(self, hidden):
+    def forward(self, hidden, masked_positions=None):
         pooled = self.pooler(hidden)
-        return self.cls(hidden, pooled)
+        return self.cls(hidden, pooled, masked_positions)
 
 
 class _CloneList(nn.Layer):

@@ -17,6 +17,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compile cache (machine-local): model-sized test graphs cost
+# 10-70s each to compile; re-runs hit the disk cache instead.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 import numpy as np
 import pytest

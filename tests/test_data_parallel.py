@@ -8,9 +8,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 import paddle_tpu as pd
+from paddle_tpu.parallel.collective import shard_map
 import paddle_tpu.nn as nn
 from paddle_tpu.autograd import functional_call, parameters_dict
 from paddle_tpu.distributed import env as dist_env
@@ -58,9 +58,11 @@ def test_dp_grads_match_single_device():
             g = jax.grad(loss_fn)(p, x, y)
             return apply_collective_grads(g)
 
+    # check_rep=True: apply_collective_grads reads each value's vma set to
+    # pick pmean vs divide-by-n, so VMA tracking must stay on.
     sharded = shard_map(
         shard_step, mesh=mesh,
-        in_specs=(P(), P("dp"), P("dp")), out_specs=P())
+        in_specs=(P(), P("dp"), P("dp")), out_specs=P(), check_rep=True)
     dp_grads = sharded(params, jnp.asarray(X), jnp.asarray(Y))
     for k in ref_grads:
         np.testing.assert_allclose(np.asarray(dp_grads[k]),
@@ -74,11 +76,13 @@ def test_scale_loss_under_shard_map():
     def f(x):
         with dist_env.data_axis_scope("dp"):
             from paddle_tpu.parallel import scale_loss
-            return scale_loss(x.sum())
+            # per-shard loss varies over dp, so the scaled value does too:
+            # out_specs must keep the dp axis (VMA replication rule)
+            return scale_loss(x.sum())[None]
 
-    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P())(
-        jnp.ones(8))
-    np.testing.assert_allclose(float(out), 1.0 / 8)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                    check_rep=True)(jnp.ones(8))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 1.0 / 8))
 
 
 def test_distributed_metrics_psum():

@@ -226,7 +226,7 @@ def test_yolov3_forward_shapes(tiny_yolo):
 def test_yolov3_loss_and_grad(tiny_yolo):
     from paddle_tpu.autograd import functional_call, parameters_dict
     params = parameters_dict(tiny_yolo)
-    x = jnp.asarray(np.random.RandomState(6).rand(2, 3, 96, 96), jnp.float32)
+    x = jnp.asarray(np.random.RandomState(6).rand(2, 3, 64, 64), jnp.float32)
     gt_box = jnp.asarray([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1]],
                           [[0.7, 0.2, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]],
                          jnp.float32)  # second image has 1 padded gt
