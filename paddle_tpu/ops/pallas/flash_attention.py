@@ -339,8 +339,11 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
     """Flash attention over (batch, heads, seq, head_dim) inputs.
 
     ``bias`` is an optional additive k-position bias of shape (batch, seq_k)
-    — the padding-mask case.  ``seed`` (int32 scalar array) drives in-kernel
-    dropout when ``dropout_rate > 0``.
+    — the padding-mask case.  ``bias`` is treated as NON-DIFFERENTIABLE:
+    it passes through ``stop_gradient``, so a learned bias (ALiBi-style)
+    passed here silently receives zero gradient.  Use the composable
+    ``ops.attention`` path for trainable biases.  ``seed`` (int32 scalar
+    array) drives in-kernel dropout when ``dropout_rate > 0``.
     """
     b, h, s, d = q.shape
     if sm_scale is None:
@@ -363,8 +366,9 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
         bias = jnp.zeros((b, s), jnp.float32)
     else:
         # The kernel does not emit a bias gradient (padding masks carry no
-        # trainable state); enforce that contract rather than silently
-        # returning zero grads for learned-bias (ALiBi-style) uses.
+        # trainable state).  stop_gradient makes that zero-grad behaviour
+        # explicit at the trace level; the docstring carries the warning —
+        # a learned (ALiBi-style) bias must NOT be passed here.
         bias = jax.lax.stop_gradient(
             jnp.broadcast_to(bias.astype(jnp.float32), (b, s)))
     if seed is None:

@@ -21,6 +21,73 @@ from .framework import Parameter, Program, Variable, default_main_program
 GRAD_SUFFIX = "@GRAD"
 
 
+def _effective_io(program, op):
+    """(inputs, outputs) of an op for dataflow purposes.  Control-flow ops
+    additionally read every outer variable their sub-blocks reference
+    (closure capture in the Executor's lowering)."""
+    ins = set(op.input_names())
+    outs = set(op.output_names())
+    blk_attrs = [a for a in ("true_block", "false_block",
+                             "cond_block", "body_block")
+                 if a in op.attrs]
+    for a in blk_attrs:
+        blk = program.blocks[op.attrs[a]]
+        defined = set()
+        for sub in blk.ops:
+            si, so = _effective_io(program, sub)
+            ins |= {n for n in si if n not in defined}
+            defined |= so
+    return ins, outs
+
+
+def _reject_while_ops(program, loss_names, param_names, api_name: str) -> None:
+    """`while` lowers to jax.lax.while_loop, which has no transpose rule;
+    a while op ON THE PARAM→LOSS PATH fails deep inside jax.grad at
+    Executor time with an opaque error.  Detect that case at build time
+    (the reference differentiates while via its own WhileGrad op,
+    operators/controlflow/while_op.cc — out of scope for the XLA lowering;
+    use the dygraph/autograd path for differentiable recurrences).
+
+    While ops OFF the grad path (counters, preprocessing of fed data) are
+    fine: jax.grad never transposes equations whose primal does not depend
+    on the differentiated params."""
+    def contains_while(op):
+        if op.type == "while":
+            return True
+        return any(contains_while(sub)
+                   for a in ("true_block", "false_block",
+                             "cond_block", "body_block") if a in op.attrs
+                   for sub in program.blocks[op.attrs[a]].ops)
+
+    block = program.global_block()
+    suspects = []  # (ins, outs) of ops containing a while, in program order
+    for op in block.ops:
+        if contains_while(op):
+            suspects.append(_effective_io(program, op))
+    if not suspects:
+        return
+    # forward: vars transitively computed from the params
+    tainted = set(param_names)
+    for op in block.ops:
+        ins, outs = _effective_io(program, op)
+        if ins & tainted:
+            tainted |= outs
+    # backward: vars the loss transitively reads
+    needed = set(loss_names)
+    for op in reversed(block.ops):
+        ins, outs = _effective_io(program, op)
+        if outs & needed:
+            needed |= ins
+    for ins, outs in suspects:
+        if (ins & tainted) and (outs & needed):
+            raise NotImplementedError(
+                f"{api_name}: a `while` op lies on the parameter→loss "
+                "path; jax.lax.while_loop is not reverse-mode "
+                "differentiable, so static backward through while_loop is "
+                "unsupported. Move the loop out of the differentiated "
+                "region or use the dygraph autograd path.")
+
+
 def append_backward(loss: Variable, parameter_list: Optional[List] = None,
                     no_grad_set=None, program: Optional[Program] = None
                     ) -> List[Tuple[Parameter, Variable]]:
@@ -34,6 +101,8 @@ def append_backward(loss: Variable, parameter_list: Optional[List] = None,
         params = [p for p in program.all_parameters() if p.trainable]
     no_grad = {v if isinstance(v, str) else v.name for v in (no_grad_set or ())}
     params = [p for p in params if p.name not in no_grad]
+    _reject_while_ops(program, [loss.name], [p.name for p in params],
+                      "append_backward")
 
     grad_vars = []
     for p in params:
@@ -54,6 +123,8 @@ def gradients(targets, inputs, program: Optional[Program] = None):
     block = program.global_block()
     tgt = targets if isinstance(targets, (list, tuple)) else [targets]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    _reject_while_ops(program, [t.name for t in tgt], [v.name for v in ins],
+                      "gradients")
     grad_vars = []
     for v in ins:
         g = block.create_var(name=v.name + GRAD_SUFFIX, shape=v.shape,

@@ -15,7 +15,14 @@ any outer variable (closure capture), and the loop state is exactly the
   * both cond branches must produce matching shapes/dtypes,
   * while-loop carries are shape-invariant,
   * loop trip counts are data-dependent at *runtime* but the body is traced
-    once (no Python side effects per iteration).
+    once (no Python side effects per iteration),
+  * sub-block randomness is traced once: a dropout/random op inside a
+    ``while_loop`` body draws from the same per-op PRNG key every iteration
+    (the same mask repeats) — thread a counter through ``loop_vars`` and
+    fold it in manually if per-iteration randomness is required,
+  * ``append_backward`` rejects programs containing a ``while`` op:
+    jax.lax.while_loop is not reverse-mode differentiable (see
+    backward._reject_while_ops).
 """
 from __future__ import annotations
 

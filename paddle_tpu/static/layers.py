@@ -29,7 +29,8 @@ __all__ = [
     "softmax_with_cross_entropy", "mean", "reduce_sum", "reduce_mean",
     "accuracy", "reshape", "transpose", "concat", "split", "flatten", "cast",
     "scale", "fill_constant", "elementwise_add", "elementwise_sub",
-    "elementwise_mul", "elementwise_div", "matmul", "topk", "argmax", "clip",
+    "elementwise_mul", "elementwise_div", "elementwise_mod",
+    "elementwise_floordiv", "matmul", "topk", "argmax", "clip",
     "create_parameter",
 ]
 
@@ -307,6 +308,14 @@ def elementwise_mul(x, y, axis=-1):
 
 def elementwise_div(x, y, axis=-1):
     return _elementwise("elementwise_div", x, y, axis)
+
+
+def elementwise_mod(x, y, axis=-1):
+    return _elementwise("elementwise_mod", x, y, axis)
+
+
+def elementwise_floordiv(x, y, axis=-1):
+    return _elementwise("elementwise_floordiv", x, y, axis)
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0) -> Variable:

@@ -108,7 +108,9 @@ for _name, _fn in [("elementwise_add", jnp.add), ("elementwise_sub", jnp.subtrac
                    ("elementwise_div", jnp.divide),
                    ("elementwise_max", jnp.maximum),
                    ("elementwise_min", jnp.minimum),
-                   ("elementwise_pow", jnp.power)]:
+                   ("elementwise_pow", jnp.power),
+                   ("elementwise_mod", jnp.mod),
+                   ("elementwise_floordiv", jnp.floor_divide)]:
     register_op(_name)(_elementwise(_fn))
 
 
@@ -514,16 +516,24 @@ def _cumsum(ins, attrs, op):
     axis = attrs.get("axis")
     if attrs.get("flatten", False) or axis is None:
         x, axis = x.reshape(-1), 0
+    reverse = attrs.get("reverse", False)
+    exclusive = attrs.get("exclusive", False)
     out = jnp.cumsum(x, axis=axis)
-    if attrs.get("reverse", False):
+    if reverse:
         out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
-    if attrs.get("exclusive", False):
+    if exclusive:
+        # shift by one along `axis`: drop the first (last when reverse)
+        # element and pad a zero on the other side, matching cumsum_op's
+        # exclusive semantics for both directions.
         pad = [(0, 0)] * out.ndim
-        pad[axis] = (1, 0)
         sl = [slice(None)] * out.ndim
-        sl[axis] = slice(0, -1)
-        out = jnp.pad(out, pad)[tuple(sl)] if not attrs.get("reverse", False) \
-            else out  # exclusive+reverse uncommon; forward semantics kept
+        if reverse:
+            pad[axis] = (0, 1)
+            sl[axis] = slice(1, None)
+        else:
+            pad[axis] = (1, 0)
+            sl[axis] = slice(0, -1)
+        out = jnp.pad(out, pad)[tuple(sl)]
     return {"Out": [out]}
 
 

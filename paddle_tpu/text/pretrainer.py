@@ -230,14 +230,14 @@ class HybridPretrainer:
     def data_shardings(self, mesh=None):
         m = mesh or self.mesh
         tok = _mesh.data_sharding(m, seq_axis=_mesh.SP_AXIS)
-        lab = NamedSharding(m, PartitionSpec(
-            _mesh.DP_AXIS if _mesh.DP_AXIS in m.axis_names else None))
         dp_only = NamedSharding(m, PartitionSpec(
             _mesh.DP_AXIS if _mesh.DP_AXIS in m.axis_names else None))
         return {"input_ids": tok, "token_type_ids": tok,
-                "mlm_labels": tok, "nsp_labels": lab,
-                # (b, n_mask) indices: batch-sharded only (indices address
-                # the full sequence, so no seq-axis sharding)
+                # (b, n_mask) labels/indices and (b,) nsp labels: batch-
+                # sharded only.  n_mask is not a sequence dim (sp rarely
+                # divides it), and the masked-position indices address the
+                # full sequence, so none get seq-axis sharding.
+                "mlm_labels": dp_only, "nsp_labels": dp_only,
                 "masked_positions": dp_only}
 
 

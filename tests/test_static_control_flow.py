@@ -1,0 +1,240 @@
+"""Static control flow: cond / while_loop builders + Executor lowering.
+
+Mirrors the reference's control-flow tests
+(python/paddle/fluid/tests/unittests/test_cond.py, test_while_loop_op.py):
+cond taken/not-taken, while counter, nesting, and the documented
+backward-over-while rejection.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+from paddle_tpu.static.control_flow import (
+    cond,
+    increment,
+    less_than,
+    while_loop,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+def _run(main, feed, fetch):
+    exe = static.Executor()
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond_taken_and_not_taken(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [2])
+    pred = less_than(L.reduce_sum(x), L.fill_constant([1], "float32", 0.0))
+    out = cond(pred,
+               lambda: L.scale(x, scale=2.0),
+               lambda: L.scale(x, scale=-1.0))
+
+    neg = np.array([[-1.0, -2.0]], np.float32)
+    pos = np.array([[1.0, 2.0]], np.float32)
+    r_neg, = _run(main, {"x": neg}, [out])
+    r_pos, = _run(main, {"x": pos}, [out])
+    np.testing.assert_allclose(r_neg, neg * 2.0)
+    np.testing.assert_allclose(r_pos, pos * -1.0)
+
+
+def test_cond_multiple_outputs(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [2])
+    pred = less_than(L.fill_constant([1], "float32", 0.0),
+                     L.fill_constant([1], "float32", 1.0))
+    a, b = cond(pred,
+                lambda: (L.scale(x, scale=1.0), L.scale(x, scale=2.0)),
+                lambda: (L.scale(x, scale=3.0), L.scale(x, scale=4.0)))
+    v = np.array([[1.0, 1.0]], np.float32)
+    ra, rb = _run(main, {"x": v}, [a, b])
+    np.testing.assert_allclose(ra, v)
+    np.testing.assert_allclose(rb, v * 2.0)
+
+
+def test_cond_branch_mismatch_raises(_fresh_programs):
+    x = L.data("x", [2])
+    pred = less_than(L.reduce_sum(x), L.fill_constant([1], "float32", 0.0))
+    with pytest.raises(ValueError, match="must match"):
+        cond(pred,
+             lambda: (L.scale(x, scale=1.0), L.scale(x, scale=2.0)),
+             lambda: L.scale(x, scale=3.0))
+
+
+def test_while_loop_counter(_fresh_programs):
+    main, _ = _fresh_programs
+    i = L.fill_constant([1], "int64", 0)
+    limit = L.fill_constant([1], "int64", 7)
+    s = L.fill_constant([1], "float32", 0.0)
+
+    def cond_fn(i, s):
+        return less_than(i, limit)
+
+    def body_fn(i, s):
+        return [increment(i, 1.0, in_place=False),
+                L.elementwise_add(s, L.cast(i, "float32"))]
+
+    i_out, s_out = while_loop(cond_fn, body_fn, [i, s])
+    ri, rs = _run(main, {}, [i_out, s_out])
+    assert int(ri) == 7
+    # sum of 0..6 (i is added before incrementing: body adds old i)
+    assert float(rs) == pytest.approx(sum(range(7)))
+
+
+def test_while_loop_shape_invariance_error(_fresh_programs):
+    i = L.fill_constant([1], "int64", 0)
+    limit = L.fill_constant([1], "int64", 3)
+
+    def cond_fn(i):
+        return less_than(i, limit)
+
+    def body_fn(i):
+        return [L.concat([i, i], axis=0)]  # shape changes: must be rejected
+
+    with pytest.raises(ValueError, match="shape-invariant"):
+        while_loop(cond_fn, body_fn, [i])
+
+
+def test_cond_nested_in_while(_fresh_programs):
+    main, _ = _fresh_programs
+    i = L.fill_constant([1], "int64", 0)
+    limit = L.fill_constant([1], "int64", 4)
+    s = L.fill_constant([1], "float32", 0.0)
+
+    def cond_fn(i, s):
+        return less_than(i, limit)
+
+    def body_fn(i, s):
+        even = less_than(
+            L.elementwise_mod(L.cast(i, "float32"),
+                              L.fill_constant([1], "float32", 2.0)),
+            L.fill_constant([1], "float32", 0.5))
+        inc = cond(even,
+                   lambda: L.fill_constant([1], "float32", 10.0),
+                   lambda: L.fill_constant([1], "float32", 1.0))
+        return [increment(i, 1.0, in_place=False), L.elementwise_add(s, inc)]
+
+    _, s_out = while_loop(cond_fn, body_fn, [i, s])
+    rs, = _run(main, {}, [s_out])
+    # i = 0,1,2,3 -> 10 + 1 + 10 + 1
+    assert float(rs) == pytest.approx(22.0)
+
+
+def test_append_backward_rejects_on_path_while(_fresh_programs):
+    """A while op whose body consumes parameter-derived values and whose
+    output feeds the loss must be rejected (lax.while_loop has no transpose
+    rule; failing at build time beats an opaque jax.grad error)."""
+    main, _ = _fresh_programs
+    x = L.data("x", [2])
+    w = L.fc(x, 2)
+    w_sum = L.reduce_sum(w)
+    i = L.fill_constant([1], "int64", 0)
+    limit = L.fill_constant([1], "int64", 3)
+    s = L.fill_constant([1], "float32", 0.0)
+
+    def cond_fn(i, s):
+        return less_than(i, limit)
+
+    def body_fn(i, s):
+        # closure-captures w_sum (param-derived) into the sub-block
+        return [increment(i, 1.0, in_place=False),
+                L.elementwise_add(s, w_sum)]
+
+    _, s_out = while_loop(cond_fn, body_fn, [i, s])
+    loss = L.mean(s_out)
+    with pytest.raises(NotImplementedError, match="while"):
+        static.append_backward(loss)
+
+
+def test_off_path_while_does_not_block_backward(_fresh_programs):
+    """A counter/preprocessing while that never touches params must NOT be
+    rejected — jax.grad never transposes it."""
+    main, startup = _fresh_programs
+    x = L.data("x", [2])
+    w = L.fc(x, 2)
+    i = L.fill_constant([1], "int64", 0)
+    limit = L.fill_constant([1], "int64", 3)
+
+    def cond_fn(i):
+        return less_than(i, limit)
+
+    def body_fn(i):
+        return [increment(i, 1.0, in_place=False)]
+
+    i_out, = while_loop(cond_fn, body_fn, [i])
+    loss = L.mean(w)
+    opt = static.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    v = np.ones((4, 2), np.float32)
+    l0, = exe.run(main, feed={"x": v}, fetch_list=[loss])
+    l1, = exe.run(main, feed={"x": v}, fetch_list=[loss])
+    ri, = exe.run(main, feed={"x": v}, fetch_list=[i_out])
+    assert float(l1) < float(l0)
+    assert int(ri[0]) == 3
+
+
+def test_nested_while_in_cond_also_rejected(_fresh_programs):
+    """A while hidden inside a cond branch on the grad path is caught too
+    (the guard recurses into sub-blocks)."""
+    main, _ = _fresh_programs
+    x = L.data("x", [2])
+    w = L.fc(x, 2)
+    w_sum = L.reduce_sum(w)
+    pred = less_than(L.fill_constant([1], "float32", 0.0),
+                     L.fill_constant([1], "float32", 1.0))
+
+    def true_fn():
+        i = L.fill_constant([1], "int64", 0)
+        limit = L.fill_constant([1], "int64", 3)
+        s = L.fill_constant([1], "float32", 0.0)
+
+        def cond_fn(i, s):
+            return less_than(i, limit)
+
+        def body_fn(i, s):
+            return [increment(i, 1.0, in_place=False),
+                    L.elementwise_add(s, w_sum)]
+
+        _, s_out = while_loop(cond_fn, body_fn, [i, s])
+        return s_out
+
+    out = cond(pred, true_fn, lambda: L.fill_constant([1], "float32", 0.0))
+    loss = L.mean(out)
+    with pytest.raises(NotImplementedError, match="while"):
+        static.append_backward(loss)
+
+
+def test_cond_under_append_backward(_fresh_programs):
+    """cond IS differentiable (lax.cond has a grad rule): training through a
+    conditional works."""
+    main, startup = _fresh_programs
+    x = L.data("x", [2])
+    h = L.fc(x, 2)
+    pred = less_than(L.fill_constant([1], "float32", 0.0),
+                     L.fill_constant([1], "float32", 1.0))
+    out = cond(pred,
+               lambda: L.scale(h, scale=2.0),
+               lambda: L.scale(h, scale=1.0))
+    loss = L.mean(out)
+    opt = static.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    v = np.ones((4, 2), np.float32)
+    l0, = exe.run(main, feed={"x": v}, fetch_list=[loss])
+    for _ in range(5):
+        l1, = exe.run(main, feed={"x": v}, fetch_list=[loss])
+    assert float(l1) < float(l0)
