@@ -95,6 +95,146 @@ def pipeline_apply(stage_fn: Callable, stage_params, xs, *, axis: str = _mesh.PP
     return outs
 
 
+def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
+                        stage_params, head_params, xs, targets, *,
+                        axis: str = _mesh.PP_AXIS):
+    """One-forward-one-backward pipeline schedule with manual VJP.
+
+    Reference parity: the SectionWorker's interleaved schedule
+    (framework/device_worker.h:415; fluid/optimizer.py:3661 emits the
+    per-section programs it runs).  Unlike `pipeline_apply` (GPipe shape:
+    forward scan + AD-transposed backward scan, all micro-batch residuals
+    live), 1F1B retires each micro-batch's backward as soon as its cotangent
+    arrives, so at most ``2*n_stages - 1`` micro-batch *boundary inputs* are
+    stashed per rank — and the stage forward is recomputed from the stashed
+    input during backward (activation recompute), so no block-internal
+    residuals survive a tick.  Peak activation memory is O(n_stages) instead
+    of GPipe's O(num_micro + n_stages); FLOPs pay one extra stage forward
+    per micro-batch (the usual remat trade).
+
+    Schedule (paired fwd+bwd slots per tick; ranks ``me``, ticks ``t``):
+      * forward of micro-batch b on rank me at   t = b + me
+      * loss + output cotangent on the LAST rank at t = b + n - 1 (same tick
+        as its forward — the 1F1B property)
+      * backward of micro-batch b on rank me at  t = b + 2(n-1) - me
+    Total horizon T = num_micro + 2(n-1).
+
+    Must be called inside shard_map with `axis` manual.  Arguments:
+      stage_fn:   (stage_params, x, micro_idx) -> y, uniform stages,
+                  y.shape == x.shape.  ``micro_idx`` (traced int32) is the
+                  micro-batch index — identical between a micro-batch's
+                  forward and its backward replay, so per-micro randomness
+                  (dropout keys folded on it) stays consistent across the
+                  recompute.
+      loss_fn:    (head_params, y_mb, target_mb, micro_idx) -> scalar mean
+                  loss for one micro-batch.  Runs on the last rank only
+                  (guarded by lax.cond, so other ranks skip the head
+                  compute); differentiated w.r.t. (head_params, y_mb).
+                  ``micro_idx`` serves per-micro RNG, like stage_fn's.
+      stage_params: this rank's stage parameters (pp dim consumed)
+      head_params:  replicated head/criterion parameters (pytree, may be {})
+      xs:         [num_micro, mb, ...] micro-batched stage-0 inputs
+                  (replicated over pp)
+      targets:    pytree of [num_micro, ...] per-micro-batch labels
+    Returns (loss_mean, stage_grads, head_grads, dxs) where dxs is
+    [num_micro, mb, ...] — the cotangents w.r.t. xs (for the caller to
+    continue backward into the embedding), replicated over pp.
+    """
+    n = lax.psum(1, axis)
+    me = lax.axis_index(axis)
+    num_micro = xs.shape[0]
+    S = min(2 * n - 1, num_micro)  # max in-flight stash slots per rank
+    T = num_micro + 2 * (n - 1)
+    ring_fwd = [(i, (i + 1) % n) for i in range(n)]
+    ring_bwd = [((i + 1) % n, i) for i in range(n)]
+
+    zero_act = jnp.zeros_like(xs[0])
+    stash0 = jnp.zeros((S,) + xs.shape[1:], xs.dtype)
+    sgrads0 = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    hgrads0 = jax.tree_util.tree_map(jnp.zeros_like, head_params)
+    inv_micro = 1.0 / num_micro
+
+    def loss_cot(args):
+        """loss and cotangents for one micro-batch on the last rank."""
+        hp, y, tgt, b = args
+        l, (dh, dy) = jax.value_and_grad(
+            lambda h_, y_: loss_fn(h_, y_, tgt, b), argnums=(0, 1))(hp, y)
+        return l.astype(jnp.float32), dh, dy
+
+    def loss_skip(args):
+        hp, y, tgt, b = args
+        return (jnp.zeros((), jnp.float32),
+                jax.tree_util.tree_map(jnp.zeros_like, hp),
+                jnp.zeros_like(y))
+
+    def tick(carry, t):
+        fwd_state, bwd_cot, stash, dxs, sgrads, hgrads, loss_sum = carry
+
+        # ---- forward slot: micro b_f = t - me -----------------------------
+        b_f = t - me
+        active_f = (b_f >= 0) & (b_f < num_micro)
+        b_fc = jnp.clip(b_f, 0, num_micro - 1)
+        inp = lax.dynamic_index_in_dim(xs, b_fc, 0, keepdims=False)
+        x_in = jnp.where(me == 0, inp, fwd_state)
+        y = stage_fn(stage_params, x_in, b_fc)
+        slot_f = jnp.mod(b_fc, S)
+        old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(active_f, x_in, old), slot_f, 0)
+
+        # ---- last rank: per-micro loss + output cotangent -----------------
+        # lax.cond (scalar pred inside the manual shard_map) so non-last
+        # ranks skip the head forward+backward entirely instead of masking
+        # it out — the head can be a vocab-sized projection.
+        tgt = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, b_fc, 0, keepdims=False),
+            targets)
+        is_last = me == n - 1
+        take_loss = active_f & is_last
+        l_b, dh_b, dy_b = lax.cond(take_loss, loss_cot, loss_skip,
+                                   (head_params, y, tgt, b_fc))
+        loss_sum = loss_sum + l_b
+        hgrads = jax.tree_util.tree_map(
+            lambda acc, g: acc + g * inv_micro, hgrads, dh_b)
+
+        # ---- backward slot: micro b_b = t - 2(n-1) + me -------------------
+        b_b = t - 2 * (n - 1) + me
+        active_b = (b_b >= 0) & (b_b < num_micro)
+        b_bc = jnp.clip(b_b, 0, num_micro - 1)
+        # last rank consumes its own dy from THIS tick (b_b == b_f there)
+        cot_in = jnp.where(is_last, dy_b * inv_micro, bwd_cot)
+        slot_b = jnp.mod(b_bc, S)
+        x_saved = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+        _, vjp_fn = jax.vjp(
+            lambda sp, x: stage_fn(sp, x, b_bc), stage_params, x_saved)
+        dparams, dx = vjp_fn(cot_in)
+        sgrads = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(active_b, g, jnp.zeros_like(g)),
+            sgrads, dparams)
+        # rank 0 retires dx into dxs (cotangent w.r.t. the pipeline input)
+        take_dx = active_b & (me == 0)
+        cur_dx = lax.dynamic_index_in_dim(dxs, b_bc, 0, keepdims=False)
+        dxs = lax.dynamic_update_index_in_dim(
+            dxs, jnp.where(take_dx, dx, cur_dx), b_bc, 0)
+
+        # ---- rotate ------------------------------------------------------
+        fwd_state = lax.ppermute(y, axis, ring_fwd)
+        bwd_cot = lax.ppermute(dx, axis, ring_bwd)
+        return (fwd_state, bwd_cot, stash, dxs, sgrads, hgrads, loss_sum), None
+
+    carry0 = (zero_act, zero_act, stash0, jnp.zeros_like(xs), sgrads0,
+              hgrads0, jnp.asarray(0.0, jnp.float32))
+    (_, _, _, dxs, sgrads, hgrads, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    # loss/head grads live on the last rank, dxs on rank 0: broadcast both
+    loss = lax.psum(loss_sum, axis) * inv_micro
+    hgrads = lax.psum(jax.tree_util.tree_map(
+        lambda g: jnp.where(me == n - 1, g, jnp.zeros_like(g)), hgrads), axis)
+    dxs = lax.psum(jnp.where(me == 0, dxs, jnp.zeros_like(dxs)), axis)
+    return loss, sgrads, hgrads, dxs
+
+
 def stack_block_params(block_params: Sequence[Dict[str, jax.Array]]
                        ) -> Dict[str, jax.Array]:
     """Stack per-block {name: array} dicts into {name: [L, ...] array} — the

@@ -27,6 +27,7 @@ from .control_flow import (
     not_equal,
     while_loop,
 )
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
 from .executor import Executor, Scope, global_scope, scope_guard
 from .framework import (
     Block,

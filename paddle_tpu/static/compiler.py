@@ -1,0 +1,109 @@
+"""CompiledProgram: multi-device execution of static Programs.
+
+Reference parity: `CompiledProgram` / `with_data_parallel`
+(python/paddle/fluid/compiler.py:87/:160), which wraps ParallelExecutor —
+the multi-device SSA graph builder clones the graph per device and inserts
+per-gradient allreduce op-handles
+(paddle/fluid/framework/ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:175,
+:464 CreateAllReduceOp).
+
+TPU-native design: none of that machinery survives — the Executor already
+lowers the whole Program to ONE XLA computation, so data parallelism is
+purely a *sharding* decision: jit the same computation over a 1-axis device
+mesh with feed arrays sharded on their batch (leading) dimension and every
+persistable replicated.  GSPMD then partitions the forward, and the
+gradient summation that `append_backward`'s replay produces against
+replicated parameters lowers to the same all-reduce the reference inserted
+by hand.  Fetches come back replicated (a mean loss equals the
+single-device full-batch loss — the reference's TestDistBase parity
+contract).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .framework import Program
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """ref framework/details/build_strategy.h:58.  The SSA-graph knobs
+    (reduce strategy, fusion, hierarchical allreduce) are XLA/GSPMD's job
+    now; the class exists for API parity and records its fields."""
+
+    def __init__(self):
+        self.reduce_strategy = "AllReduce"
+        self.gradient_scale_strategy = "CoeffNumDevice"
+        self.fuse_all_reduce_ops = True  # GSPMD always effectively fuses
+        self.memory_optimize = True      # XLA buffer assignment
+        self.enable_inplace = True
+
+
+class ExecutionStrategy:
+    """ref framework/details/execution_strategy.h — thread-pool sizing for
+    the SSA executors; meaningless under one fused XLA program."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+
+
+class CompiledProgram:
+    """ref fluid/compiler.py:87.
+
+    Usage (same shape as the reference)::
+
+        compiled = static.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        exe.run(compiled, feed={...}, fetch_list=[loss])
+
+    The feed carries the GLOBAL batch; it is split evenly across devices
+    (reference: with_data_parallel feed splitting, fluid/executor.py:855
+    _run_parallel).  Batch dims must divide the device count.
+    """
+
+    def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
+        if not isinstance(program, Program):
+            raise TypeError(
+                f"CompiledProgram wraps a static.Program, got {type(program)}")
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._data_parallel = False
+        self._loss_name: Optional[str] = None
+        self._places: Optional[Sequence] = None
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           places: Optional[Sequence] = None) -> "CompiledProgram":
+        """ref fluid/compiler.py:160.  `places` defaults to every local
+        device (the reference's CUDAPlace list ≈ jax.devices())."""
+        self._data_parallel = True
+        self._loss_name = loss_name
+        self._places = places
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        return self
+
+    @property
+    def program(self) -> Program:
+        return self._program
+
+    def _devices(self):
+        import jax
+
+        if self._places is None:
+            return list(jax.devices())
+        devs = []
+        for p in self._places:
+            # accept jax.Device, Place-like with .device, or int index
+            if hasattr(p, "device_kind"):
+                devs.append(p)
+            elif hasattr(p, "device"):
+                devs.append(p.device)
+            elif isinstance(p, int):
+                devs.append(jax.devices()[p])
+            else:
+                raise TypeError(f"unsupported place {p!r}")
+        return devs

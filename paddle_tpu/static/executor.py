@@ -209,6 +209,19 @@ def _lower_backward(program, block_idx, ops, bw_idx, env, base_key):
         env[gname] = grads[pname]
 
 
+_prog_tokens = iter(range(1, 1 << 62))
+
+
+def _program_token(program) -> int:
+    """Stable per-Program cache token.  `id()` can alias after GC (round-1/2
+    finding); a token stored ON the object cannot."""
+    tok = getattr(program, "_exec_cache_token", None)
+    if tok is None:
+        tok = next(_prog_tokens)
+        program._exec_cache_token = tok
+    return tok
+
+
 class Executor:
     """ref fluid/executor.py:474.  `place` is accepted for API parity; XLA
     owns placement (SURVEY.md L0a TPU mapping)."""
@@ -219,9 +232,15 @@ class Executor:
         self._step = 0
 
     # -- public API ----------------------------------------------------------
-    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+    def run(self, program=None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True):
+        from .compiler import CompiledProgram
+
+        devices = None
+        if isinstance(program, CompiledProgram):
+            devices = program._devices() if program._data_parallel else None
+            program = program._program
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -239,13 +258,15 @@ class Executor:
                 f"persistable variables {missing} have no value in scope — "
                 "run the startup program first (exe.run(startup_program))")
 
-        key = (id(program), program._version, tuple(fetch_names),
+        key = (_program_token(program), program._version, tuple(fetch_names),
                tuple(sorted((k, v.shape, str(v.dtype))
-                            for k, v in feed_arrays.items())))
+                            for k, v in feed_arrays.items())),
+               tuple(id(d) for d in devices) if devices else None)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, list(feed_arrays), fetch_names,
-                                   state_names)
+                                   state_names, devices=devices,
+                                   feed_arrays=feed_arrays)
             self._cache[key] = compiled
 
         state = {n: scope.find_var(n) for n in state_names
@@ -278,7 +299,8 @@ class Executor:
                 return True
         return False
 
-    def _build(self, program: Program, feed_names, fetch_names, state_names):
+    def _build(self, program: Program, feed_names, fetch_names, state_names,
+               devices=None, feed_arrays=None):
         def raw(feeds, state, base_key):
             env: Dict[str, Any] = {}
             env.update({k: jnp.asarray(v) for k, v in state.items()})
@@ -288,7 +310,46 @@ class Executor:
             new_state = {n: env[n] for n in state_names if n in env}
             return fetches, new_state
 
-        return jax.jit(raw)
+        if not devices or len(devices) == 1:
+            return jax.jit(raw)
+        return self._build_data_parallel(raw, devices, feed_arrays)
+
+    @staticmethod
+    def _build_data_parallel(raw, devices, feed_arrays):
+        """Data-parallel build: the SAME traced computation, jitted over a
+        1-axis mesh with batch-sharded feeds + replicated persistables.
+        GSPMD partitions the forward, and the replay-gradient summation
+        against replicated params lowers to the cross-device all-reduce the
+        reference's MultiDevSSAGraphBuilder inserted per gradient
+        (ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464)."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.asarray(devices), ("dp",))
+        n = len(devices)
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def feed_sharding(name, arr):
+            if arr.ndim == 0 or arr.shape[0] == 1:
+                return repl
+            if arr.shape[0] % n != 0:
+                raise ValueError(
+                    f"data-parallel feed '{name}' batch dim {arr.shape[0]} "
+                    f"does not divide the {n} devices (the reference's "
+                    "with_data_parallel requires an even split)")
+            return NamedSharding(mesh, PartitionSpec("dp"))
+
+        feed_sh = {k: feed_sharding(k, v) for k, v in feed_arrays.items()}
+        jitted = jax.jit(raw)
+
+        def call(feeds, state, base_key):
+            placed_feeds = {k: jax.device_put(np.asarray(v), feed_sh[k])
+                            for k, v in feeds.items()}
+            placed_state = {k: jax.device_put(v, repl)
+                            for k, v in state.items()}
+            return jitted(placed_feeds, placed_state,
+                          jax.device_put(base_key, repl))
+
+        return call
 
     def close(self):
         self._cache.clear()

@@ -86,6 +86,19 @@ class HybridPretrainer:
             recompute_policy = strategy.recompute_configs.policy
         self.recompute = recompute or getattr(self.cfg, "enable_recompute", False)
         self.recompute_policy = recompute_policy
+        # fleet wiring: PipelineConfig.schedule selects the pp schedule
+        # (ref device_worker.h:415 SectionWorker's 1F1B vs GPipe).
+        self.pp_schedule = "gpipe"
+        if strategy is not None and getattr(strategy, "pipeline", False):
+            sched = strategy.pipeline_configs.schedule
+            if sched not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    f"unknown pipeline schedule {sched!r}: use 'gpipe' or "
+                    "'1f1b'")
+            self.pp_schedule = sched
+            if num_micro == 1:
+                num_micro = strategy.pipeline_configs.micro_batch
+                self.num_micro = num_micro
         cfg = self.cfg
 
         self.embeddings = ErnieEmbeddings(cfg)
@@ -150,9 +163,9 @@ class HybridPretrainer:
             is_leaf=lambda x: not isinstance(x, dict))
 
     # -- forward ------------------------------------------------------------
-    def _encode(self, blocks, h):
-        """Run the encoder stack: pipelined over pp when the axis exists."""
-        pp = _mesh.mesh_axis_size(_mesh.PP_AXIS, self.mesh)
+    def _block_fn(self):
+        """Single-block apply (+ optional recompute wrap) shared by the
+        GPipe and 1F1B paths."""
         template = self.block_template
 
         def block_fn(blk, x):
@@ -163,6 +176,12 @@ class HybridPretrainer:
 
             block_fn = jax.checkpoint(
                 block_fn, policy=checkpoint_policy(self.recompute_policy))
+        return block_fn
+
+    def _encode(self, blocks, h):
+        """Run the encoder stack: pipelined over pp when the axis exists."""
+        pp = _mesh.mesh_axis_size(_mesh.PP_AXIS, self.mesh)
+        block_fn = self._block_fn()
 
         if pp == 1:
             stage = blockwise_stage_fn(block_fn)
@@ -213,6 +232,10 @@ class HybridPretrainer:
 
     # -- train step ---------------------------------------------------------
     def make_train_step(self, optimizer, compute_dtype=jnp.float32):
+        pp = _mesh.mesh_axis_size(_mesh.PP_AXIS, self.mesh)
+        if self.pp_schedule == "1f1b" and pp > 1:
+            return self._make_train_step_1f1b(optimizer, compute_dtype)
+
         def train_step(params, opt_state, batch, key):
             def _loss(p):
                 if compute_dtype != jnp.float32:
@@ -222,6 +245,99 @@ class HybridPretrainer:
                 return self.loss_fn(p, batch, key)
 
             loss, grads = jax.value_and_grad(_loss)(params)
+            new_params, new_state = optimizer.update(grads, opt_state, params)
+            return new_params, new_state, loss
+
+        return train_step
+
+    def _make_train_step_1f1b(self, optimizer, compute_dtype):
+        """1F1B pipeline schedule (ref SectionWorker device_worker.h:415):
+        the loss runs per micro-batch on the last stage inside the pipeline
+        and each micro-batch's backward retires as soon as its cotangent
+        arrives — peak activation memory O(pp) instead of GPipe's
+        O(num_micro).  Uses manual VJP (parallel.pipeline.pipeline_train_1f1b)
+        with stage-input stashing + forward recompute.
+
+        RNG contract: the stage forward and its VJP replay must draw the
+        SAME dropout masks, so the per-micro-batch key is derived from the
+        micro index and threaded explicitly (the ambient traced-counter
+        stream would desynchronize between the fwd slot and the bwd-slot
+        replay)."""
+        from ..parallel.pipeline import pipeline_train_1f1b
+
+        def train_step(params, opt_state, batch, key):
+            p = params
+            if compute_dtype != jnp.float32:
+                p = jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+            block_fn = self._block_fn()
+
+            def stage_fn(blk, x, micro_idx):
+                with _random.rng_scope(
+                        jax.random.fold_in(key, 2 * micro_idx + 2)):
+                    def body(h, one_blk):
+                        return block_fn(one_blk, h), None
+
+                    out, _ = lax.scan(body, x, blk)
+                return out
+
+            def loss_fn(hp, y, tgt, micro_idx):
+                # odd salts for the head (even+2 are the stages'): per-micro
+                # head randomness advances like the GPipe stream would
+                with _random.rng_scope(
+                        jax.random.fold_in(key, 2 * micro_idx + 3)):
+                    logits, nsp = functional_call(
+                        self.head, hp, (y, tgt.get("masked_positions")))
+                return self.criterion(
+                    logits.astype(jnp.float32), nsp.astype(jnp.float32),
+                    tgt["mlm_labels"], tgt["nsp_labels"])
+
+            def embed_fn(ep):
+                with _random.rng_scope(jax.random.fold_in(key, 0)):
+                    h = functional_call(
+                        self.embeddings, ep,
+                        (batch["input_ids"], batch["token_type_ids"]))
+                return self._data_constraint(h)
+
+            head_params = dict(p["head"])
+            head_params[self._TIED] = p["embed"][self._EMB]
+
+            h, vjp_embed = jax.vjp(embed_fn, p["embed"])
+            xs = microbatch(h, self.num_micro)
+            targets = {k: microbatch(batch[k], self.num_micro)
+                       for k in ("masked_positions", "mlm_labels",
+                                 "nsp_labels") if k in batch}
+
+            blk_specs = jax.tree_util.tree_map(
+                lambda _: PartitionSpec(_mesh.PP_AXIS), p["blocks"])
+
+            def run(blk, hp, xs_, ts_):
+                return pipeline_train_1f1b(
+                    stage_fn, loss_fn, blk, hp, xs_, ts_,
+                    axis=_mesh.PP_AXIS)
+
+            f = _jax_shard_map(
+                run, mesh=self.mesh,
+                in_specs=(blk_specs, PartitionSpec(), PartitionSpec(),
+                          PartitionSpec()),
+                out_specs=(PartitionSpec(), blk_specs, PartitionSpec(),
+                           PartitionSpec()),
+                axis_names={_mesh.PP_AXIS}, **{_VMA_KW: False})
+            loss, sgrads, hgrads, dxs = f(p["blocks"], head_params, xs,
+                                          targets)
+            (egrads,) = vjp_embed(unmicrobatch(dxs))
+
+            hgrads = dict(hgrads)
+            tied_g = hgrads.pop(self._TIED)
+            egrads = dict(egrads)
+            egrads[self._EMB] = egrads[self._EMB] + tied_g
+            grads = {"embed": egrads, "blocks": dict(sgrads),
+                     "head": hgrads}
+            grads = jax.tree_util.tree_map(
+                lambda g, q: g.astype(q.dtype), grads, params,
+                is_leaf=lambda x: not isinstance(x, dict))
             new_params, new_state = optimizer.update(grads, opt_state, params)
             return new_params, new_state, loss
 

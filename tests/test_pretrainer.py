@@ -95,3 +95,67 @@ def test_params_change_and_tied_weight_single_leaf():
     delta = np.abs(np.asarray(new_params["embed"][trainer._EMB]) -
                    np.asarray(params["embed"][trainer._EMB])).max()
     assert delta > 0
+
+
+def test_1f1b_schedule_matches_gpipe():
+    """PipelineConfig.schedule="1f1b" runs the manual-VJP schedule and
+    produces the same loss and updated params as the GPipe path (dropout is
+    0 in CFG, so the schedules are numerically comparable)."""
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+
+    import paddle_tpu
+    paddle_tpu.seed(13)
+    m = dist.init_parallel_env(dp=4, pp=2)
+
+    strat = DistributedStrategy()
+    strat.pipeline = True
+    strat.pipeline_configs.schedule = "1f1b"
+    strat.pipeline_configs.micro_batch = 4
+
+    t_1f1b = HybridPretrainer(ErnieConfig(**CFG), mesh=m, strategy=strat)
+    assert t_1f1b.pp_schedule == "1f1b" and t_1f1b.num_micro == 4
+    p0 = t_1f1b.place_params(t_1f1b.init_params())
+    raw = jax.tree_util.tree_map(np.asarray, p0)
+
+    # SGD, not Adam: Adam's first-step update is ~lr*sign(g), which turns
+    # fp-noise-level grad differences between the two schedules into
+    # full-scale param deltas.  SGD keeps param deltas proportional to g.
+    from paddle_tpu.optimizer import SGD
+    opt = SGD(learning_rate=0.1)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, 16, 16, t_1f1b.cfg.vocab_size)
+
+    def run(trainer, params_np):
+        params = trainer.place_params(
+            jax.tree_util.tree_map(jnp.asarray, params_np))
+        state = opt.init(params)
+        sh = trainer.data_shardings(m)
+        placed = {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+        step = jax.jit(trainer.make_train_step(opt))
+        with m:
+            new_p, _, loss = step(params, state, placed,
+                                  jax.random.PRNGKey(0))
+        return float(loss), jax.tree_util.tree_map(np.asarray, new_p)
+
+    l1, np1 = run(t_1f1b, raw)
+
+    t_gp = HybridPretrainer(ErnieConfig(**CFG), mesh=m, num_micro=4)
+    assert t_gp.pp_schedule == "gpipe"
+    l2, np2 = run(t_gp, raw)
+
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    flat1 = jax.tree_util.tree_leaves(np1)
+    flat2 = jax.tree_util.tree_leaves(np2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
+
+
+def test_unknown_pipeline_schedule_rejected():
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+
+    m = dist.init_parallel_env(dp=4, pp=2)
+    strat = DistributedStrategy()
+    strat.pipeline = True
+    strat.pipeline_configs.schedule = "interleaved-magic"
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        HybridPretrainer(ErnieConfig(**CFG), mesh=m, strategy=strat)

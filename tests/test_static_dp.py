@@ -1,0 +1,102 @@
+"""CompiledProgram.with_data_parallel: static Programs on the device mesh.
+
+Reference contract (fluid/compiler.py:160 + TestDistBase): the global feed
+batch is split evenly across devices, gradients all-reduce, and the loss
+sequence matches the single-device run.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _build_mnist_like(seed):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with static.program_guard(main, startup):
+        img = L.data("img", [32])
+        label = L.data("label", [1], dtype="int64")
+        h = L.fc(img, 16, act="relu")
+        logits = L.fc(h, 10)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+        opt = static.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(program_for_run, main, startup, loss, steps=8):
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(7)
+        x = rng.normal(0, 1, (64, 32)).astype(np.float32)
+        y = rng.integers(0, 10, (64, 1)).astype(np.int64)
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(program_for_run, feed={"img": x, "label": y},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+@needs_devices
+def test_dp_matches_single_device_losses():
+    main, startup, loss = _build_mnist_like(seed=11)
+    ref = _train(main, main, startup, loss)
+
+    main2, startup2, loss2 = _build_mnist_like(seed=11)
+    compiled = static.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name)
+    dp = _train(compiled, main2, startup2, loss2)
+
+    assert dp == pytest.approx(ref, rel=2e-4), (ref, dp)
+    assert dp[-1] < dp[0] * 0.7  # it actually trains
+
+
+@needs_devices
+def test_dp_feed_is_actually_sharded():
+    main, startup, loss = _build_mnist_like(seed=3)
+    compiled = static.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        x = np.zeros((64, 32), np.float32)
+        y = np.zeros((64, 1), np.int64)
+        exe.run(compiled, feed={"img": x, "label": y}, fetch_list=[loss])
+        # the compiled callable shards feeds over all devices: check the
+        # parameter state stayed replicated (valid on every device) and
+        # training across devices produced one consistent value
+        w = scope.find_var(main.all_parameters()[0].name)
+        assert isinstance(w, jax.Array)
+        assert len(w.sharding.device_set) == jax.device_count()
+
+
+@needs_devices
+def test_dp_uneven_batch_raises():
+    main, startup, loss = _build_mnist_like(seed=5)
+    compiled = static.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        x = np.zeros((30, 32), np.float32)  # 30 % 8 != 0
+        y = np.zeros((30, 1), np.int64)
+        with pytest.raises(ValueError, match="does not divide"):
+            exe.run(compiled, feed={"img": x, "label": y}, fetch_list=[loss])
+
+
+def test_compiled_program_type_checks():
+    with pytest.raises(TypeError):
+        static.CompiledProgram(object())
