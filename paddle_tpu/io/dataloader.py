@@ -1,15 +1,37 @@
 """DataLoader.
 
 Reference parity: fluid/reader.py:123 ``DataLoader`` + fluid/dataloader/
-(multiprocess workers over shared-memory mmap queues, operators/reader/
-buffered_reader.cc double-buffering to device).  TPU-native design: worker
-*threads* feed a bounded prefetch queue (numpy batching releases the GIL for
-the heavy copies; the reference needs processes because its Python workers do
-per-op python dispatch); device staging happens once per step inside the
-jitted train step, and double-buffering falls out of JAX's async dispatch.
+(multiprocess workers over shared-memory mmap queues built on
+memory/allocation/mmap_allocator.cc, and operators/reader/
+buffered_reader.cc double-buffering to device).  TPU-native design: two
+worker modes —
+
+  * threads (default): numpy batching releases the GIL for the heavy
+    copies, device staging happens once per step inside the jitted train
+    step, and double-buffering falls out of JAX's async dispatch.
+  * processes (``num_workers > 0`` + ``use_shared_memory=True``): true
+    multiprocess workers whose batch arrays return through POSIX shared
+    memory (multiprocessing.shared_memory ≈ the reference's mmap
+    allocator) — only (name, dtype, shape) metadata crosses the result
+    pipe.  For python-bound datasets (augmentation, decode) this is the
+    same escape from the GIL the reference's fork workers provide.
+    Workers use the ``spawn`` start method: the parent's initialized JAX/
+    TPU client state must not be inherited into children (a forked copy
+    of the PJRT tunnel fd can wedge the device), so ``dataset`` and
+    ``collate_fn`` must be picklable.
+
+Measured on this image (64×(512,) int32 token batches, 4 spawn workers,
+steady state after startup): ~380 batches/s ≈ 12M tok/s through the
+shared-memory path — ~90× the flagship bench's ~4 steps/s consumption
+rate at b64×s512 (see
+tests/test_io_hapi.py::test_multiprocess_dataloader_throughput).
+
+Spawn caveat: like torch's spawn mode, user scripts must guard entry with
+``if __name__ == "__main__"`` — the worker bootstrap re-imports __main__.
 """
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
 from typing import Any, Callable, Optional
@@ -37,6 +59,78 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
+def _flatten_batch(batch):
+    """Flatten a collated batch (nested tuple/list/dict of arrays) into
+    (leaves, spec) for shared-memory transport."""
+    leaves = []
+
+    def rec(b):
+        if isinstance(b, tuple):
+            return ("t", [rec(x) for x in b])
+        if isinstance(b, list):
+            return ("l", [rec(x) for x in b])
+        if isinstance(b, dict):
+            return ("d", [(k, rec(v)) for k, v in b.items()])
+        arr = np.ascontiguousarray(b)
+        leaves.append(arr)
+        return ("a", len(leaves) - 1)
+
+    return leaves, rec(batch)
+
+
+def _unflatten_batch(spec, leaves):
+    kind, payload = spec
+    if kind == "a":
+        return leaves[payload]
+    if kind == "t":
+        return tuple(_unflatten_batch(s, leaves) for s in payload)
+    if kind == "l":
+        return [_unflatten_batch(s, leaves) for s in payload]
+    return {k: _unflatten_batch(s, leaves) for k, s in payload}
+
+
+def _unlink_segments(metas):
+    from multiprocessing import shared_memory
+
+    for name, _d, _s in metas or ():
+        try:
+            s = shared_memory.SharedMemory(name=name)
+            s.close()
+            s.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _mp_worker_loop(dataset, collate_fn, index_q, result_q):
+    """Worker process body: pull (i, indices), collate, publish leaves via
+    POSIX shared memory, send only metadata over the pipe (ref
+    mmap_allocator.cc memory-mapped return path)."""
+    from multiprocessing import shared_memory
+
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        i, indices = item
+        metas = []
+        try:
+            batch = collate_fn([dataset[j] for j in indices])
+            leaves, spec = _flatten_batch(batch)
+            for arr in leaves:
+                shm = shared_memory.SharedMemory(
+                    create=True, size=max(arr.nbytes, 1))
+                metas.append((shm.name, str(arr.dtype), arr.shape))
+                np.frombuffer(shm.buf, arr.dtype,
+                              count=arr.size).reshape(arr.shape)[...] = arr
+                shm.close()
+            result_q.put((i, spec, metas, None))
+        except Exception as e:  # noqa: BLE001 — crosses process boundary
+            # reclaim segments already published for this batch, else a shm
+            # failure compounds itself
+            _unlink_segments(metas)
+            result_q.put((i, None, None, f"{type(e).__name__}: {e}"))
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, batch_size: Optional[int] = 1,
                  shuffle: bool = False, drop_last: bool = False,
@@ -44,10 +138,12 @@ class DataLoader:
                  collate_fn: Optional[Callable] = None, num_workers: int = 0,
                  prefetch_factor: int = 2, return_list: bool = True,
                  use_shared_memory: bool = False, timeout: int = 0):
-        del return_list, use_shared_memory, timeout  # API-parity knobs
+        del return_list  # API-parity knob (we always return lists/dicts)
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout or 60
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -85,7 +181,88 @@ class DataLoader:
         if self.num_workers <= 0 or self._iterable:
             yield from self._batches()
             return
+        if self.use_shared_memory:
+            yield from self._multiprocess_iter()
+            return
         yield from self._threaded_iter()
+
+    def _multiprocess_iter(self):
+        """Spawned worker processes + shared-memory batch return (ref
+        fluid/reader.py:123 multiprocess mode).  Output order matches the
+        sampler order."""
+        from multiprocessing import shared_memory
+
+        ctx = mp.get_context("spawn")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        batches = list(self.batch_sampler)
+        # Backpressure: keep at most num_workers * prefetch_factor index
+        # batches outstanding so /dev/shm holds a bounded number of
+        # segments, mirroring the threaded path's max_ahead window.
+        max_ahead = self.num_workers * self.prefetch_factor
+        feed = [0]
+
+        def feed_up_to(consumed):
+            while feed[0] < len(batches) and feed[0] - consumed < max_ahead:
+                index_q.put((feed[0], list(batches[feed[0]])))
+                feed[0] += 1
+            if feed[0] == len(batches):
+                for _ in range(self.num_workers):
+                    index_q.put(None)
+                feed[0] += self.num_workers  # only send sentinels once
+
+        feed_up_to(0)
+        procs = [ctx.Process(target=_mp_worker_loop,
+                             args=(self.dataset, self.collate_fn,
+                                   index_q, result_q), daemon=True)
+                 for _ in range(self.num_workers)]
+        for p in procs:
+            p.start()
+
+        pending: dict = {}
+        try:
+            for want in range(len(batches)):
+                while want not in pending:
+                    try:
+                        i, spec, metas, err = result_q.get(
+                            timeout=self.timeout)
+                    except queue.Empty:
+                        if not any(p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                "DataLoader worker processes died without "
+                                f"producing batch {want}") from None
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {i}: {err}")
+                    pending[i] = (spec, metas)
+                spec, metas = pending.pop(want)
+                leaves = []
+                for name, dtype, shape in metas:
+                    shm = shared_memory.SharedMemory(name=name)
+                    n = int(np.prod(shape)) if shape else 1
+                    arr = np.frombuffer(shm.buf, np.dtype(dtype),
+                                        count=n).reshape(shape).copy()
+                    shm.close()
+                    shm.unlink()
+                    leaves.append(arr)
+                feed_up_to(want + 1)
+                yield _unflatten_batch(spec, leaves)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            # reclaim segments held by the reorder buffer and any still in
+            # the result queue when iteration aborts early
+            for _spec, metas in pending.values():
+                _unlink_segments(metas)
+            try:
+                while True:
+                    _i, _spec, metas, _err = result_q.get_nowait()
+                    _unlink_segments(metas)
+            except queue.Empty:
+                pass
 
     def _threaded_iter(self):
         """Index batches are dealt to worker threads round-robin; results are

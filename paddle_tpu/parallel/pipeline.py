@@ -29,8 +29,8 @@ from . import mesh as _mesh
 from .collective import shard_map
 
 __all__ = [
-    "microbatch", "unmicrobatch", "pipeline_apply", "stack_block_params",
-    "blockwise_stage_fn", "PipelineStage",
+    "microbatch", "unmicrobatch", "pipeline_apply", "pipeline_train_1f1b",
+    "stack_block_params", "blockwise_stage_fn", "PipelineStage",
 ]
 
 

@@ -28,6 +28,34 @@ class RangeDataset(Dataset):
         return self.n
 
 
+class _FailingDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom")
+        return np.float32(i)
+
+    def __len__(self):
+        return self.n
+
+
+class _TokenDataset(Dataset):
+    """b64xs512 int32 token samples (the flagship bench feed shape)."""
+
+    def __init__(self, seq, n=512):
+        self.seq = seq
+        self.n = n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return rng.integers(0, 18000, (self.seq,)).astype(np.int32)
+
+    def __len__(self):
+        return self.n
+
+
 class TestDataLoader:
     def test_basic_batching(self):
         dl = DataLoader(RangeDataset(10), batch_size=3)
@@ -64,6 +92,39 @@ class TestDataLoader:
         dl = DataLoader(Bad(), batch_size=2, num_workers=2)
         with pytest.raises(ValueError, match="boom"):
             list(dl)
+
+    def test_multiprocess_shared_memory_order_and_values(self):
+        dl = DataLoader(RangeDataset(23), batch_size=4, num_workers=2,
+                        use_shared_memory=True)
+        got = list(dl)
+        assert len(got) == 6
+        xs = np.concatenate([b[0] for b in got])
+        np.testing.assert_allclose(xs, np.arange(23, dtype=np.float32))
+        ys = np.concatenate([b[1] for b in got])
+        np.testing.assert_array_equal(ys, np.arange(23) % 3)
+
+    def test_multiprocess_worker_error_propagates(self):
+        dl = DataLoader(_FailingDataset(10), batch_size=2, num_workers=2,
+                        use_shared_memory=True, timeout=30)
+        with pytest.raises(RuntimeError, match="boom|worker"):
+            list(dl)
+
+    def test_multiprocess_dataloader_throughput(self):
+        """The shared-memory pipeline must sustain far more than the bench
+        step rate (~4 batches/s at b64xs512); the measured number is
+        recorded in io/dataloader.py's module docstring."""
+        import time
+        ds = _TokenDataset(512)
+        dl = DataLoader(ds, batch_size=64, num_workers=4,
+                        use_shared_memory=True)
+        t0 = time.perf_counter()
+        n = sum(1 for _ in dl)
+        dt = time.perf_counter() - t0
+        rate = n / dt
+        assert n == 8
+        # generous floor: spawn startup dominates this tiny run; the
+        # steady-state rate is far higher (see docstring measurement)
+        assert rate > 0.5, f"{rate:.2f} batches/s"
 
     def test_iterable_dataset(self):
         class Stream(IterableDataset):
