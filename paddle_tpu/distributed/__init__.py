@@ -2,7 +2,7 @@
 distributed/).  Thin parity namespace over paddle_tpu.parallel: collectives
 (collective.py:59–:419 of the reference), ParallelEnv, init_parallel_env, and
 the fleet facade."""
-from . import env, ps
+from . import env, ps, ps_server
 from .env import ParallelEnv, get_rank, get_world_size
 from .ps import (
     AsyncCommunicator,
@@ -11,6 +11,7 @@ from .ps import (
     LargeScaleEmbedding,
     SparseTable,
 )
+from .ps_server import PSServer, RemoteSparseTable
 
 from ..parallel.mesh import init_parallel_env
 from ..parallel.collective import (

@@ -1,0 +1,299 @@
+"""Cross-process parameter-server transport.
+
+Reference parity: the PS RPC runtime — `RPCClient`/`RPCServer` over gRPC
+with zero-copy LoDTensor serialization
+(operators/distributed/grpc/grpc_client.cc, sendrecvop_utils.cc,
+send_recv.proto.in), request handlers for Send/Get
+(request_handler_impl.cc), and `ListenAndServOp`'s serve loop
+(operators/distributed_ops/listen_and_serv_op.cc).
+
+TPU-native design: the data plane for dense training is ICI/XLA
+collectives; what needs a *wire* is only the host-side sparse table
+(SparseTable in ps.py).  So instead of gRPC + protobuf the transport is a
+deliberately small length-prefixed binary framing over TCP (DCN): each
+message is  op byte + array count + per-array (dtype, shape, raw bytes) —
+numpy buffers go over the socket without pickling.  `PSServer` hosts a
+SparseTable; `RemoteSparseTable` exposes the SAME pull/push/apply_delta/
+state_dict API as the in-process table, routing rows to servers by
+``id % num_servers`` (the reference's ParameterSend row split across
+pservers), so AsyncCommunicator/GeoCommunicator work unchanged across the
+process boundary (tests/test_ps_server.py runs 2-process GEO-SGD).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .ps import SparseTable
+
+__all__ = ["PSServer", "RemoteSparseTable", "serve_forever"]
+
+_OP_PULL = 1
+_OP_PUSH = 2
+_OP_DELTA = 3
+_OP_NUM_ROWS = 4
+_OP_STATE = 5
+_OP_LOAD = 6
+_OP_SHUTDOWN = 7
+_OP_OK = 100
+_OP_ERR = 101
+
+_STATE_KEYS = ("ids", "rows", "accum", "accum2", "steps")
+
+
+def _send_msg(sock: socket.socket, op: int, arrays: Sequence[np.ndarray]):
+    parts = [struct.pack("<BI", op, len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        ds = a.dtype.str.encode()
+        parts.append(struct.pack("<B", len(ds)))
+        parts.append(ds)
+        parts.append(struct.pack("<B", a.ndim))
+        if a.ndim:
+            parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    payload = b"".join(parts)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    buf = _recv_exact(sock, total)
+    off = 0
+    op, count = struct.unpack_from("<BI", buf, off)
+    off += 5
+    arrays = []
+    for _ in range(count):
+        (dlen,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dtype = np.dtype(buf[off:off + dlen].decode())
+        off += dlen
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        arr = np.frombuffer(buf, dtype, count=(nbytes // dtype.itemsize),
+                            offset=off).reshape(shape).copy()
+        off += nbytes
+        arrays.append(arr)
+    return op, arrays
+
+
+class PSServer:
+    """Serves one SparseTable over TCP (ref listen_and_serv_op.cc serve
+    loop; one handler thread per connection ≈ its RPC thread pool)."""
+
+    def __init__(self, table: SparseTable, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.table = table
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._running = False
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "PSServer":
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        with conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                try:
+                    op, arrays = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    if op == _OP_PULL:
+                        rows = self.table.pull(arrays[0])
+                        _send_msg(conn, _OP_OK, [rows])
+                    elif op == _OP_PUSH:
+                        ids, grads, lr = arrays
+                        self.table.push(ids, grads, float(lr[0]))
+                        _send_msg(conn, _OP_OK, [])
+                    elif op == _OP_DELTA:
+                        self.table.apply_delta(arrays[0], arrays[1])
+                        _send_msg(conn, _OP_OK, [])
+                    elif op == _OP_NUM_ROWS:
+                        _send_msg(conn, _OP_OK,
+                                  [np.asarray([self.table.num_rows],
+                                              np.int64)])
+                    elif op == _OP_STATE:
+                        st = self.table.state_dict()
+                        _send_msg(conn, _OP_OK,
+                                  [st[k] for k in _STATE_KEYS])
+                    elif op == _OP_LOAD:
+                        self.table.load_state_dict(
+                            dict(zip(_STATE_KEYS, arrays)))
+                        _send_msg(conn, _OP_OK, [])
+                    elif op == _OP_SHUTDOWN:
+                        _send_msg(conn, _OP_OK, [])
+                        self.stop()
+                        return
+                    else:
+                        _send_msg(conn, _OP_ERR,
+                                  [np.frombuffer(f"bad op {op}".encode(),
+                                                 np.uint8)])
+                except Exception as e:  # noqa: BLE001 — report to client
+                    try:
+                        _send_msg(conn, _OP_ERR, [np.frombuffer(
+                            f"{type(e).__name__}: {e}".encode(), np.uint8)])
+                    except OSError:
+                        return
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Conn:
+    """One persistent client connection (lock-serialized request/response)."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=60)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+    def call(self, op: int, arrays: Sequence[np.ndarray]):
+        with self.lock:
+            _send_msg(self.sock, op, arrays)
+            rop, out = _recv_msg(self.sock)
+        if rop == _OP_ERR:
+            raise RuntimeError(
+                "PS server error: " + bytes(out[0]).decode(errors="replace"))
+        return out
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RemoteSparseTable:
+    """Client-side table with the SparseTable API, rows routed to servers
+    by ``id % num_servers`` (ref ParameterSend VarBlock row split).  Plug
+    it into AsyncCommunicator/GeoCommunicator for cross-process PS."""
+
+    def __init__(self, endpoints: Sequence[str], dim: int):
+        self.dim = dim
+        self._conns = [_Conn(e) for e in endpoints]
+        self.n = len(self._conns)
+
+    def _route(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        return ids, ids % self.n
+
+    def pull(self, ids) -> np.ndarray:
+        ids, srv = self._route(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for s in range(self.n):
+            m = srv == s
+            if m.any():
+                (rows,) = self._conns[s].call(_OP_PULL, [ids[m]])
+                out[m] = rows
+        return out
+
+    def push(self, ids, grads, lr: float = 0.1) -> None:
+        ids, srv = self._route(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        lr_arr = np.asarray([lr], np.float32)
+        for s in range(self.n):
+            m = srv == s
+            if m.any():
+                self._conns[s].call(_OP_PUSH, [ids[m], grads[m], lr_arr])
+
+    def apply_delta(self, ids, delta) -> None:
+        ids, srv = self._route(ids)
+        delta = np.asarray(delta, np.float32).reshape(len(ids), self.dim)
+        for s in range(self.n):
+            m = srv == s
+            if m.any():
+                self._conns[s].call(_OP_DELTA, [ids[m], delta[m]])
+
+    @property
+    def num_rows(self) -> int:
+        return sum(int(c.call(_OP_NUM_ROWS, [])[0][0]) for c in self._conns)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        parts = [dict(zip(_STATE_KEYS, c.call(_OP_STATE, [])))
+                 for c in self._conns]
+        out = {k: np.concatenate([p[k] for p in parts]) for k in _STATE_KEYS}
+        order = np.argsort(out["ids"])
+        return {k: v[order] for k, v in out.items()}
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        ids = np.asarray(state["ids"], np.int64)
+        srv = ids % self.n
+        for s in range(self.n):
+            m = srv == s
+            self._conns[s].call(
+                _OP_LOAD, [np.asarray(state[k])[m] for k in _STATE_KEYS])
+
+    def shutdown_servers(self) -> None:
+        for c in self._conns:
+            try:
+                c.call(_OP_SHUTDOWN, [])
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+
+    def close(self) -> None:
+        for c in self._conns:
+            c.close()
+
+
+def serve_forever(dim: int, port: int, num_shards: int = 4,
+                  optimizer: str = "adagrad", seed: int = 0) -> None:
+    """Blocking server entry point for a dedicated pserver process
+    (ref: the pserver side of fleet launch_ps, launch.py:226)."""
+    import time
+
+    server = PSServer(SparseTable(dim, num_shards, optimizer=optimizer,
+                                  seed=seed), port=port)
+    server.start()
+    while server._running:
+        time.sleep(0.2)
