@@ -27,9 +27,12 @@ from paddle_tpu.text.ernie import (
     ErniePretrainingCriterion,
 )
 
-# First TPU measurement gets recorded here by hand once known; the driver's
-# BENCH_r{N}.json history is the source of truth.
-BASELINE_TOK_PER_SEC = float(os.environ.get("BENCH_BASELINE_TOKS", "0") or 0)
+# The first recorded TPU measurement is the baseline (BASELINE.md):
+# round 1 measured 44,322 tok/s/chip on this config (BENCH_r01.json).
+# vs_baseline therefore reports progress against r01; override with
+# BENCH_BASELINE_TOKS to rebase.
+BASELINE_TOK_PER_SEC = float(os.environ.get("BENCH_BASELINE_TOKS", "")
+                             or 44322.17)
 
 
 def main():
@@ -75,15 +78,20 @@ def main():
             rng.integers(0, cfg.vocab_size, (batch, n_mask)), jnp.int32),
         "nsp_labels": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
     }
-    key = jax.random.PRNGKey(0)
+    # rbg (hardware) PRNG for dropout: threefry mask generation alone costs
+    # ~45ms/step at this shape (measured r03); the typed key carries its
+    # impl into every fold_in/bernoulli downstream.
+    key = jax.random.key(0, impl="rbg" if on_tpu else "threefry2x32")
 
     # Sync via a host read of the (scalar) loss every k steps: on the axon
     # TPU tunnel, block_until_ready does not reliably wait and deep
     # unsynchronized dispatch chains wedge the device.  Steps already chain
     # through donated params, so a sync every k steps bounds the outstanding
-    # dispatch depth while amortizing the tunnel round-trip (VERDICT r1
-    # weak #2b: per-step float(loss) dominated step time).
-    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "4"))
+    # dispatch depth while amortizing the tunnel round-trip — measured
+    # ~120 ms dead time per sync (r03), i.e. 30 ms/step at k=4 vs 6 ms/step
+    # at k=20.  k=20 has run clean repeatedly; tighten via env if the
+    # tunnel regresses.
+    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "20"))
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch_data, key)
         float(loss)

@@ -109,7 +109,6 @@ class PSServer:
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
-        self._threads: List[threading.Thread] = []
         self._accept_thread: Optional[threading.Thread] = None
         self._running = False
 
@@ -130,10 +129,11 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            # daemon handler threads exit with their connection; no registry
+            # (a long-lived pserver accepting per-epoch reconnects must not
+            # accumulate dead Thread objects)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
         with conn:

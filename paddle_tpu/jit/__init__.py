@@ -69,10 +69,24 @@ def _canon(x):
 
 class StaticFunction:
     """The object `to_static` returns (ref program_translator.py
-    StaticFunction): callable with per-signature compiled-program caching."""
+    StaticFunction): callable with per-signature compiled-program caching.
+
+    Data-dependent Python `if`/`while` over tensors are AST-rewritten to
+    lax.cond/lax.while_loop dispatchers when the function is inside the
+    dy2static subset (see jit/dy2static.py); otherwise the original
+    trace-based path applies (matching the reference's convert-or-fallback
+    behavior, program_translator.py:667)."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  input_spec: Optional[Sequence[InputSpec]] = None):
+        from . import dy2static
+
+        self._orig_fn = fn
+        try:
+            fn = dy2static.ast_transform(fn)
+            self._converted = True
+        except dy2static.Unsupported:
+            self._converted = False
         self._fn = fn
         self._layer = layer
         self.input_spec = list(input_spec) if input_spec else None

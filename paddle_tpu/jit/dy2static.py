@@ -1,0 +1,321 @@
+"""Dygraph-to-static AST conversion for data-dependent Python control flow.
+
+Reference parity: the dygraph_to_static transformer pipeline —
+`ProgramTranslator` (fluid/dygraph/dygraph_to_static/program_translator.py:667)
+with its per-construct transformers (ifelse_transformer.py,
+loop_transformer.py) and the `convert_ifelse`/`convert_while_loop` runtime
+dispatchers (convert_operators.py), which let `@to_static` code keep Python
+`if`/`while` over tensors.
+
+TPU-native design: most dygraph code traces directly under jax.jit, so the
+AST pass only needs to rewrite the two constructs tracing cannot express —
+`if` and `while` whose predicate is a *traced* value — into runtime
+dispatchers that pick `lax.cond` / `lax.while_loop` when the predicate is a
+tensor and plain Python control flow otherwise (exactly the reference's
+convert_* contract).  Supported subset (documented, checked):
+
+  * `if`/`elif`/`else` where every name live after the branch is assigned
+    in BOTH branches (lax.cond needs matching output structures),
+  * `while` whose carried names exist before the loop and keep
+    shape/dtype (lax.while_loop shape-invariant carry),
+  * no `break`/`continue`/`return` inside converted bodies, no closures
+    over free variables being mutated.
+
+Functions using constructs outside the subset fall back to plain tracing
+(data-INdependent control flow still works there); a data-dependent
+predicate will then raise jax's TracerBoolConversionError as before.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ast_transform", "convert_ifelse", "convert_while", "Unsupported"]
+
+
+class Unsupported(Exception):
+    """Raised when a function is outside the convertible subset."""
+
+
+_UNDEF = object()  # placeholder for names not yet bound before an `if`
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, (jax.core.Tracer, jax.Array))
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   args: Tuple) -> Tuple:
+    """ref convert_operators.py convert_ifelse: tensor pred -> lax.cond,
+    python pred -> plain call."""
+    if _is_traced(pred):
+        p = jnp.reshape(pred, ()).astype(bool)
+        out_t = true_fn(*args)
+        out_f = false_fn(*args)
+        _check_match(out_t, out_f)
+        # names unbound before the `if` (fresh in both branches) carry a
+        # placeholder; lax.cond operands must be arrays, so substitute a
+        # dummy — the branches provably assign before use (checked above)
+        safe = tuple(jnp.zeros(()) if a is _UNDEF else a for a in args)
+        return jax.lax.cond(p, lambda a: true_fn(*a), lambda a: false_fn(*a),
+                            safe)
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def _check_match(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    for x, y in zip(fa, fb):
+        xs = getattr(x, "shape", ()) if x is not _UNDEF else None
+        ys = getattr(y, "shape", ()) if y is not _UNDEF else None
+        if x is _UNDEF or y is _UNDEF or xs != ys:
+            raise Unsupported(
+                "converted `if`: both branches must assign every output "
+                f"with matching shapes (got {xs} vs {ys}); a name assigned "
+                "in only one branch cannot cross a lax.cond boundary")
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, carry: Tuple) -> Tuple:
+    """ref convert_operators.py convert_while_loop."""
+    probe = cond_fn(*carry)
+    if _is_traced(probe):
+        if any(c is _UNDEF for c in carry):
+            raise Unsupported(
+                "converted `while`: every carried variable must be bound "
+                "before the loop (lax.while_loop carry)")
+        return jax.lax.while_loop(
+            lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
+            lambda c: tuple(body_fn(*c)), tuple(carry))
+    while cond_fn(*carry):
+        carry = tuple(body_fn(*carry))
+    return carry
+
+
+# ------------------------------------------------------------------ AST ----
+
+def _assigned_names(nodes: Sequence[ast.stmt]) -> list:
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Store) and n.id not in names:
+                names.append(n.id)
+
+        def visit_FunctionDef(self, n):  # don't descend into nested defs
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_AugAssign(self, n):
+            if isinstance(n.target, ast.Name) and n.target.id not in names:
+                names.append(n.target.id)
+            self.generic_visit(n)
+
+    for s in nodes:
+        V().visit(s)
+    return names
+
+
+class _Checker(ast.NodeVisitor):
+    """Reject constructs the subset cannot express inside converted bodies."""
+
+    def __init__(self):
+        self.banned = None
+
+    def visit_Break(self, n):
+        self.banned = "break"
+
+    def visit_Continue(self, n):
+        self.banned = "continue"
+
+    def visit_Return(self, n):
+        self.banned = "return"
+
+    def visit_Yield(self, n):
+        self.banned = "yield"
+
+    def visit_FunctionDef(self, n):
+        # nested defs (incl. ones this transformer generated for an inner
+        # converted construct) own their returns — don't descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _check_body(nodes):
+    c = _Checker()
+    for s in nodes:
+        c.visit(s)
+    if c.banned:
+        raise Unsupported(
+            f"`{c.banned}` inside a converted control-flow body is outside "
+            "the dy2static subset")
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__pdtpu_{kind}_{self.counter}"
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        outs = sorted(set(_assigned_names(node.body))
+                      | set(_assigned_names(node.orelse)))
+        if not outs:
+            # pure side-effect-free branch on possibly-traced pred is
+            # meaningless; leave python semantics (will raise if traced)
+            return node
+        _check_body(node.body)
+        _check_body(node.orelse)
+        tname, fname = self._fresh("true"), self._fresh("false")
+        args = [ast.arg(arg=n) for n in outs]
+
+        def mk(nm, body):
+            stmts = list(body) or [ast.Pass()]
+            stmts.append(ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in outs],
+                ctx=ast.Load())))
+            return ast.FunctionDef(
+                name=nm,
+                args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                                   kwonlyargs=[], kw_defaults=[], kwarg=None,
+                                   defaults=[]),
+                body=stmts, decorator_list=[], returns=None)
+
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in outs],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pdtpu_convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[
+                          ast.Call(func=ast.Name(id="__pdtpu_maybe",
+                                                 ctx=ast.Load()),
+                                   args=[ast.Call(func=ast.Name(
+                                       id="locals", ctx=ast.Load()),
+                                       args=[], keywords=[]),
+                                       ast.Constant(value=n)],
+                                   keywords=[])
+                          for n in outs], ctx=ast.Load())],
+                keywords=[]))
+        # restore python semantics for names the taken branch did not bind:
+        # `if __pdtpu_is_undef(x): del x` so a later read raises
+        # UnboundLocalError exactly like the untransformed code (only
+        # reachable on the python-predicate path; the traced path proves
+        # both branches assign)
+        cleanup = [ast.If(
+            test=ast.Call(func=ast.Name(id="__pdtpu_is_undef",
+                                        ctx=ast.Load()),
+                          args=[ast.Name(id=n, ctx=ast.Load())],
+                          keywords=[]),
+            body=[ast.Delete(targets=[ast.Name(id=n, ctx=ast.Del())])],
+            orelse=[]) for n in outs]
+        return [mk(tname, node.body), mk(fname, node.orelse), call] + cleanup
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            raise Unsupported("while/else is outside the dy2static subset")
+        _check_body(node.body)
+        carries = sorted(set(_assigned_names(node.body)))
+        if not carries:
+            raise Unsupported(
+                "converted `while` body assigns nothing: infinite or "
+                "side-effect loop cannot become lax.while_loop")
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        args = [ast.arg(arg=n) for n in carries]
+        cond_fn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_stmts = list(node.body)
+        body_stmts.append(ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carries],
+            ctx=ast.Load())))
+        body_fn = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=body_stmts, decorator_list=[], returns=None)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carries],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pdtpu_convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[
+                          ast.Call(func=ast.Name(id="__pdtpu_maybe",
+                                                 ctx=ast.Load()),
+                                   args=[ast.Call(func=ast.Name(
+                                       id="locals", ctx=ast.Load()),
+                                       args=[], keywords=[]),
+                                       ast.Constant(value=n)],
+                                   keywords=[])
+                          for n in carries], ctx=ast.Load())],
+                keywords=[]))
+        return [cond_fn, body_fn, call]
+
+
+def _maybe(frame_locals, name):
+    return frame_locals.get(name, _UNDEF)
+
+
+def _is_undef(x) -> bool:
+    return x is _UNDEF
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Return fn with data-dependent if/while rewritten, or raise
+    Unsupported when conversion cannot apply (caller falls back to plain
+    tracing — the reference logs and falls back the same way)."""
+    if inspect.ismethod(fn):
+        return ast_transform(fn.__func__).__get__(fn.__self__)
+    if fn.__closure__:
+        raise Unsupported(
+            "functions with closures are outside the dy2static subset "
+            "(recompiling would sever the closure cells)")
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Unsupported(f"source unavailable: {e}") from e
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise Unsupported("not a plain function definition")
+    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+        raise Unsupported("nothing to convert")
+    fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
+    new_tree = _Transformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, f"<dy2static {fn.__qualname__}>", "exec")
+    glb = dict(fn.__globals__)
+    glb["__pdtpu_convert_ifelse"] = convert_ifelse
+    glb["__pdtpu_convert_while"] = convert_while
+    glb["__pdtpu_maybe"] = _maybe
+    glb["__pdtpu_is_undef"] = _is_undef
+    loc: dict = {}
+    exec(code, glb, loc)
+    out = loc[fdef.name]
+    functools.update_wrapper(out, fn)
+    return out

@@ -9,6 +9,20 @@ vars + ops + attrs — human-readable, replaces the protobuf ProgramDesc) and
 `params.npz` (every persistable's value).  load_inference_model rebuilds the
 Program and returns (program, feed_names, fetch_names) exactly like the
 reference API.
+
+WIRE-COMPAT DESCOPE (deliberate, recorded): this format is NOT
+byte-compatible with the reference's `framework.proto:212` ProgramDesc or
+`save_inference_model`'s `__model__` + per-var LoDTensor files.  Rationale:
+(a) the proto encodes executor-era concepts (LoD levels, kernel hints,
+op-version map) that have no meaning under the XLA lowering, so a faithful
+decoder would immediately re-encode into this in-memory form anyway;
+(b) no reference-built binary models exist in this environment to migrate;
+(c) JSON + npz keeps the format inspectable and diffable.  A migration
+would need: a protobuf schema copy of framework.proto, a desc→Program
+decoder mapping each OpDesc attr onto the registered lowerings (the op
+names already match), and a LoDTensor file reader (plain header + raw
+bytes).  The op-name/attr parity maintained throughout static/ops.py is
+what keeps that door open.
 """
 from __future__ import annotations
 

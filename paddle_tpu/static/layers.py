@@ -25,7 +25,7 @@ from .framework import (
 
 __all__ = [
     "data", "fc", "conv2d", "pool2d", "batch_norm", "embedding", "dropout",
-    "relu", "sigmoid", "tanh", "softmax", "cross_entropy",
+    "relu", "sigmoid", "tanh", "softmax", "cross_entropy", "square_error_cost",
     "softmax_with_cross_entropy", "mean", "reduce_sum", "reduce_mean",
     "accuracy", "reshape", "transpose", "concat", "split", "flatten", "cast",
     "scale", "fill_constant", "elementwise_add", "elementwise_sub",
@@ -77,9 +77,28 @@ def _init_attrs(initializer, shape, dtype):
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      default_initializer=None, trainable=True) -> Parameter:
     """Create a Parameter in the main program + its init op in startup
-    (ref layer_helper_base.py create_parameter)."""
+    (ref layer_helper_base.py create_parameter).
+
+    A string ``attr`` (or ``ParamAttr(name=...)``) names the parameter;
+    re-using a name SHARES the existing parameter (the reference's
+    ``param_attr='shared_w'`` weight-sharing idiom, e.g. the word2vec book
+    model's common embedding table) — shapes must then match."""
     initializer = getattr(attr, "initializer", None) or default_initializer
-    name = name or getattr(attr, "name", None) or unique_name("param")
+    attr_name = attr if isinstance(attr, str) else getattr(attr, "name", None)
+    name = name or attr_name or unique_name("param")
+    existing = _main_block().program._parameters.get(name)
+    if existing is not None:
+        if tuple(existing.shape) != tuple(shape):
+            raise ValueError(
+                f"shared parameter {name!r} has shape {existing.shape}, "
+                f"requested {tuple(shape)}")
+        if np.dtype(existing.dtype) != np.dtype(dtype):
+            raise ValueError(
+                f"shared parameter {name!r} has dtype {existing.dtype}, "
+                f"requested {dtype}")
+        # first creation wins for trainable/initializer (the reference's
+        # ParamAttr sharing semantics); shape+dtype are validated above
+        return existing
     p = _main_block().create_parameter(name, shape, dtype, trainable,
                                        initializer)
     sp = _startup_block()
@@ -411,7 +430,20 @@ def flatten(x, axis=1) -> Variable:
 
 
 def concat(inputs, axis=0) -> Variable:
-    out = _out(inputs[0].dtype, (-1,) * inputs[0].ndim)
+    # infer shape: concat dim sums (unknown if any input unknown), other
+    # dims copy the first statically-known size (downstream fc/create_
+    # parameter derive weight shapes from this metadata)
+    ndim = inputs[0].ndim
+    ax = axis % ndim
+    shape = []
+    for d in range(ndim):
+        dims = [v.shape[d] for v in inputs]
+        if d == ax:
+            shape.append(-1 if any(s < 0 for s in dims) else int(sum(dims)))
+        else:
+            known = [s for s in dims if s >= 0]
+            shape.append(known[0] if known else -1)
+    out = _out(inputs[0].dtype, tuple(shape))
     _append("concat", {"X": [v.name for v in inputs]}, {"Out": [out.name]},
             {"axis": axis})
     return out
@@ -430,6 +462,14 @@ def split(x, num_or_sections, dim=0):
 
 
 # -- loss / metrics ----------------------------------------------------------
+
+def square_error_cost(input, label) -> Variable:
+    """ref fluid/layers/loss.py square_error_cost: (input - label)^2."""
+    out = _out(input.dtype, input.shape)
+    _append("square_error_cost", {"X": [input.name], "Label": [label.name]},
+            {"Out": [out.name]})
+    return out
+
 
 def cross_entropy(input, label, soft_label=False) -> Variable:
     out = _out(input.dtype, input.shape[:-1] + (1,))

@@ -11,7 +11,13 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    # Tests compile model-sized graphs on ONE CPU core; backend opt level 0
+    # cuts XLA CPU compile ~30% and the tiny test arrays don't need fast
+    # codegen (measured r03: vision-zoo file 61s -> 43s cold).
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 
 import jax
@@ -19,7 +25,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compile cache (machine-local): model-sized test graphs cost
 # 10-70s each to compile; re-runs hit the disk cache instead.
-jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("PDTPU_TEST_CACHE_DIR",
+                                 "/tmp/paddle_tpu_jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 import numpy as np

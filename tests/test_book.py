@@ -1,0 +1,145 @@
+"""Book regression models (ref python/paddle/fluid/tests/book/):
+fit_a_line and word2vec ported verbatim-modulo-imports-and-datasets — the
+program structure, layer calls, train-until-threshold loop, and
+save/load_inference_model round trip match the reference tests; the
+datasets are synthetic (no network in this environment).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+def _uci_housing_like(n=200, seed=0):
+    """Synthetic stand-in for paddle.dataset.uci_housing: 13 features with a
+    linear ground truth + noise."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 13)).astype(np.float32)
+    w = rng.normal(0, 1, (13, 1)).astype(np.float32)
+    Y = (X @ w + 0.1 * rng.normal(0, 1, (n, 1))).astype(np.float32)
+    return X, Y
+
+
+def test_fit_a_line(tmp_path, _fresh_programs):
+    """ref book/test_fit_a_line.py:42 train(): fc regression on 13 features,
+    square_error_cost + mean, SGD, train until avg loss below threshold,
+    then save_inference_model and infer."""
+    main, startup = _fresh_programs
+    x = L.data("x", [13])
+    y_predict = L.fc(x, 1, act=None)
+    y = L.data("y", [1])
+    cost = L.square_error_cost(y_predict, y)
+    avg_cost = L.mean(cost)
+    opt = static.optimizer.SGD(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    X, Y = _uci_housing_like()
+    exe = static.Executor()
+    exe.run(startup)
+    BATCH = 20
+    loss_val = None
+    for epoch in range(100):
+        for i in range(0, len(X), BATCH):
+            loss_val, = exe.run(main,
+                                feed={"x": X[i:i + BATCH],
+                                      "y": Y[i:i + BATCH]},
+                                fetch_list=[avg_cost])
+            assert np.isfinite(float(loss_val)), "got NaN loss"
+        if float(loss_val) < 0.1:
+            break
+    assert float(loss_val) < 0.1, f"fit_a_line cost too large: {loss_val}"
+
+    save_dir = str(tmp_path / "fit_a_line.model")
+    static.save_inference_model(save_dir, ["x"], [y_predict], exe)
+
+    infer_prog, feed_names, fetch_vars = static.load_inference_model(
+        save_dir, exe)
+    assert feed_names == ["x"]
+    probe = X[:8]
+    pred, = exe.run(infer_prog, feed={"x": probe}, fetch_list=fetch_vars)
+    ref, = exe.run(main, feed={"x": probe, "y": Y[:8]},
+                   fetch_list=[y_predict])
+    np.testing.assert_allclose(pred, ref, rtol=1e-5)
+
+
+def _imikolov_like(dict_size, n=512, window=5, seed=1):
+    """Synthetic imikolov-style n-grams with learnable structure: the next
+    word is a deterministic function of the previous ones."""
+    rng = np.random.default_rng(seed)
+    words = rng.integers(0, dict_size, (n, window - 1)).astype(np.int64)
+    nxt = (words.sum(axis=1) % dict_size).astype(np.int64)
+    return words, nxt
+
+
+def test_word2vec(tmp_path, _fresh_programs):
+    """ref book/test_word2vec.py:27 train(): four embeddings SHARING one
+    table (param_attr='shared_w'), concat, sigmoid fc, softmax fc,
+    cross_entropy on probabilities; train until loss drops, then
+    save/load_inference_model."""
+    main, startup = _fresh_programs
+    EMBED_SIZE, HIDDEN_SIZE, BATCH = 32, 256, 32
+    dict_size = 64
+
+    word_vars = [L.data(n, [1], dtype="int64")
+                 for n in ("firstw", "secondw", "thirdw", "forthw")]
+    next_word = L.data("nextw", [1], dtype="int64")
+
+    embeds = [L.embedding(w, size=[dict_size, EMBED_SIZE],
+                          param_attr="shared_w") for w in word_vars]
+    # one shared table: exactly one parameter exists
+    assert len(main.all_parameters()) == 1
+
+    concat_embed = L.concat([L.reshape(e, [-1, EMBED_SIZE]) for e in embeds],
+                            axis=1)
+    hidden1 = L.fc(concat_embed, HIDDEN_SIZE, act="sigmoid")
+    predict_word = L.fc(hidden1, dict_size, act="softmax")
+    cost = L.cross_entropy(predict_word, next_word)
+    avg_cost = L.mean(cost)
+    opt = static.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_cost)
+
+    words, nxt = _imikolov_like(dict_size)
+    exe = static.Executor()
+    exe.run(startup)
+
+    first_loss = last_loss = None
+    for epoch in range(60):
+        for i in range(0, len(words), BATCH):
+            feed = {
+                "firstw": words[i:i + BATCH, 0:1],
+                "secondw": words[i:i + BATCH, 1:2],
+                "thirdw": words[i:i + BATCH, 2:3],
+                "forthw": words[i:i + BATCH, 3:4],
+                "nextw": nxt[i:i + BATCH, None],
+            }
+            last_loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            assert np.isfinite(float(last_loss)), "got NaN loss"
+            if first_loss is None:
+                first_loss = float(last_loss)
+        if float(last_loss) < 3.0:
+            break
+    assert float(last_loss) < float(first_loss), (first_loss, last_loss)
+    assert float(last_loss) < 3.0, f"word2vec cost too large: {last_loss}"
+
+    save_dir = str(tmp_path / "word2vec.model")
+    static.save_inference_model(
+        save_dir, ["firstw", "secondw", "thirdw", "forthw"],
+        [predict_word], exe)
+    infer_prog, feed_names, fetch_vars = static.load_inference_model(
+        save_dir, exe)
+    probe = {
+        "firstw": words[:4, 0:1], "secondw": words[:4, 1:2],
+        "thirdw": words[:4, 2:3], "forthw": words[:4, 3:4],
+    }
+    pred, = exe.run(infer_prog, feed=probe, fetch_list=fetch_vars)
+    assert pred.shape == (4, dict_size)
+    np.testing.assert_allclose(pred.sum(axis=1), np.ones(4), rtol=1e-4)

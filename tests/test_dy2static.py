@@ -1,0 +1,158 @@
+"""dygraph-to-static AST conversion (jit/dy2static.py).
+
+Mirrors the reference's dygraph_to_static tests
+(unittests/dygraph_to_static/test_ifelse.py, test_loop.py): data-dependent
+Python if/while convert to lax.cond/lax.while_loop under jit; plain-python
+predicates keep eager semantics; out-of-subset functions fall back to
+tracing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pd
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import Unsupported, ast_transform
+
+
+def test_data_dependent_if_under_jit():
+    @to_static
+    def f(x):
+        if jnp.sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y
+
+    assert f._converted
+    pos = jnp.ones((3,))
+    neg = -jnp.ones((3,))
+    np.testing.assert_allclose(np.asarray(f(pos)), 2.0 * np.ones(3))
+    np.testing.assert_allclose(np.asarray(f(neg)), np.ones(3))
+    # and it really works inside an outer jit (traced predicate)
+    g = jax.jit(lambda x: f._fn(x))
+    np.testing.assert_allclose(np.asarray(g(pos)), 2.0 * np.ones(3))
+    np.testing.assert_allclose(np.asarray(g(neg)), np.ones(3))
+
+
+def test_if_read_modify_both_branches():
+    @to_static
+    def f(x):
+        y = jnp.zeros_like(x)
+        if jnp.max(x) > 1.0:
+            y = y + x
+        else:
+            y = y - x
+        return y + 1.0
+
+    big = jnp.full((2,), 3.0)
+    np.testing.assert_allclose(np.asarray(f(big)), [4.0, 4.0])
+    small = jnp.full((2,), 0.5)
+    np.testing.assert_allclose(np.asarray(f(small)), [0.5, 0.5])
+
+
+def test_data_dependent_while_under_jit():
+    @to_static
+    def f(n):
+        i = jnp.asarray(0, jnp.int32)
+        s = jnp.asarray(0.0)
+        while i < n:
+            s = s + 2.0
+            i = i + 1
+        return s
+
+    assert f._converted
+    assert float(f(jnp.asarray(5, jnp.int32))) == 10.0
+    assert float(f(jnp.asarray(0, jnp.int32))) == 0.0
+
+
+def test_python_bool_predicate_keeps_eager_semantics():
+    side = []
+
+    @to_static
+    def f(x, flag):
+        if flag:
+            side.append(1)  # must only run when flag is truthy
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    # NOTE: called OUTSIDE jit with a python bool — normal python control
+    # flow applies (the reference's convert_ifelse contract)
+    out = f._fn(np.float32(1.0), True)
+    assert float(out[0] if isinstance(out, tuple) else out) == 2.0
+    assert side == [1]
+
+
+def test_while_in_layer_forward():
+    class StepCount(nn.Layer):
+        def forward(self, x):
+            i = jnp.asarray(0, jnp.int32)
+            h = x
+            while jnp.max(jnp.abs(h)) > 1.0:
+                h = h * 0.5
+                i = i + 1
+            return h, i
+
+    layer = to_static(StepCount())
+    h, i = layer(jnp.asarray([8.0]))
+    assert float(h[0]) == 1.0 and int(i) == 3
+
+
+def test_break_falls_back_to_trace():
+    @to_static
+    def f(x):
+        s = x
+        while float(jnp.sum(s)) < 4:  # would need python values anyway
+            s = s * 2
+            break
+        return s
+
+    assert not f._converted  # break is outside the subset
+
+
+def test_one_sided_assignment_rejected_at_runtime():
+    @to_static
+    def f(x):
+        if jnp.sum(x) > 0:
+            y = x * 2.0
+        else:
+            z = x  # does not bind y
+        return x
+
+    assert f._converted
+    with pytest.raises(Unsupported, match="both branches"):
+        f(jnp.ones((2,)))
+
+
+def test_shape_invariance_still_enforced():
+    @to_static
+    def f(x):
+        if jnp.sum(x) > 0:
+            y = jnp.concatenate([x, x])
+        else:
+            y = x
+        return y
+
+    with pytest.raises(Unsupported, match="matching shapes"):
+        f(jnp.ones((2,)))
+
+
+def test_nested_if_in_while():
+    @to_static
+    def f(n):
+        i = jnp.asarray(0, jnp.int32)
+        s = jnp.asarray(0.0)
+        while i < n:
+            if jnp.mod(i, 2) == 0:
+                s = s + 10.0
+            else:
+                s = s + 1.0
+            i = i + 1
+        return s
+
+    # i = 0..3 -> 10 + 1 + 10 + 1
+    assert float(f(jnp.asarray(4, jnp.int32))) == 22.0
