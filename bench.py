@@ -44,7 +44,7 @@ def main():
     if on_tpu:
         cfg = ErnieConfig(enable_recompute=recompute)  # L12 H768 A12 V18000
         batch, seq = int(os.environ.get("BENCH_BATCH", "64")), 512
-        warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "20"))
+        warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "40"))
     else:
         cfg = ErnieConfig(vocab_size=1024, hidden_size=128,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -91,7 +91,7 @@ def main():
     # ~120 ms dead time per sync (r03), i.e. 30 ms/step at k=4 vs 6 ms/step
     # at k=20.  k=20 has run clean repeatedly; tighten via env if the
     # tunnel regresses.
-    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "20"))
+    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "40"))
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch_data, key)
         float(loss)

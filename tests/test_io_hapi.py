@@ -114,14 +114,14 @@ class TestDataLoader:
         step rate (~4 batches/s at b64xs512); the measured number is
         recorded in io/dataloader.py's module docstring."""
         import time
-        ds = _TokenDataset(512)
-        dl = DataLoader(ds, batch_size=64, num_workers=4,
+        ds = _TokenDataset(512, n=256)
+        dl = DataLoader(ds, batch_size=64, num_workers=2,
                         use_shared_memory=True)
         t0 = time.perf_counter()
         n = sum(1 for _ in dl)
         dt = time.perf_counter() - t0
         rate = n / dt
-        assert n == 8
+        assert n == 4
         # generous floor: spawn startup dominates this tiny run; the
         # steady-state rate is far higher (see docstring measurement)
         assert rate > 0.5, f"{rate:.2f} batches/s"
