@@ -41,8 +41,24 @@ __all__ = [
 
 
 # -- compare / logical DSL (ref layers/control_flow.py less_than :1262 etc.) --
+def _sym_broadcast(a, b):
+    """np.broadcast_shapes that tolerates -1 (unknown) dims."""
+    out = []
+    for da, db in zip((1,) * (len(b) - len(a)) + tuple(a),
+                      (1,) * (len(a) - len(b)) + tuple(b)):
+        if da == 1:
+            out.append(db)
+        elif db == 1 or da == db:
+            out.append(da)
+        elif -1 in (da, db):
+            out.append(-1)
+        else:
+            raise ValueError(f"cannot broadcast {a} with {b}")
+    return tuple(out)
+
+
 def _cmp(op_type, x: Variable, y: Variable) -> Variable:
-    out = _out("bool", np.broadcast_shapes(x.shape, y.shape))
+    out = _out("bool", _sym_broadcast(x.shape, y.shape))
     _append(op_type, {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]})
     return out
 

@@ -30,8 +30,20 @@ __all__ = [
     "accuracy", "reshape", "transpose", "concat", "split", "flatten", "cast",
     "scale", "fill_constant", "elementwise_add", "elementwise_sub",
     "elementwise_mul", "elementwise_div", "elementwise_mod",
-    "elementwise_floordiv", "matmul", "topk", "argmax", "clip",
+    "elementwise_floordiv", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "matmul", "topk", "argmax", "argmin", "clip",
     "create_parameter",
+    # long tail (same registry coverage as static/ops.py)
+    "exp", "log", "sqrt", "square", "abs", "floor", "ceil", "round", "sign",
+    "erf", "reciprocal", "rsqrt", "sin", "cos", "tan", "asin", "acos",
+    "atan", "sinh", "cosh", "logsigmoid", "gelu", "leaky_relu", "elu",
+    "relu6", "selu", "mish", "silu", "swish", "softplus", "softsign",
+    "hard_sigmoid", "hard_swish", "log_softmax", "pow", "shape", "squeeze",
+    "unsqueeze", "stack", "expand", "tile", "slice", "gather", "gather_nd",
+    "scatter", "where", "one_hot", "cumsum", "fill_zeros_like", "pad",
+    "layer_norm", "sigmoid_cross_entropy_with_logits", "log_loss",
+    "label_smooth", "l2_normalize", "huber_loss", "smooth_l1", "kldiv_loss",
+    "mse_loss",
 ]
 
 
@@ -511,3 +523,386 @@ def argmax(x, axis=-1) -> Variable:
     out = _out("int64", x.shape[:axis] + x.shape[axis + 1:])
     _append("arg_max", {"X": [x.name]}, {"Out": [out.name]}, {"axis": axis})
     return out
+
+
+# -- DSL long tail (ref fluid/layers/nn.py ~200 fns; this block closes the
+# gap for every lowering static/ops.py already registers) ---------------------
+
+def _unary_attr(op_type, x, **attrs) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append(op_type, {"X": [x.name]}, {"Out": [out.name]}, attrs or None)
+    return out
+
+
+def exp(x):
+    return _unary("exp", x)
+
+
+def log(x):
+    return _unary("log", x)
+
+
+def sqrt(x):
+    return _unary("sqrt", x)
+
+
+def square(x):
+    return _unary("square", x)
+
+
+def abs(x):  # noqa: A001 — fluid.layers.abs shadows builtins there too
+    return _unary("abs", x)
+
+
+def floor(x):
+    return _unary("floor", x)
+
+
+def ceil(x):
+    return _unary("ceil", x)
+
+
+def round(x):  # noqa: A001
+    return _unary("round", x)
+
+
+def sign(x):
+    return _unary("sign", x)
+
+
+def erf(x):
+    return _unary("erf", x)
+
+
+def reciprocal(x):
+    return _unary("reciprocal", x)
+
+
+def rsqrt(x):
+    return _unary("rsqrt", x)
+
+
+def sin(x):
+    return _unary("sin", x)
+
+
+def cos(x):
+    return _unary("cos", x)
+
+
+def tan(x):
+    return _unary("tan", x)
+
+
+def asin(x):
+    return _unary("asin", x)
+
+
+def acos(x):
+    return _unary("acos", x)
+
+
+def atan(x):
+    return _unary("atan", x)
+
+
+def sinh(x):
+    return _unary("sinh", x)
+
+
+def cosh(x):
+    return _unary("cosh", x)
+
+
+def logsigmoid(x):
+    return _unary("logsigmoid", x)
+
+
+def gelu(x):
+    return _unary("gelu", x)
+
+
+def leaky_relu(x, alpha=0.02):
+    return _unary_attr("leaky_relu", x, alpha=alpha)
+
+
+def elu(x, alpha=1.0):
+    return _unary_attr("elu", x, alpha=alpha)
+
+
+def relu6(x):
+    return _unary("relu6", x)
+
+
+def selu(x):
+    return _unary("selu", x)
+
+
+def mish(x):
+    return _unary("mish", x)
+
+
+def silu(x):
+    return _unary("silu", x)
+
+
+def swish(x):
+    return _unary("swish", x)
+
+
+def softplus(x):
+    return _unary("softplus", x)
+
+
+def softsign(x):
+    return _unary("softsign", x)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5):
+    return _unary_attr("hard_sigmoid", x, slope=slope, offset=offset)
+
+
+def hard_swish(x):
+    return _unary("hard_swish", x)
+
+
+def log_softmax(x, axis=-1):
+    return _unary_attr("log_softmax", x, axis=axis)
+
+
+def pow(x, factor=1.0):  # noqa: A001
+    return _unary_attr("pow", x, factor=factor)
+
+
+def elementwise_max(x, y, axis=-1):
+    return _elementwise("elementwise_max", x, y, axis)
+
+
+def elementwise_min(x, y, axis=-1):
+    return _elementwise("elementwise_min", x, y, axis)
+
+
+def elementwise_pow(x, y, axis=-1):
+    return _elementwise("elementwise_pow", x, y, axis)
+
+
+# -- shape / index manipulation ----------------------------------------------
+
+def shape(x) -> Variable:
+    out = _out("int64", (x.ndim,))
+    _append("shape", {"Input": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def squeeze(x, axes=()) -> Variable:
+    shp = [s for i, s in enumerate(x.shape)
+           if not ((axes and i in axes) or (not axes and s == 1))]
+    out = _out(x.dtype, tuple(shp))
+    xshape = _out(x.dtype, ())
+    _append("squeeze2", {"X": [x.name]},
+            {"Out": [out.name], "XShape": [xshape.name]},
+            {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(x, axes) -> Variable:
+    axes = [axes] if isinstance(axes, int) else list(axes)
+    shp = list(x.shape)
+    for a in sorted(axes):
+        shp.insert(a if a >= 0 else a + len(shp) + 1, 1)
+    out = _out(x.dtype, tuple(shp))
+    xshape = _out(x.dtype, ())
+    _append("unsqueeze2", {"X": [x.name]},
+            {"Out": [out.name], "XShape": [xshape.name]}, {"axes": axes})
+    return out
+
+
+def stack(inputs, axis=0) -> Variable:
+    shp = list(inputs[0].shape)
+    shp.insert(axis if axis >= 0 else axis + len(shp) + 1, len(inputs))
+    out = _out(inputs[0].dtype, tuple(shp))
+    _append("stack", {"X": [v.name for v in inputs]}, {"Y": [out.name]},
+            {"axis": axis})
+    return out
+
+
+def expand(x, shape) -> Variable:
+    out = _out(x.dtype, tuple(shape))
+    _append("expand_v2", {"X": [x.name]}, {"Out": [out.name]},
+            {"shape": list(shape)})
+    return out
+
+
+def tile(x, repeat_times) -> Variable:
+    shp = tuple(-1 if s < 0 else s * r
+                for s, r in zip(x.shape, repeat_times))
+    out = _out(x.dtype, shp)
+    _append("tile", {"X": [x.name]}, {"Out": [out.name]},
+            {"repeat_times": list(repeat_times)})
+    return out
+
+
+def slice(x, axes, starts, ends) -> Variable:  # noqa: A001
+    shp = list(x.shape)
+    for a, s, e in zip(axes, starts, ends):
+        if shp[a] >= 0:
+            lo = s if s >= 0 else shp[a] + s
+            hi = min(e, shp[a]) if e >= 0 else shp[a] + e
+            shp[a] = max(hi - lo, 0)
+    out = _out(x.dtype, tuple(shp))
+    _append("slice", {"Input": [x.name]}, {"Out": [out.name]},
+            {"axes": list(axes), "starts": list(starts), "ends": list(ends)})
+    return out
+
+
+def gather(x, index, axis=0) -> Variable:
+    shp = list(x.shape)
+    shp[axis] = index.shape[0] if index.ndim else 1
+    out = _out(x.dtype, tuple(shp))
+    _append("gather", {"X": [x.name], "Index": [index.name]},
+            {"Out": [out.name]}, {"axis": axis})
+    return out
+
+
+def gather_nd(x, index) -> Variable:
+    out = _out(x.dtype, tuple(index.shape[:-1]))
+    _append("gather_nd", {"X": [x.name], "Index": [index.name]},
+            {"Out": [out.name]})
+    return out
+
+
+def scatter(x, index, updates, overwrite=True) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append("scatter", {"X": [x.name], "Ids": [index.name],
+                        "Updates": [updates.name]},
+            {"Out": [out.name]}, {"overwrite": overwrite})
+    return out
+
+
+def where(condition, x, y) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append("where", {"Condition": [condition.name], "X": [x.name],
+                      "Y": [y.name]}, {"Out": [out.name]})
+    return out
+
+
+def one_hot(x, depth) -> Variable:
+    out = _out("float32", tuple(x.shape) + (depth,))
+    _append("one_hot_v2", {"X": [x.name]}, {"Out": [out.name]},
+            {"depth": depth})
+    return out
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False) -> Variable:
+    out = _out(x.dtype, x.shape if axis is not None else (-1,))
+    _append("cumsum", {"X": [x.name]}, {"Out": [out.name]},
+            {"axis": axis, "exclusive": exclusive, "reverse": reverse,
+             "flatten": axis is None})
+    return out
+
+
+def argmin(x, axis=-1) -> Variable:
+    shp = tuple(s for i, s in enumerate(x.shape)
+                if i != (axis if axis >= 0 else axis + x.ndim))
+    out = _out("int64", shp)
+    _append("arg_min", {"X": [x.name]}, {"Out": [out.name]}, {"axis": axis})
+    return out
+
+
+def fill_zeros_like(x) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append("fill_zeros_like", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0) -> Variable:
+    shp = tuple(s + paddings[2 * i] + paddings[2 * i + 1] if s >= 0 else -1
+                for i, s in enumerate(x.shape))
+    out = _out(x.dtype, shp)
+    _append("pad", {"X": [x.name]}, {"Out": [out.name]},
+            {"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None) -> Variable:
+    """ref fluid/layers/nn.py layer_norm."""
+    n = int(np.prod(input.shape[begin_norm_axis:]))
+    ins = {"X": [input.name]}
+    if scale:
+        s = create_parameter((n,), input.dtype, attr=param_attr,
+                             default_initializer=I.Constant(1.0))
+        ins["Scale"] = [s.name]
+    if shift:
+        b = create_parameter((n,), input.dtype, attr=bias_attr,
+                             default_initializer=I.Constant(0.0))
+        ins["Bias"] = [b.name]
+    out = _out(input.dtype, input.shape)
+    mean = _out("float32", input.shape[:begin_norm_axis])
+    var = _out("float32", input.shape[:begin_norm_axis])
+    _append("layer_norm", ins,
+            {"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+            {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon})
+    return out
+
+
+# -- losses -------------------------------------------------------------------
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append("sigmoid_cross_entropy_with_logits",
+            {"X": [x.name], "Label": [label.name]}, {"Out": [out.name]},
+            {"ignore_index": ignore_index, "normalize": normalize})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4) -> Variable:
+    out = _out(input.dtype, input.shape)
+    _append("log_loss", {"Predicted": [input.name], "Labels": [label.name]},
+            {"Loss": [out.name]}, {"epsilon": epsilon})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1) -> Variable:
+    out = _out(label.dtype, label.shape)
+    ins = {"X": [label.name]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist.name]
+    _append("label_smooth", ins, {"Out": [out.name]}, {"epsilon": epsilon})
+    return out
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-10) -> Variable:
+    out = _out(x.dtype, x.shape)
+    norm = _out(x.dtype, x.shape[:-1] + (1,))
+    _append("norm", {"X": [x.name]}, {"Out": [out.name], "Norm": [norm.name]},
+            {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def huber_loss(input, label, delta=1.0) -> Variable:
+    out = _out(input.dtype, input.shape)
+    _append("huber_loss", {"X": [input.name], "Y": [label.name]},
+            {"Out": [out.name]}, {"delta": delta})
+    return out
+
+
+def smooth_l1(x, y, sigma=1.0) -> Variable:
+    out = _out(x.dtype, x.shape)
+    _append("smooth_l1_loss", {"X": [x.name], "Y": [y.name]},
+            {"Out": [out.name]}, {"sigma": sigma})
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean") -> Variable:
+    shp = () if reduction in ("mean", "sum", "batchmean") else x.shape
+    out = _out(x.dtype, shp)
+    _append("kldiv_loss", {"X": [x.name], "Target": [target.name]},
+            {"Loss": [out.name]}, {"reduction": reduction})
+    return out
+
+
+def mse_loss(input, label) -> Variable:
+    """ref fluid/layers mse_loss — mean of squared error."""
+    return mean(square_error_cost(input, label))

@@ -477,9 +477,8 @@ for _name, _fn in [
     ("softplus", jax.nn.softplus), ("silu", jax.nn.silu),
     ("swish", jax.nn.silu), ("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x))),
     ("relu6", lambda x: jnp.clip(x, 0.0, 6.0)),
-    ("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0)),
     ("hard_swish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0),
-    ("elu", jax.nn.elu), ("selu", jax.nn.selu),
+    ("selu", jax.nn.selu),
     ("logsigmoid", jax.nn.log_sigmoid),
 ]:
     register_op(_name)(_unary_rule(_fn))
@@ -490,6 +489,20 @@ def _leaky_relu(ins, attrs, op):
     a = attrs.get("alpha", 0.02)
     x = _one(ins, "X")
     return {"Out": [jnp.where(x >= 0, x, a * x)]}
+
+
+@register_op("elu")
+def _elu(ins, attrs, op):
+    a = attrs.get("alpha", 1.0)
+    x = _one(ins, "X")
+    return {"Out": [jnp.where(x >= 0, x, a * (jnp.exp(x) - 1.0))]}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ins, attrs, op):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(slope * _one(ins, "X") + offset, 0.0, 1.0)]}
 
 
 @register_op("pow")
@@ -686,3 +699,58 @@ def _square_error_cost(ins, attrs, op):
 @register_op("relu_grad_passthrough")  # reserved (grad ops are jax.grad'd)
 def _relu_grad_passthrough(ins, attrs, op):
     return {"Out": [_one(ins, "X")]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ins, attrs, op):
+    x, label = _one(ins, "X"), _one(ins, "Label")
+    # ref sigmoid_cross_entropy_with_logits_op: max(x,0) - x*z + log1p(exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore), 1)
+        loss = loss / n
+    return {"Out": [loss]}
+
+
+@register_op("log_loss")
+def _log_loss(ins, attrs, op):
+    p, label = _one(ins, "Predicted"), _one(ins, "Labels")
+    e = attrs.get("epsilon", 1e-4)
+    out = -label * jnp.log(p + e) - (1 - label) * jnp.log(1 - p + e)
+    return {"Loss": [out]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ins, attrs, op):
+    x = _one(ins, "X")
+    eps = attrs.get("epsilon", 0.1)
+    prior = _one(ins, "PriorDist")
+    k = x.shape[-1]
+    smooth = prior if prior is not None else 1.0 / k
+    return {"Out": [(1 - eps) * x + eps * smooth]}
+
+
+@register_op("norm")
+def _l2_normalize(ins, attrs, op):
+    x = _one(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return {"Out": [x / jnp.maximum(n, eps)], "Norm": [n]}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ins, attrs, op):
+    x, tgt = _one(ins, "X"), _one(ins, "Target")
+    # ref kldiv_loss_op: x is log-prob input, target is prob
+    loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-20)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
