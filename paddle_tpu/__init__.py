@@ -10,6 +10,7 @@ than NCCL rings (SURVEY.md §7 design stance).
 from __future__ import annotations
 
 from . import core
+from .core import errors
 from .core import (
     CPUPlace,
     CUDAPlace,

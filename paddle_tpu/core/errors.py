@@ -1,0 +1,84 @@
+"""Typed error taxonomy.
+
+Reference parity: `platform/errors.h` + `error_codes.proto` — the typed
+error codes every PADDLE_ENFORCE_* site carries (InvalidArgument, NotFound,
+OutOfRange, AlreadyExists, ResourceExhausted, PreconditionNotMet,
+PermissionDenied, ExecutionTimeout, Unimplemented, Unavailable, Fatal,
+External) — surfaced to Python as EnforceNotMet subclasses
+(pybind/exception.cc).
+
+TPU-native design: plain Python exception classes, each subclassing the
+builtin exception users would already catch (ValueError/KeyError/...), so
+typed catches work without breaking duck-typed callers:
+
+    try: ...
+    except errors.NotFoundError: ...     # typed
+    except KeyError: ...                 # still works
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError",
+]
+
+
+class EnforceNotMet(Exception):
+    """Base of the taxonomy (ref enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    """error_codes.proto INVALID_ARGUMENT."""
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    """NOT_FOUND — a requested entity (variable, file, op) is missing."""
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    """OUT_OF_RANGE."""
+
+
+class AlreadyExistsError(EnforceNotMet, ValueError):
+    """ALREADY_EXISTS."""
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    """RESOURCE_EXHAUSTED."""
+
+
+class PreconditionNotMetError(EnforceNotMet, RuntimeError):
+    """PRECONDITION_NOT_MET — e.g. running before initialization."""
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    """PERMISSION_DENIED."""
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    """EXECUTION_TIMEOUT."""
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    """UNIMPLEMENTED."""
+
+
+class UnavailableError(EnforceNotMet, RuntimeError):
+    """UNAVAILABLE — transient service/backend failure."""
+
+
+class FatalError(EnforceNotMet, RuntimeError):
+    """FATAL."""
+
+
+class ExternalError(EnforceNotMet, RuntimeError):
+    """EXTERNAL — an error surfaced from an external library (XLA/PJRT)."""
+
+
+def enforce(cond, error_cls=InvalidArgumentError, message="enforce failed"):
+    """PADDLE_ENFORCE equivalent: raise a typed error when cond is false."""
+    if not cond:
+        raise error_cls(message)

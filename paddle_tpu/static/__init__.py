@@ -13,6 +13,7 @@ from . import layers, optimizer
 from . import control_flow
 from .backward import append_backward, gradients
 from .control_flow import (
+    StaticRNN,
     cond,
     equal,
     greater_equal,

@@ -28,7 +28,7 @@ def _effective_io(program, op):
     ins = set(op.input_names())
     outs = set(op.output_names())
     blk_attrs = [a for a in ("true_block", "false_block",
-                             "cond_block", "body_block")
+                             "cond_block", "body_block", "rnn_block")
                  if a in op.attrs]
     for a in blk_attrs:
         blk = program.blocks[op.attrs[a]]
@@ -56,7 +56,8 @@ def _reject_while_ops(program, loss_names, param_names, api_name: str) -> None:
             return True
         return any(contains_while(sub)
                    for a in ("true_block", "false_block",
-                             "cond_block", "body_block") if a in op.attrs
+                             "cond_block", "body_block", "rnn_block")
+                   if a in op.attrs
                    for sub in program.blocks[op.attrs[a]].ops)
 
     block = program.global_block()

@@ -34,7 +34,7 @@ from .framework import Program, Variable, default_main_program
 from .layers import _append, _main_block, _out, fill_constant
 
 __all__ = [
-    "cond", "while_loop", "increment", "less_than", "less_equal",
+    "cond", "while_loop", "StaticRNN", "increment", "less_than", "less_equal",
     "greater_than", "greater_equal", "equal", "not_equal", "logical_and",
     "logical_or", "logical_xor", "logical_not",
 ]
@@ -237,6 +237,111 @@ def while_loop(cond_fn: Callable, body_fn: Callable,
                "cond_out": c_out.name,
                "body_outs": [v.name for v in b_list]})
     return outs
+
+
+class StaticRNN:
+    """Static (fixed-length) recurrence (ref layers/control_flow.py
+    StaticRNN → recurrent_op.cc).
+
+    TPU-native: the step block lowers to ``lax.scan`` over the TIME-MAJOR
+    leading axis of every step input — and scan is reverse-mode
+    differentiable, so seq2seq models TRAIN through this construct (the
+    reference's RecurrentGradOp machinery collapses into AD-of-scan).
+
+    Usage (reference API shape)::
+
+        rnn = StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tmajor)        # [T, B, D] -> per-step [B, D]
+            prev = rnn.memory(init=h0)          # carried state
+            h = layers.fc(concat([w, prev], 1), H, act='tanh')
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        outs = rnn()                            # [T, B, H]
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self._prog: Optional[Program] = None
+        self._block = None
+        self._seq_pairs = []     # (outer_var, step_var)
+        self._mem_pairs = []     # (step_mem_var, init_outer_var)
+        self._mem_next = {}      # step_mem_name -> step_next_name
+        self._outputs = []       # step vars
+        self._built = False
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            from .framework import default_main_program
+
+            rnn = self.rnn
+            rnn._prog = default_main_program()
+            rnn._block = rnn._prog._create_block()
+            return rnn
+
+        def __exit__(self, *exc):
+            self.rnn._prog._rollback()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        if x.ndim < 1:
+            raise ValueError("step_input needs a [T, ...] sequence variable")
+        v = self._block.create_var(shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._seq_pairs.append((x, v))
+        return v
+
+    def memory(self, init: Optional[Variable] = None) -> Variable:
+        if init is None:
+            raise ValueError(
+                "memory requires init= (batch_ref/shape form of the "
+                "reference is not supported; pass an initialized tensor)")
+        m = self._block.create_var(shape=init.shape, dtype=init.dtype)
+        self._mem_pairs.append((m, init))
+        return m
+
+    def update_memory(self, mem: Variable, new: Variable) -> None:
+        if mem.shape != new.shape or mem.dtype != new.dtype:
+            raise ValueError(
+                f"update_memory: carry must be shape-invariant, got "
+                f"{mem.shape}:{mem.dtype} vs {new.shape}:{new.dtype}")
+        self._mem_next[mem.name] = new.name
+
+    def step_output(self, o: Variable) -> None:
+        self._outputs.append(o)
+
+    output = step_output
+
+    def __call__(self):
+        if self._built:
+            raise RuntimeError("StaticRNN() already materialized")
+        if not self._seq_pairs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        missing = [m.name for m, _ in self._mem_pairs
+                   if m.name not in self._mem_next]
+        if missing:
+            raise ValueError(f"memories {missing} never update_memory'd")
+        self._built = True
+        parent = self._prog.current_block()
+        T = self._seq_pairs[0][0].shape[0]
+        outs = [parent.create_var(shape=(T,) + tuple(v.shape),
+                                  dtype=v.dtype) for v in self._outputs]
+        parent.append_op(
+            "static_rnn",
+            inputs={"X": [x.name for x, _ in self._seq_pairs],
+                    "Init": [i.name for _, i in self._mem_pairs]},
+            outputs={"Out": [o.name for o in outs]},
+            attrs={"rnn_block": self._block.idx,
+                   "step_in_names": [v.name for _, v in self._seq_pairs],
+                   "mem_names": [m.name for m, _ in self._mem_pairs],
+                   "mem_next": [self._mem_next[m.name]
+                                for m, _ in self._mem_pairs],
+                   "out_names": [v.name for v in self._outputs]})
+        return outs if len(outs) > 1 else outs[0]
 
 
 class While:

@@ -35,13 +35,37 @@ __all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
 
 
 class Scope:
-    """Name -> host array store for persistables (ref framework/scope.h:46 —
-    hierarchical C++ Scope; here a flat dict per program state)."""
+    """Name -> host array store for persistables (ref framework/scope.h:46).
 
-    def __init__(self):
+    Hierarchical like the reference: `new_scope()` creates a child whose
+    lookups fall through to ancestors (the pattern the reference's
+    per-thread/per-section scopes rely on); writes always land in the scope
+    they are issued on (kid scopes never clobber the parent)."""
+
+    def __init__(self, parent: "Optional[Scope]" = None):
         self._vars: Dict[str, Any] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    @property
+    def parent(self) -> "Optional[Scope]":
+        return self._parent
 
     def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def local_var(self, name: str):
+        """Lookup without falling through to ancestors."""
         return self._vars.get(name)
 
     def var(self, name: str):
@@ -53,8 +77,13 @@ class Scope:
     def keys(self):
         return self._vars.keys()
 
+    def drop_kids(self):
+        """ref Scope::DropKids."""
+        self._kids.clear()
+
     def drop(self):
         self._vars.clear()
+        self._kids.clear()
 
 
 _global_scope = Scope()
@@ -111,6 +140,9 @@ def _trace_ops(program: Program, block_idx: int, ops, env, base_key):
             continue
         if op.type == "while":
             _lower_while(program, op, env, base_key)
+            continue
+        if op.type == "static_rnn":
+            _lower_static_rnn(program, op, env, base_key)
             continue
         _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
 
@@ -185,6 +217,33 @@ def _lower_while(program, op, env, base_key):
         env[name] = val
 
 
+def _lower_static_rnn(program, op, env, base_key):
+    """static_rnn → jax.lax.scan over the time-major leading axis (ref
+    operators/recurrent_op.cc; AD-of-scan replaces RecurrentGradOp)."""
+    blk = program.blocks[op.attrs["rnn_block"]]
+    step_in = op.attrs["step_in_names"]
+    mem_names = op.attrs["mem_names"]
+    mem_next = op.attrs["mem_next"]
+    out_names = op.attrs["out_names"]
+    outer = _arrays_only(env)
+    seqs = tuple(jnp.asarray(env[n]) for n in op.inputs["X"])
+    inits = tuple(jnp.asarray(env[n]) for n in op.inputs["Init"])
+
+    def body(carry, xs_t):
+        env2 = dict(outer)
+        env2.update(zip(mem_names, carry))
+        env2.update(zip(step_in, xs_t))
+        _trace_ops(program, blk.idx, blk.ops, env2, base_key)
+        new_carry = tuple(jnp.asarray(env2[n], carry[i].dtype)
+                          for i, n in enumerate(mem_next))
+        outs_t = tuple(env2[n] for n in out_names)
+        return new_carry, outs_t
+
+    _, stacked = jax.lax.scan(body, inits, seqs)
+    for name, val in zip(op.outputs["Out"], stacked):
+        env[name] = val
+
+
 def _lower_backward(program, block_idx, ops, bw_idx, env, base_key):
     op = ops[bw_idx]
     loss_names = op.inputs["Loss"]
@@ -254,7 +313,9 @@ class Executor:
         missing = [n for n in state_names
                    if scope.find_var(n) is None and self._needs_value(program, n)]
         if missing:
-            raise RuntimeError(
+            from ..core.errors import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
                 f"persistable variables {missing} have no value in scope — "
                 "run the startup program first (exe.run(startup_program))")
 

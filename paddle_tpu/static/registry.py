@@ -33,7 +33,9 @@ def get_lowering(type_name: str) -> Lowering:
     try:
         return _REGISTRY[type_name]
     except KeyError:
-        raise NotImplementedError(
+        from ..core.errors import UnimplementedError
+
+        raise UnimplementedError(
             f"no lowering registered for op type {type_name!r}; known: "
             f"{sorted(_REGISTRY)}") from None
 

@@ -211,3 +211,32 @@ def test_executor_reports_uninitialized(_fresh_programs):
     with pytest.raises(RuntimeError, match="startup"):
         exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
                 fetch_list=[out])
+
+
+def test_scope_hierarchy():
+    """ref framework/scope.h:46 — child lookups fall through to ancestors,
+    writes stay local, DropKids clears children."""
+    from paddle_tpu.core import errors
+
+    root = static.Scope()
+    root.set("w", 1.0)
+    kid = root.new_scope()
+    assert kid.find_var("w") == 1.0           # falls through
+    assert kid.local_var("w") is None         # not local
+    kid.set("w", 2.0)
+    assert kid.find_var("w") == 2.0           # local shadows
+    assert root.find_var("w") == 1.0          # parent untouched
+    assert kid.parent is root
+    root.drop_kids()
+
+    # typed error taxonomy reaches users through the Executor
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup), static.scope_guard(static.Scope()):
+        x = L.data("x", [2])
+        h = L.fc(x, 2)
+        exe = static.Executor()
+        with pytest.raises(errors.PreconditionNotMetError):
+            exe.run(main, feed={"x": np.zeros((1, 2), np.float32)},
+                    fetch_list=[h])
+    assert issubclass(errors.NotFoundError, KeyError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)

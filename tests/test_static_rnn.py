@@ -1,0 +1,151 @@
+"""StaticRNN: the static recurrence construct lowered to lax.scan (ref
+layers/control_flow.py StaticRNN -> recurrent_op.cc).  Covers forward
+parity against a numpy RNN, training THROUGH the recurrence (AD-of-scan
+replaces RecurrentGradOp), and a seq2seq encoder-decoder in the
+book/test_rnn_encoder_decoder.py / machine_translation style.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+from paddle_tpu.static.control_flow import StaticRNN
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+def _build_rnn(x, h0, H):
+    rnn = StaticRNN()
+    with rnn.step():
+        w = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        h = L.fc(L.concat([w, prev], axis=1), H, act="tanh",
+                 param_attr="rnn_w", bias_attr="rnn_b")
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    return rnn()
+
+
+def test_static_rnn_forward_matches_numpy(_fresh):
+    main, startup = _fresh
+    T, B, D, H = 5, 2, 3, 4
+    x = L.data("x", [T, B, D], append_batch_size=False)
+    h0 = L.data("h0", [B, H], append_batch_size=False)
+    out = _build_rnn(x, h0, H)
+    assert out.shape == (T, B, H)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (T, B, D)).astype(np.float32)
+    H0 = np.zeros((B, H), np.float32)
+    got, = exe.run(main, feed={"x": X, "h0": H0}, fetch_list=[out])
+
+    scope = static.global_scope()
+    W = np.asarray(scope.find_var("rnn_w"))
+    bias = np.asarray(scope.find_var("rnn_b"))
+    h = H0
+    ref = []
+    for t in range(T):
+        h = np.tanh(np.concatenate([X[t], h], axis=1) @ W + bias)
+        ref.append(h)
+    np.testing.assert_allclose(got, np.stack(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_static_rnn_trains(_fresh):
+    """Backward through the recurrence: learn to output a constant."""
+    main, startup = _fresh
+    T, B, D, H = 4, 3, 2, 4
+    x = L.data("x", [T, B, D], append_batch_size=False)
+    h0 = L.data("h0", [B, H], append_batch_size=False)
+    out = _build_rnn(x, h0, H)
+    target = L.fill_constant([T, B, H], "float32", 0.5)
+    loss = L.mean(L.square(L.elementwise_sub(out, target)))
+    opt = static.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (T, B, D)).astype(np.float32)
+    H0 = np.zeros((B, H), np.float32)
+    losses = [float(exe.run(main, feed={"x": X, "h0": H0},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_seq2seq_encoder_decoder_trains(_fresh):
+    """book/test_rnn_encoder_decoder.py shape: encoder RNN final state
+    initializes a teacher-forced decoder RNN; per-step softmax +
+    cross_entropy.  Trained on a copy task until the loss clearly drops."""
+    main, startup = _fresh
+    T, B, V, E, H = 4, 8, 12, 8, 16
+
+    src = L.data("src", [T, B], dtype="int64", append_batch_size=False)
+    tgt_in = L.data("tgt_in", [T, B], dtype="int64",
+                    append_batch_size=False)
+    tgt_out = L.data("tgt_out", [T, B], dtype="int64",
+                     append_batch_size=False)
+    h0 = L.data("h0", [B, H], append_batch_size=False)
+
+    src_emb = L.embedding(src, size=[V, E], param_attr="src_emb")
+    enc = StaticRNN()
+    with enc.step():
+        w = enc.step_input(src_emb)
+        prev = enc.memory(init=h0)
+        h = L.fc(L.concat([w, prev], axis=1), H, act="tanh",
+                 param_attr="enc_w", bias_attr="enc_b")
+        enc.update_memory(prev, h)
+        enc.step_output(h)
+    enc_states = enc()
+    # final encoder state = last time step
+    enc_final = L.squeeze(L.slice(enc_states, axes=[0], starts=[T - 1],
+                                  ends=[T]), axes=(0,))
+
+    tgt_emb = L.embedding(tgt_in, size=[V, E], param_attr="tgt_emb")
+    dec = StaticRNN()
+    with dec.step():
+        w = dec.step_input(tgt_emb)
+        prev = dec.memory(init=enc_final)
+        h = L.fc(L.concat([w, prev], axis=1), H, act="tanh",
+                 param_attr="dec_w", bias_attr="dec_b")
+        dec.update_memory(prev, h)
+        logits = L.fc(h, V, param_attr="proj_w", bias_attr="proj_b")
+        dec.step_output(logits)
+    dec_logits = dec()  # [T, B, V]
+
+    loss = L.mean(L.softmax_with_cross_entropy(
+        dec_logits, L.unsqueeze(tgt_out, [2])))
+    opt = static.optimizer.Adam(learning_rate=0.05)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(3)
+    SRC = rng.integers(1, V, (T, B)).astype(np.int64)
+    TGT_IN = np.vstack([np.zeros((1, B), np.int64), SRC[:-1]])  # shifted
+    H0 = np.zeros((B, H), np.float32)
+    feed = {"src": SRC, "tgt_in": TGT_IN, "tgt_out": SRC, "h0": H0}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(60)]
+    assert losses[-1] < 0.35 * losses[0], (losses[0], losses[-1])
+    assert losses[-1] < 1.0, losses[-1]
+
+
+def test_static_rnn_validation(_fresh):
+    main, _ = _fresh
+    x = L.data("x", [4, 2, 3], append_batch_size=False)
+    h0 = L.data("h0", [2, 5], append_batch_size=False)
+    rnn = StaticRNN()
+    with rnn.step():
+        w = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        rnn.step_output(prev)
+    with pytest.raises(ValueError, match="never update_memory"):
+        rnn()
