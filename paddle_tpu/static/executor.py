@@ -342,6 +342,50 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """ref fluid/executor.py:1597 train_from_dataset →
+        TrainerFactory/MultiTrainer/DeviceWorker (trainer.h:41,
+        device_worker.h:215 HogwildWorker threads pulling from the DataFeed
+        channel).
+
+        TPU-native collapse: the C++ DataFeed (native/src/datafeed.cc)
+        already parses/shuffles/batches on background threads, and a single
+        XLA device consumes steps in order — so the N-worker Hogwild loop
+        becomes sequential jitted steps over the feed stream (`thread` is
+        accepted for parity; parallel parsing is configured on the dataset
+        via set_thread)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        del thread  # parity knob; parse parallelism lives on the dataset
+        fetch_list = list(fetch_list or [])
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in fetch_list]
+        labels = list(fetch_info or names)
+        step = 0
+        last = None
+        for batch in dataset:
+            last = self.run(program, feed=batch, fetch_list=fetch_list,
+                            scope=scope)
+            step += 1
+            if debug and fetch_list and step % print_period == 0:
+                msg = ", ".join(f"{l}={np.asarray(v).ravel()[:1][0]:.6g}"
+                                for l, v in zip(labels, last))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """ref fluid/executor.py:1476 — same loop; the program is expected
+        to be an inference/test clone (no optimizer ops)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- internals -----------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:
         names = []
