@@ -127,24 +127,30 @@ def _op_salt(block_idx: int, op_idx: int) -> int:
     return block_idx * 65536 + op_idx
 
 
-def _trace_ops(program: Program, block_idx: int, ops, env, base_key):
-    """Trace a list of ops (any block) with control-flow dispatch."""
+def _trace_ops(program: Program, block_idx: int, ops, env, base_key,
+               frozen=None):
+    """Trace a list of ops (any block) with control-flow dispatch.
+
+    ``frozen`` maps names to values that must stay bound to those exact
+    (traced) values even when an op writes them — the backward replay
+    injects differentiated intermediates this way, so ∂loss/∂v means "v as
+    consumed downstream" rather than being recomputed by its producer
+    (reference backward.py gradients() semantics)."""
     for idx, op in enumerate(ops):
         if op.type in ("feed", "fetch"):
             continue
         if op.type == "backward_region":
             _lower_backward(program, block_idx, ops, idx, env, base_key)
-            continue
-        if op.type == "conditional_block":
+        elif op.type == "conditional_block":
             _lower_cond(program, op, env, base_key)
-            continue
-        if op.type == "while":
+        elif op.type == "while":
             _lower_while(program, op, env, base_key)
-            continue
-        if op.type == "static_rnn":
+        elif op.type == "static_rnn":
             _lower_static_rnn(program, op, env, base_key)
-            continue
-        _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
+        else:
+            _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
+        if frozen:
+            env.update(frozen)
 
 
 def _trace_block(program: Program, env: Dict[str, Any], base_key):
@@ -256,7 +262,11 @@ def _lower_backward(program, block_idx, ops, bw_idx, env, base_key):
     def replay(param_values: Dict[str, Any]):
         env2 = dict(init_env)
         env2.update(param_values)
-        _trace_ops(program, block_idx, ops[:bw_idx], env2, base_key)
+        # freeze the differentiated names: a producer op in the replay must
+        # not overwrite an injected intermediate (gradients()-wrt-
+        # intermediate semantics, ref backward.py:1795)
+        _trace_ops(program, block_idx, ops[:bw_idx], env2, base_key,
+                   frozen=param_values)
         total = 0.0
         for ln in loss_names:
             total = total + jnp.sum(env2[ln].astype(jnp.float32))

