@@ -4,7 +4,8 @@ This package is the rebuild's L0 (SURVEY.md §1 L0a/L0b): the reference's
 16K-LoC platform layer (Place/DeviceContext/allocators/dynload) collapses
 onto JAX's PJRT client, leaving only thin typed handles here.
 """
-from . import dtype, errors, flags, random
+from . import dtype, errors, flags, lod, random
+from .lod import LoDTensor, SelectedRows
 from .device import (
     CPUPlace,
     CUDAPlace,

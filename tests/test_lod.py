@@ -1,0 +1,61 @@
+"""LoDTensor/SelectedRows host data model (ref lod_tensor.h:104,
+selected_rows.h:32) and its bridge to the padded device layout."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core import LoDTensor, SelectedRows
+
+
+def test_lod_offsets_and_lengths_roundtrip():
+    t = LoDTensor(np.arange(10.0).reshape(5, 2))
+    t.set_recursive_sequence_lengths([[2, 3]])
+    assert t.lod() == [[0, 2, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+    t.set_lod([[0, 1, 5]])
+    assert t.recursive_sequence_lengths() == [[1, 4]]
+
+
+def test_lod_validation():
+    t = LoDTensor(np.zeros((4, 1)))
+    with pytest.raises(ValueError, match="non-decreasing"):
+        t.set_lod([[0, 3, 2]])
+    with pytest.raises(ValueError, match="start at 0"):
+        t.set_lod([[1, 2]])
+    # nested: outer [0,2] says 2 inner sequences; inner has 3 -> invalid
+    with pytest.raises(ValueError, match="nested LoD"):
+        t.set_lod([[0, 2], [0, 1, 2, 4]])
+    # valid nesting
+    t.set_lod([[0, 2], [0, 1, 4]])
+
+
+def test_padded_bridge_roundtrip():
+    vals = np.arange(12.0).reshape(6, 2)
+    t = LoDTensor(vals)
+    t.set_recursive_sequence_lengths([[2, 1, 3]])
+    padded, lengths = t.to_padded()
+    assert padded.shape == (3, 3, 2)
+    np.testing.assert_array_equal(lengths, [2, 1, 3])
+    np.testing.assert_allclose(padded[1, 1:], 0.0)  # padding
+
+    back = LoDTensor.from_padded(padded, lengths)
+    np.testing.assert_allclose(back.numpy(), vals)
+    assert back.recursive_sequence_lengths() == [[2, 1, 3]]
+
+
+def test_selected_rows_merge_and_dense():
+    sr = SelectedRows(rows=[3, 1, 3], height=5,
+                      value=np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]]))
+    m = sr.merge_add()
+    assert m.rows() == [1, 3]
+    np.testing.assert_allclose(m.get_tensor(), [[2.0, 2.0], [4.0, 4.0]])
+    dense = m.to_dense()
+    assert dense.shape == (5, 2)
+    np.testing.assert_allclose(dense[3], [4.0, 4.0])
+    np.testing.assert_allclose(dense[0], 0.0)
+
+    rt = SelectedRows.from_dense_rows(dense, [1, 3])
+    np.testing.assert_allclose(rt.get_tensor()[1], [4.0, 4.0])
+
+    with pytest.raises(ValueError, match="mismatch"):
+        SelectedRows().set([1, 2], np.zeros((3, 2)))
