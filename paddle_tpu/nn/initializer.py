@@ -15,6 +15,64 @@ import numpy as np
 from ..core import random as _random
 
 
+def _host_rng():
+    """numpy Generator seeded from the framework key stream, or None under
+    tracing.
+
+    Parameter init in the reference runs as CPU fill ops in the startup
+    program (fluid/initializer.py emits uniform_random/gaussian_random ops
+    with a seed attribute); the TPU-native equivalent draws on the host too —
+    threefry on-device is wasteful for one-time init (measured: ~10s for a
+    VGG classifier on one CPU core) and the stream identity of init values
+    is not part of the API contract.  Under a traced key (functional init
+    inside jit) we fall back to jax.random.
+    """
+    key = _random.next_key()
+    if isinstance(key, jax.core.Tracer):
+        return None, key
+    bits = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.default_rng([int(b) for b in bits]), key
+
+
+def _wants_device_draw(dtype):
+    """float64 (x64 mode) keeps the jax.random path: the host fast path draws
+    float32 mantissas, which would silently quantize f64 initialization."""
+    return jnp.dtype(dtype).itemsize > 4
+
+
+def _uniform(shape, dtype, low, high):
+    rng, key = _host_rng()
+    if rng is None or _wants_device_draw(dtype):
+        return jax.random.uniform(key, shape, dtype=dtype, minval=low,
+                                  maxval=high)
+    u = rng.random(tuple(shape), dtype=np.float32)
+    return jnp.asarray(low + (high - low) * u, dtype=dtype)
+
+
+def _normal(shape, dtype, mean, std):
+    rng, key = _host_rng()
+    if rng is None or _wants_device_draw(dtype):
+        return mean + std * jax.random.normal(key, shape, dtype=dtype)
+    x = rng.standard_normal(tuple(shape), dtype=np.float32)
+    return jnp.asarray(mean + std * x, dtype=dtype)
+
+
+def _truncated_normal(shape, dtype, mean, std, lo=-2.0, hi=2.0):
+    rng, key = _host_rng()
+    if rng is None or _wants_device_draw(dtype):
+        x = jax.random.truncated_normal(key, lo, hi, shape, dtype=dtype)
+        return mean + std * x
+    x = rng.standard_normal(tuple(shape), dtype=np.float32)
+    for _ in range(8):  # resample only the tail (P(out) ≈ 4.6%, shrinking)
+        out = (x < lo) | (x > hi)
+        n_out = int(out.sum())
+        if n_out == 0:
+            break
+        x[out] = rng.standard_normal(n_out, dtype=np.float32)
+    x = np.clip(x, lo, hi)
+    return jnp.asarray(mean + std * x, dtype=dtype)
+
+
 def _fans(shape):
     shape = tuple(shape)
     if len(shape) == 0:
@@ -45,8 +103,7 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype=jnp.float32):
-        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
-                                  minval=self.low, maxval=self.high)
+        return _uniform(shape, dtype, self.low, self.high)
 
 
 class Normal(Initializer):
@@ -54,8 +111,7 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=jnp.float32):
-        return self.mean + self.std * jax.random.normal(_random.next_key(), shape,
-                                                        dtype=dtype)
+        return _normal(shape, dtype, self.mean, self.std)
 
 
 class TruncatedNormal(Initializer):
@@ -63,9 +119,7 @@ class TruncatedNormal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=jnp.float32):
-        x = jax.random.truncated_normal(_random.next_key(), -2.0, 2.0, shape,
-                                        dtype=dtype)
-        return self.mean + self.std * x
+        return _truncated_normal(shape, dtype, self.mean, self.std)
 
 
 class XavierUniform(Initializer):
@@ -77,8 +131,7 @@ class XavierUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
-        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
-                                  minval=-limit, maxval=limit)
+        return _uniform(shape, dtype, -limit, limit)
 
 
 class XavierNormal(Initializer):
@@ -90,7 +143,7 @@ class XavierNormal(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
-        return std * jax.random.normal(_random.next_key(), shape, dtype=dtype)
+        return _normal(shape, dtype, 0.0, std)
 
 
 class KaimingUniform(Initializer):
@@ -110,8 +163,7 @@ class KaimingUniform(Initializer):
         fi, _ = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         limit = self._gain() * math.sqrt(3.0 / fi)
-        return jax.random.uniform(_random.next_key(), shape, dtype=dtype,
-                                  minval=-limit, maxval=limit)
+        return _uniform(shape, dtype, -limit, limit)
 
 
 class KaimingNormal(KaimingUniform):
@@ -119,7 +171,7 @@ class KaimingNormal(KaimingUniform):
         fi, _ = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         std = self._gain() / math.sqrt(fi)
-        return std * jax.random.normal(_random.next_key(), shape, dtype=dtype)
+        return _normal(shape, dtype, 0.0, std)
 
 
 class Assign(Initializer):

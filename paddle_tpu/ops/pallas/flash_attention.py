@@ -8,16 +8,25 @@ TPU-native capability.  Design:
   matmuls via jnp.dot with preferred_element_type), grid over
   (batch*heads, q_blocks); K/V stream through a fori_loop of VMEM dynamic
   slices.  Emits the per-row logsumexp for the backward pass.
+* Matmul dtype policy: every dot runs in the INPUT dtype (bf16 on the
+  flagship) with fp32 accumulation — softmax statistics and probabilities
+  are fp32, and probabilities are rounded back to the input dtype for the
+  PV / dV / dK / dQ matmuls.  An fp32 upcast before the dot (the r02
+  design) forced multi-pass fp32 MXU matmuls at a fraction of bf16 peak;
+  fp32 inputs still take the exact-fp32 path end-to-end (the CPU tests).
 * Backward: two kernels — dK/dV over a (batch*heads, k_blocks) grid and dQ
   over (batch*heads, q_blocks) — recomputing probabilities from the stored
   logsumexp (no S matrix ever materialized in HBM).
 * Padding mask: an additive k-position bias of shape (batch, seq_k) streams
   through both passes, which covers the BERT/ERNIE padding-mask case without
   falling back to the O(S^2) jnp path.
-* Dropout: applied inside the kernel with a counter-based hash RNG keyed on
-  (seed, batch*head, q_pos, k_pos) so forward and backward replay identical
-  keep masks with no mask tensor in HBM.  (pltpu.prng_* is TPU-only and not
-  replayable across the two backward kernels; a position-keyed hash is.)
+* Dropout: applied inside the kernel with no mask tensor in HBM.  On real
+  TPUs the keep mask comes from the hardware PRNG re-seeded per
+  (seed, batch*head, q_block, k_block) tile — tile-local streams are
+  replayable across the forward and both backward kernels even though they
+  visit tiles in different orders.  Interpret mode (CPU tests) uses a
+  murmur3-style position hash instead (identical property, but ~10 ms/step
+  slower on TPU where int32 multiplies are VPU-emulated).
 
 Numerics: probabilities use softmax-then-dropout semantics; sum `l` is taken
 over the *undropped* probabilities, matching the jnp reference path.
@@ -50,7 +59,10 @@ def _dropout_keep(seed, bh, q_pos, k_pos, rate):
     """Deterministic keep-mask: murmur3-finalizer hash of global positions.
 
     Identical values in forward and both backward kernels for the same
-    (seed, bh, q_pos, k_pos), independent of block sizes.
+    (seed, bh, q_pos, k_pos), independent of block sizes.  Used in interpret
+    mode (CPU tests); on real TPUs _dropout_keep_hw replaces it — int32
+    multiplies are emulated on the VPU and the 5-multiply hash costs ~10 ms
+    per flagship step (measured r03).
     """
     h = (seed.astype(jnp.uint32)
          + bh.astype(jnp.uint32) * _P3
@@ -65,12 +77,47 @@ def _dropout_keep(seed, bh, q_pos, k_pos, rate):
     return h >= threshold  # keep with prob (1 - rate)
 
 
+def _dropout_keep_hw(seed, bh, qi, kv_idx, shape, rate):
+    """Hardware-PRNG keep-mask for one (block_q, block_k) tile.
+
+    The generator is RE-SEEDED per (seed, bh, q_block, k_block) tile, so the
+    stream drawn for a tile depends only on its coordinates — the forward,
+    dK/dV, and dQ kernels visit tiles in different orders yet replay
+    identical masks.  (A single kernel-wide stream would not be replayable:
+    the two backward kernels iterate the S matrix along different axes.)
+    Requires block sizes to agree across forward and backward, which
+    flash_attention() guarantees.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Mosaic takes at most two 32-bit seed words: fold (seed, bh) into one
+    # (odd-constant multiply is injective in bh mod 2^32) and (qi, kv) into
+    # the other (block indices are far below 2^16).
+    pltpu.prng_seed(seed + bh * jnp.int32(_P3), qi * jnp.int32(65536) + kv_idx)
+    bits = pltpu.prng_random_bits(shape)  # int32 tile
+    threshold = np.int32(min(int(rate * 2**32), 2**32 - 1) - 2**31)
+    return bits >= threshold  # keep with prob (1 - rate)
+
+
+def _keep_mask(seed, bh, qi, kv_idx, q_pos, k_pos, rate):
+    """Dispatch: hardware PRNG on real TPUs, position hash in interpret."""
+    if _interpret():
+        return _dropout_keep(seed, bh, q_pos, k_pos, rate)
+    return _dropout_keep_hw(seed, bh, qi, kv_idx, q_pos.shape, rate)
+
+
 def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                       *, sm_scale, causal, dropout_rate, block_q, block_k,
                       seq_len):
+    # MXU policy: matmuls run in the INPUT dtype with float32 accumulation
+    # (preferred_element_type).  bf16 inputs hit the MXU at full rate; an
+    # fp32 upcast before the dot would force multi-pass fp32 matmuls at a
+    # fraction of peak.  Softmax/logsumexp stay fp32; probabilities are cast
+    # back to the input dtype for the PV matmul (fp32 inputs therefore keep
+    # exact fp32 numerics end-to-end — the CPU/interpret test path).
     bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+    q = q_ref[0]  # (block_q, d), native dtype
 
     num_kv = seq_len // block_k
     if causal:
@@ -83,8 +130,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         acc, m_prev, l_prev = carry
         k = k_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
         v = v_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
-        s = jnp.dot(q, k.astype(jnp.float32).T,
-                    preferred_element_type=jnp.float32)  # (block_q, block_k)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         bias = bias_ref[0, 0, pl.dslice(kv_idx * block_k, block_k)]
         s = s + bias.astype(jnp.float32)[None, :]
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -97,10 +143,11 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            keep = _keep_mask(seed_ref[0], bh_idx, qi, kv_idx, q_pos, k_pos,
+                              dropout_rate)
             p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc = acc * alpha[:, None] + jnp.dot(
-            p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     d = q_ref.shape[-1]
@@ -151,8 +198,8 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                            causal, dropout_rate, block_q, block_k, seq_len):
     bh_idx = pl.program_id(0)
     kv_idx = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # (block_k, d), native dtype (matmuls run in input dtype)
+    v = v_ref[0]
     bias = bias_ref[0, 0].astype(jnp.float32)  # (block_k,)
 
     num_q = seq_len // block_q
@@ -160,8 +207,8 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
 
     def body(qi, carry):
         dk_acc, dv_acc = carry
-        q = q_ref[0, pl.dslice(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.dslice(qi * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]
         delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
@@ -172,17 +219,20 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            keep = _keep_mask(seed_ref[0], bh_idx, qi, kv_idx, q_pos, k_pos,
+                              dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
             p_d = jnp.where(keep, p * inv, 0.0)
         else:
             p_d = p
-        dv_acc = dv_acc + jnp.dot(p_d.T, do, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(p_d.astype(do.dtype).T, do,
+                                  preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dk_acc = dk_acc + jnp.dot(ds.astype(q.dtype).T, q,
+                                  preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     d = k_ref.shape[-1]
@@ -197,8 +247,8 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                          dropout_rate, block_q, block_k, seq_len):
     bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]  # (block_q, d), native dtype (matmuls run in input dtype)
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
 
@@ -210,8 +260,8 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         num_kv_iter = num_kv
 
     def body(kv_idx, dq_acc):
-        k = k_ref[0, pl.dslice(kv_idx * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(kv_idx * block_k, block_k), :]
         bias = bias_ref[0, 0, pl.dslice(kv_idx * block_k, block_k)]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         s = s + bias.astype(jnp.float32)[None, :]
@@ -222,9 +272,10 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref[0], bh_idx, q_pos, k_pos, dropout_rate)
+            keep = _keep_mask(seed_ref[0], bh_idx, qi, kv_idx, q_pos, k_pos,
+                              dropout_rate)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k.dtype)
         return dq_acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, num_kv_iter, body,

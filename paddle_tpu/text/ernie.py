@@ -26,7 +26,7 @@ class ErnieConfig:
                  hidden_act="gelu", hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, max_position_embeddings=513,
                  type_vocab_size=2, initializer_range=0.02, pad_token_id=0,
-                 enable_recompute=False):
+                 enable_recompute=False, recompute_policy=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -40,6 +40,10 @@ class ErnieConfig:
         self.initializer_range = initializer_range
         self.pad_token_id = pad_token_id
         self.enable_recompute = enable_recompute
+        # jax.checkpoint policy name (autograd.checkpoint_policy); e.g.
+        # "dots_saveable" keeps matmul outputs and recomputes elementwise
+        # (gelu/dropout/LN) in backward -- less HBM traffic than saving all.
+        self.recompute_policy = recompute_policy
 
 
 class ErnieEmbeddings(Layer):
@@ -93,7 +97,8 @@ class ErnieModel(Layer):
             attn_dropout=config.attention_probs_dropout_prob, act_dropout=0.0)
         self.encoder = nn.TransformerEncoder(
             enc_layer, config.num_hidden_layers,
-            enable_recompute=config.enable_recompute)
+            enable_recompute=config.enable_recompute,
+            recompute_policy=config.recompute_policy)
         self.pooler = ErniePooler(config.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,

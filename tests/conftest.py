@@ -28,7 +28,10 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir",
                   os.environ.get("PDTPU_TEST_CACHE_DIR",
                                  "/tmp/paddle_tpu_jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# Cache EVERY executable (threshold 0): the suite is dominated by hundreds
+# of sub-2s per-op eager compiles (each conv shape in the vision zoo is its
+# own executable) that the default 1s threshold would refuse to persist.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np
 import pytest

@@ -216,23 +216,26 @@ def tiny_yolo():
 
 
 def test_yolov3_forward_shapes(tiny_yolo):
-    x = jnp.zeros((1, 3, 96, 96), jnp.float32)
+    # ALL yolo tests share the (1, 3, 64, 64) input shape so the 53-conv
+    # backbone compiles exactly once per suite run (per-op executables are
+    # cached by shape; a second input size would recompile every conv).
+    x = jnp.zeros((1, 3, 64, 64), jnp.float32)
     heads = tiny_yolo(x)
     # strides 32, 16, 8; 3 anchors each; 5+4 channels per anchor
     assert [tuple(h.shape) for h in heads] == [
-        (1, 27, 3, 3), (1, 27, 6, 6), (1, 27, 12, 12)]
+        (1, 27, 2, 2), (1, 27, 4, 4), (1, 27, 8, 8)]
 
 
 def test_yolov3_loss_and_grad(tiny_yolo):
     """Differentiate the YOLO loss w.r.t. the HEAD outputs (not the whole
     DarkNet53 backward — that compile alone took 85s and backbone gradient
     flow is covered by test_resnet_trains_one_step-style tests)."""
-    x = jnp.asarray(np.random.RandomState(6).rand(2, 3, 64, 64), jnp.float32)
+    x = jnp.asarray(np.random.RandomState(6).rand(1, 3, 64, 64), jnp.float32)
     heads = tiny_yolo(x)
-    gt_box = jnp.asarray([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1]],
-                          [[0.7, 0.2, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]],
-                         jnp.float32)  # second image has 1 padded gt
-    gt_label = jnp.asarray([[1, 3], [0, 0]])
+    gt_box = jnp.asarray([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1],
+                           [0.0, 0.0, 0.0, 0.0]]],
+                         jnp.float32)  # last gt row is padding
+    gt_label = jnp.asarray([[1, 3, 0]])
 
     def loss_fn(hs):
         return tiny_yolo.loss(hs, gt_box, gt_label)
@@ -245,9 +248,9 @@ def test_yolov3_loss_and_grad(tiny_yolo):
 
 def test_yolov3_predict_fixed_size(tiny_yolo):
     tiny_yolo.eval()
-    x = jnp.asarray(np.random.RandomState(7).rand(1, 3, 96, 96), jnp.float32)
+    x = jnp.asarray(np.random.RandomState(7).rand(1, 3, 64, 64), jnp.float32)
     heads = tiny_yolo(x)
-    img_size = jnp.asarray([[96, 96]], jnp.int32)
+    img_size = jnp.asarray([[64, 64]], jnp.int32)
     dets, n = tiny_yolo.predict(heads, img_size, keep_top_k=20)
     assert dets.shape == (1, 20, 6)
     assert 0 <= int(n[0]) <= 20
