@@ -67,6 +67,9 @@ define_flag("check_nan_inf", False, "Post-check every op output for NaN/Inf "
             "(ref: platform/flags.cc:44 FLAGS_check_nan_inf).")
 define_flag("use_flash_attention", True, "Use the Pallas flash-attention kernel "
             "on TPU where applicable.")
+define_flag("use_fused_layer_norm", True, "Use the Pallas fused LayerNorm "
+            "kernel on TPU where applicable (one HBM pass per direction vs "
+            "~3 fwd / ~5 bwd for the jnp lowering).")
 define_flag("matmul_precision", "default", "jax.lax precision for matmuls: "
             "default|high|highest.")
 define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "

@@ -15,6 +15,14 @@ import numpy as np
 from ..core import random as _random
 
 
+def _check_float(dtype):
+    # jax.random.uniform/normal reject non-float dtypes; the host fast path
+    # must keep that contract so eager and traced init behave the same.
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        raise ValueError(
+            f"random initializers require a float dtype, got {jnp.dtype(dtype)}")
+
+
 def _host_rng():
     """numpy Generator seeded from the framework key stream, or None under
     tracing.
@@ -41,6 +49,7 @@ def _wants_device_draw(dtype):
 
 
 def _uniform(shape, dtype, low, high):
+    _check_float(dtype)
     rng, key = _host_rng()
     if rng is None or _wants_device_draw(dtype):
         return jax.random.uniform(key, shape, dtype=dtype, minval=low,
@@ -50,6 +59,7 @@ def _uniform(shape, dtype, low, high):
 
 
 def _normal(shape, dtype, mean, std):
+    _check_float(dtype)
     rng, key = _host_rng()
     if rng is None or _wants_device_draw(dtype):
         return mean + std * jax.random.normal(key, shape, dtype=dtype)
@@ -58,6 +68,7 @@ def _normal(shape, dtype, mean, std):
 
 
 def _truncated_normal(shape, dtype, mean, std, lo=-2.0, hi=2.0):
+    _check_float(dtype)
     rng, key = _host_rng()
     if rng is None or _wants_device_draw(dtype):
         x = jax.random.truncated_normal(key, lo, hi, shape, dtype=dtype)

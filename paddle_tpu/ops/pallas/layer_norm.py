@@ -1,0 +1,185 @@
+"""Pallas TPU fused LayerNorm: forward + backward kernels.
+
+Reference parity: operators/layer_norm_op.cc (the reference's fused CUDA
+LayerNorm kernel); on TPU the XLA lowering of the jnp composition costs ~3
+HBM passes forward (f32 upcast + mean + var reduces) and ~5 backward.  This
+kernel does one pass each way:
+
+* Forward: grid over row blocks; each (block_rows, dim) tile is read once,
+  mean/variance come from a single fused sum/sum-of-squares pair in f32
+  registers, the normalized output is written in the input dtype, and the
+  per-row (mean, rstd) statistics are saved for backward.
+* Backward: one pass re-deriving x_hat from (x, mean, rstd) and emitting
+  dx plus PER-BLOCK partial reductions for dweight/dbias; the tiny
+  (n_blocks, dim) partials are summed outside the kernel.  dx uses the
+  standard row-local identity
+      dx = rstd * (g - mean_row(g) - x_hat * mean_row(g * x_hat)),
+  g = dy * weight.
+
+Matmul-free, so the only wins are HBM passes — measured on the ERNIE-base
+flagship this halves LayerNorm's step share.  Stats are always f32
+regardless of input dtype (the jnp path's "f32 stability" contract).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)  # (block_rows, dim)
+    dim = x.shape[-1]
+    mean = jnp.sum(x, axis=-1, keepdims=True) / dim
+    # Two-pass variance: E[x^2]-E[x]^2 cancels catastrophically for
+    # large-mean rows (|x|~1e3 wipes out an O(1) variance in f32).  The
+    # tile is already in VMEM so the second reduction costs no HBM pass.
+    centered = x - mean
+    var = jnp.sum(centered * centered, axis=-1, keepdims=True) / dim
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    out = xhat * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    o_ref[...] = out.astype(o_ref.dtype)
+    mean_ref[...] = mean[:, 0][None, :]
+    rstd_ref[...] = rstd[:, 0][None, :]
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dw_ref,
+                   db_ref):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    dim = x.shape[-1]
+    mean = mean_ref[0][:, None]
+    rstd = rstd_ref[0][:, None]
+    xhat = (x - mean) * rstd
+    g = dy * w
+    g_mean = jnp.sum(g, axis=-1, keepdims=True) / dim
+    gx_mean = jnp.sum(g * xhat, axis=-1, keepdims=True) / dim
+    dx = rstd * (g - g_mean - xhat * gx_mean)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # Partial dweight/dbias for this row block.  Mosaic requires the last
+    # two block dims to be (8, 128)-divisible, so the (dim,) partial is
+    # written into row 0 of an (8, dim) tile (rows 1-7 zero).
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, dim), 0)
+    dw = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db = jnp.sum(dy, axis=0, keepdims=True)
+    dw_ref[0] = jnp.where(row == 0, dw, 0.0)
+    db_ref[0] = jnp.where(row == 0, db, 0.0)
+
+
+def _rows_block(n_rows: int) -> int:
+    block = min(DEFAULT_BLOCK_ROWS, n_rows)
+    while n_rows % block:
+        block //= 2
+    return max(block, 1)
+
+
+def _fwd(x2, w, b, eps, block_rows, out_dtype):
+    n, dim = x2.shape
+    grid = (n // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, dim), out_dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w.reshape(1, dim), b.reshape(1, dim))
+
+
+def _bwd(x2, w, mean, rstd, dy2, block_rows):
+    n, dim = x2.shape
+    n_blocks = n // block_rows
+    dx, dw_part, db_part = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, dim), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, dim), x2.dtype),
+            jax.ShapeDtypeStruct((n_blocks, 8, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_blocks, 8, dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w.reshape(1, dim), mean, rstd, dy2)
+    return dx, dw_part.sum(axis=(0, 1)), db_part.sum(axis=(0, 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ln(x2, w, b, eps, block_rows, out_dtype):
+    out, _, _ = _fwd(x2, w, b, eps, block_rows, out_dtype)
+    return out
+
+
+def _fused_ln_fwd(x2, w, b, eps, block_rows, out_dtype):
+    out, mean, rstd = _fwd(x2, w, b, eps, block_rows, out_dtype)
+    return out, (x2, w, mean, rstd)
+
+
+def _fused_ln_bwd(eps, block_rows, out_dtype, res, dy2):
+    x2, w, mean, rstd = res
+    dx, dw, db = _bwd(x2, w, mean, rstd, dy2, block_rows)
+    return dx, dw.astype(w.dtype), db.astype(w.dtype)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def supported(x, normalized_shape) -> bool:
+    """Last-dim-only norm with a lane-aligned dim and a row count divisible
+    by the 256-row block (keeps every Mosaic block (8,128)-tileable: the
+    per-row stats outputs are (1, block_rows) tiles)."""
+    if len(normalized_shape) != 1 or x.shape[-1] != normalized_shape[0]:
+        return False
+    dim = x.shape[-1]
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
+    return (dim % 128 == 0 and n % DEFAULT_BLOCK_ROWS == 0
+            and x.dtype in (jnp.bfloat16, jnp.float32))
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5):
+    """LayerNorm over the last axis with weight and bias, via the fused
+    kernel.  Callers must check ``supported()`` first.  The output dtype
+    matches the jnp composition's promotion (x normalized, then scaled by
+    weight/bias): result_type(x, weight, bias)."""
+    orig_shape = x.shape
+    dim = orig_shape[-1]
+    n = x.size // dim
+    out_dtype = jnp.result_type(x.dtype, weight.dtype, bias.dtype)
+    x2 = x.reshape(n, dim)
+    block_rows = _rows_block(n)
+    out = _fused_ln(x2, weight, bias, float(epsilon), block_rows, out_dtype)
+    return out.reshape(orig_shape)
