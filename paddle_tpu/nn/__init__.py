@@ -89,3 +89,8 @@ from .layer.pooling import (
     MaxPool1D,
     MaxPool2D,
 )
+from .decode import (
+    BeamSearchDecoder,
+    dynamic_decode,
+    gather_tree,
+)

@@ -1,0 +1,200 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode + gather_tree.
+
+Reference parity: python/paddle/fluid/layers/rnn.py — ``Decoder`` protocol,
+``BeamSearchDecoder`` (:~233), ``dynamic_decode`` (:~1035) and the
+``gather_tree`` op (operators/gather_tree_op.cc) that backtracks parent
+pointers into final beams.  Exposed in the reference 2.0 API as
+``paddle.nn.BeamSearchDecoder`` / ``paddle.nn.dynamic_decode``.
+
+TPU-native design: the reference grows LoD beams inside a While op over
+tensor arrays; here beams are DENSE — every array carries an explicit
+(batch, beam) pair of leading axes, the decode loop is a ``lax.while_loop``
+with a preallocated (max_steps, ...) output buffer (static shapes for XLA),
+and finished beams extend with forced EOS at zero added score.  Works under
+``jax.jit``.  The decode loop is a ``lax.while_loop`` (early exit when all
+beams finish), so reverse-mode AD through the loop is NOT supported — this
+is an inference path, like the reference's.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_tree(ids, parents):
+    """Backtrack beam parent pointers (ref gather_tree_op.cc; fluid
+    layers/nn.py gather_tree).
+
+    ids, parents: (max_time, batch, beam) int arrays.  Returns the
+    time-major token matrix where each beam's path is rewritten to follow
+    its parent chain back from the last step.
+    """
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T, b, beam = ids.shape
+
+    def step(carry, xs):
+        beam_idx = carry                    # (b, beam) — beam at time t+1
+        ids_t, parents_t = xs
+        tokens = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        prev_beam = jnp.take_along_axis(parents_t, beam_idx, axis=1)
+        return prev_beam, tokens
+
+    init = jnp.broadcast_to(jnp.arange(beam, dtype=ids.dtype), (b, beam))
+    _, toks_rev = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return toks_rev[::-1]
+
+
+class BeamSearchOutput(NamedTuple):
+    scores: Any          # (max_steps, batch, beam) accumulated log-probs
+    predicted_ids: Any   # (max_steps, batch, beam) — backtracked tokens
+    parent_ids: Any      # (max_steps, batch, beam) raw parent pointers
+
+
+class BeamSearchState(NamedTuple):
+    cell_states: Any     # pytree, leaves (batch*beam, ...)
+    log_probs: Any       # (batch, beam)
+    finished: Any        # (batch, beam) bool
+    lengths: Any         # (batch, beam) int32
+
+
+class BeamSearchDecoder:
+    """Dense beam-search decoder over an RNN cell (ref BeamSearchDecoder,
+    fluid/layers/rnn.py).  ``embedding_fn`` maps token ids to cell inputs;
+    ``output_fn`` maps cell outputs to vocabulary logits."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Callable,
+                 output_fn: Callable, vocab_size: Optional[int] = None):
+        # vocab_size is optional validation: when given, step() checks the
+        # output_fn logits width against it.
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.vocab_size = vocab_size
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size: int):
+        """(batch, ...) -> (batch*beam, ...) by repeating each row beam_size
+        times (ref BeamSearchDecoder.tile_beam_merge_with_batch)."""
+        return jax.tree_util.tree_map(
+            lambda t: jnp.repeat(t, beam_size, axis=0), x)
+
+    def initialize(self, initial_cell_states):
+        states = self.tile_beam_merge_with_batch(initial_cell_states,
+                                                 self.beam_size)
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        bb = leaf.shape[0]
+        b = bb // self.beam_size
+        # only beam 0 is live at t=0 (the reference's kInf masking): other
+        # beams start at -inf so the first topk draws beam-0 expansions.
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (self.beam_size - 1)],
+                        jnp.float32), (b, 1))
+        state = BeamSearchState(
+            cell_states=states,
+            log_probs=log_probs,
+            finished=jnp.zeros((b, self.beam_size), bool),
+            lengths=jnp.zeros((b, self.beam_size), jnp.int32),
+        )
+        tokens = jnp.full((b, self.beam_size), self.start_token, jnp.int32)
+        return tokens, state
+
+    def step(self, tokens, state: BeamSearchState):
+        """One beam step.  Returns (ids, parents, scores, next_state)."""
+        b, beam = tokens.shape
+        inputs = self.embedding_fn(tokens.reshape(b * beam))
+        cell_out, cell_states = self.cell(inputs, state.cell_states)
+        logits = self.output_fn(cell_out)                    # (b*beam, V)
+        V = logits.shape[-1]
+        if self.vocab_size is not None and V != self.vocab_size:
+            raise ValueError(
+                f"output_fn produced {V} logits, expected vocab_size="
+                f"{self.vocab_size}")
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        step_lp = step_lp.reshape(b, beam, V)
+        # finished beams may only extend with end_token at zero added score
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(state.finished[:, :, None], eos_only[None, None, :],
+                            step_lp)
+        total = state.log_probs[:, :, None] + step_lp        # (b, beam, V)
+        flat = total.reshape(b, beam * V)
+        top_lp, top_idx = jax.lax.top_k(flat, beam)          # (b, beam)
+        parents = (top_idx // V).astype(jnp.int32)
+        ids = (top_idx % V).astype(jnp.int32)
+
+        gather = lambda t: jnp.take_along_axis(t, parents, axis=1)
+        finished = gather(state.finished) | (ids == self.end_token)
+        lengths = gather(state.lengths) + (~gather(state.finished)).astype(
+            jnp.int32)
+
+        def regroup(leaf):
+            leaf_b = leaf.reshape((b, beam) + leaf.shape[1:])
+            idx = parents.reshape((b, beam) + (1,) * (leaf_b.ndim - 2))
+            out = jnp.take_along_axis(leaf_b, idx, axis=1)
+            return out.reshape((b * beam,) + leaf.shape[1:])
+
+        next_states = jax.tree_util.tree_map(regroup, cell_states)
+        next_state = BeamSearchState(next_states, top_lp, finished, lengths)
+        return ids, parents, top_lp, next_state
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits, max_step_num: int,
+                   is_test: bool = True, return_length: bool = False):
+    """Run ``decoder`` to completion (ref fluid/layers/rnn.py
+    dynamic_decode): loops until every beam emitted end_token or
+    ``max_step_num`` is reached, then backtracks with gather_tree.
+
+    Returns (BeamSearchOutput, final_state) or with sequence lengths
+    appended when ``return_length``.
+    """
+    tokens0, state0 = decoder.initialize(inits)
+    b, beam = tokens0.shape
+    T = int(max_step_num)
+
+    buf = dict(
+        ids=jnp.zeros((T, b, beam), jnp.int32),
+        parents=jnp.zeros((T, b, beam), jnp.int32),
+        scores=jnp.zeros((T, b, beam), jnp.float32),
+    )
+
+    def cond(carry):
+        t, tokens, state, buf = carry
+        return (t < T) & ~jnp.all(state.finished)
+
+    def body(carry):
+        t, tokens, state, buf = carry
+        ids, parents, scores, next_state = decoder.step(tokens, state)
+        next_tokens = ids
+        buf = dict(
+            ids=buf["ids"].at[t].set(ids),
+            parents=buf["parents"].at[t].set(parents),
+            scores=buf["scores"].at[t].set(scores),
+        )
+        return t + 1, next_tokens, next_state, buf
+
+    t, _, final_state, buf = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), tokens0, state0, buf))
+
+    # steps never executed keep parent=identity/EOS so gather_tree is a
+    # no-op, and their scores carry the final accumulated log-probs forward
+    # (0.0 would outrank every real log-prob for consumers reading
+    # scores[-1] as the final beam ranking).
+    step_idx = jnp.arange(T)[:, None, None]
+    live = step_idx < t
+    parents = jnp.where(live, buf["parents"],
+                        jnp.arange(beam, dtype=jnp.int32)[None, None, :])
+    ids = jnp.where(live, buf["ids"], decoder.end_token)
+    scores = jnp.where(live, buf["scores"],
+                       final_state.log_probs[None, :, :])
+    predicted = gather_tree(ids, parents)
+    out = BeamSearchOutput(scores=scores, predicted_ids=predicted,
+                           parent_ids=buf["parents"])
+    if return_length:
+        return out, final_state, final_state.lengths
+    return out, final_state

@@ -462,13 +462,18 @@ def concat(inputs, axis=0) -> Variable:
 
 
 def split(x, num_or_sections, dim=0):
+    ax = dim % x.ndim
     if isinstance(num_or_sections, int):
         n = num_or_sections
         attrs = {"num": n, "axis": dim}
+        sizes = [x.shape[ax] // n if x.shape[ax] >= 0 else -1] * n
     else:
         n = len(num_or_sections)
         attrs = {"sections": list(num_or_sections), "num": 0, "axis": dim}
-    outs = [_out(x.dtype, (-1,) * x.ndim) for _ in range(n)]
+        sizes = [int(v) for v in num_or_sections]
+    shapes = [tuple(sz if d == ax else x.shape[d] for d in range(x.ndim))
+              for sz in sizes]
+    outs = [_out(x.dtype, shp) for shp in shapes]
     _append("split", {"X": [x.name]}, {"Out": [o.name for o in outs]}, attrs)
     return outs
 
@@ -906,3 +911,117 @@ def kldiv_loss(x, target, reduction="mean") -> Variable:
 def mse_loss(input, label) -> Variable:
     """ref fluid/layers mse_loss — mean of squared error."""
     return mean(square_error_cost(input, label))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0) -> Variable:
+    """ref fluid/layers fill_constant_batch_size_like: constant tensor whose
+    dim ``output_dim_idx`` copies ``input``'s runtime dim ``input_dim_idx``
+    (the standard way to build batch-shaped RNN initial states when the
+    batch dim is unknown at build time)."""
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = _out(dtype, tuple(out_shape))
+    _append("fill_constant_batch_size_like", {"Input": [input.name]},
+            {"Out": [out.name]},
+            {"shape": list(shape), "dtype": dtype, "value": float(value),
+             "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx})
+    return out
+
+
+# -- padded sequence layers --------------------------------------------------
+
+def sequence_mask(x, maxlen, dtype="float32") -> Variable:
+    """(b,) lengths -> (b, maxlen) 0/1 mask (ref fluid/layers/nn.py
+    sequence_mask; padded TPU layout per SURVEY §7 LoD policy)."""
+    out = _out(dtype, (x.shape[0], int(maxlen)))
+    _append("sequence_mask", {"X": [x.name]}, {"Y": [out.name]},
+            {"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def sequence_last_step(input, sequence_length) -> Variable:
+    """Last valid timestep of a padded (b, s, d) sequence batch (ref
+    fluid/layers sequence_last_step over LoD; here a masked gather)."""
+    out = _out(input.dtype, (input.shape[0], input.shape[2]))
+    _append("sequence_last_step_padded",
+            {"X": [input.name], "Lengths": [sequence_length.name]},
+            {"Out": [out.name]}, {})
+    return out
+
+
+def dynamic_lstm(input, size, sequence_length=None, h0=None, c0=None,
+                 param_attr=None, bias_attr=None, gate_activation="sigmoid",
+                 cell_activation="tanh", candidate_activation="tanh",
+                 name=None):
+    """LSTM over a padded (batch, seq, 4H) pre-projected input (ref
+    fluid/layers/nn.py dynamic_lstm -> lstm_op.cc).
+
+    The reference consumes a LoD-packed (sum_len, 4H) tensor; the TPU-native
+    layout is padded batch-major plus ``sequence_length`` (SURVEY §7 LoD
+    policy), and the recurrence lowers to lax.scan via StaticRNN.  As in the
+    reference, callers pre-project the input with an fc of size 4H; this
+    layer owns only the recurrent weight (H, 4H) and bias (4H).  Gate chunk
+    order is (i, f, g, o), matching nn.layer.rnn.LSTMCell's weight-layout
+    parity contract.  Returns (hidden, cell), each (batch, seq, H).
+    """
+    from .control_flow import StaticRNN
+
+    if size % 4:
+        raise ValueError(f"dynamic_lstm size must be 4*hidden, got {size}")
+    H = size // 4
+    b, s = int(input.shape[0]), int(input.shape[1])
+    if s < 0:
+        raise ValueError(
+            "dynamic_lstm requires a static (padded) sequence length in "
+            "input.shape[1]; got -1.  Pad sequences to a fixed max length "
+            "(SURVEY §7 LoD policy) and pass sequence_length for masking.")
+    acts = {"sigmoid": sigmoid, "tanh": tanh, "relu": relu,
+            "identity": lambda v: v}
+    try:
+        gate_act = acts[gate_activation]
+        cell_act = acts[cell_activation]
+        cand_act = acts[candidate_activation]
+    except KeyError as e:
+        raise ValueError(f"dynamic_lstm: unsupported activation {e}; "
+                         f"one of {sorted(acts)}") from None
+
+    w = create_parameter((H, 4 * H), input.dtype, attr=param_attr,
+                         name=f"{name}.w" if name else None)
+    bias = create_parameter((4 * H,), input.dtype, attr=bias_attr,
+                            default_initializer=I.Constant(0.0),
+                            name=f"{name}.b" if name else None)
+    if h0 is None:
+        h0 = fill_constant_batch_size_like(input, (b, H), input.dtype, 0.0)
+    if c0 is None:
+        c0 = fill_constant_batch_size_like(input, (b, H), input.dtype, 0.0)
+
+    x_tm = transpose(input, [1, 0, 2])                     # (s, b, 4H)
+    if sequence_length is not None:
+        mask = sequence_mask(sequence_length, s, dtype=input.dtype)
+        mask_tm = unsqueeze(transpose(mask, [1, 0]), [2])  # (s, b, 1)
+
+    rnn = StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x_tm)                          # (b, 4H)
+        mt = rnn.step_input(mask_tm) if sequence_length is not None else None
+        h_prev = rnn.memory(init=h0)
+        c_prev = rnn.memory(init=c0)
+        gates = elementwise_add(elementwise_add(xt, matmul(h_prev, w)), bias)
+        gi, gf, gg, go = split(gates, 4, dim=1)
+        c_new = elementwise_add(elementwise_mul(gate_act(gf), c_prev),
+                                elementwise_mul(gate_act(gi), cand_act(gg)))
+        h_new = elementwise_mul(gate_act(go), cell_act(c_new))
+        if mt is not None:
+            inv = elementwise_sub(
+                fill_constant_batch_size_like(mt, (b, 1), input.dtype, 1.0), mt)
+            h_new = elementwise_add(elementwise_mul(h_new, mt),
+                                    elementwise_mul(h_prev, inv))
+            c_new = elementwise_add(elementwise_mul(c_new, mt),
+                                    elementwise_mul(c_prev, inv))
+        rnn.update_memory(h_prev, h_new)
+        rnn.update_memory(c_prev, c_new)
+        rnn.step_output(h_new)
+        rnn.step_output(c_new)
+    h_tm, c_tm = rnn()
+    return transpose(h_tm, [1, 0, 2]), transpose(c_tm, [1, 0, 2])

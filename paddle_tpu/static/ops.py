@@ -754,3 +754,25 @@ def _kldiv_loss(ins, attrs, op):
     elif red == "batchmean":
         loss = jnp.sum(loss) / x.shape[0]
     return {"Loss": [loss]}
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ins, attrs, op):
+    """Padded-layout sequence_mask (ref fluid/layers/nn.py sequence_mask);
+    delegates to the eager ops/sequence.py implementation."""
+    from ..ops import sequence as _seq
+
+    mask = _seq.sequence_mask(_one(ins, "X"), maxlen=int(attrs["maxlen"]),
+                              dtype=attrs.get("out_dtype", "float32"))
+    return {"Y": [mask]}
+
+
+@register_op("sequence_last_step_padded")
+def _sequence_last_step_padded(ins, attrs, op):
+    """Last valid timestep of a padded (b, s, d) batch given lengths (b,);
+    delegates to ops/sequence.py sequence_last_step (the reference's
+    LoD-aware sequence_last_step in the padded TPU layout)."""
+    from ..ops import sequence as _seq
+
+    return {"Out": [_seq.sequence_last_step(_one(ins, "X"),
+                                            _one(ins, "Lengths"))]}
