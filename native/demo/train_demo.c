@@ -1,0 +1,84 @@
+/* Pure-C training from a saved program (N38; ref paddle/fluid/train/demo/
+ * demo_trainer.cc + test_train_recognize_digits.cc: load a program saved by
+ * the python front end, run train steps from C++, watch the loss drop).
+ *
+ * Usage: train_demo <model_prefix> <steps>
+ *   model_prefix: written by paddle_tpu.static.save() on a program that
+ *   CONTAINS backward + optimizer ops and fetches the loss.
+ * Prints one loss per step; exits 0 iff the final loss < first loss.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "pd_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_prefix> <steps>\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int steps = atoi(argv[2]);
+
+  PD_Predictor* pred = PD_PredictorCreate(prefix, NULL);
+  if (!pred) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  /* toy linear-regression batch: y = 2x + 1 with 13 features summed */
+  enum { B = 16, D = 13 };
+  static float xbuf[B * D], ybuf[B];
+  unsigned seed = 7;
+  double first = -1.0, last = -1.0;
+
+  for (int step = 0; step < steps; ++step) {
+    for (int i = 0; i < B; ++i) {
+      float s = 0.f;
+      for (int j = 0; j < D; ++j) {
+        seed = seed * 1103515245u + 12345u;
+        float v = (float)((seed >> 16) & 0x7fff) / 32768.0f;
+        xbuf[i * D + j] = v;
+        s += v;
+      }
+      ybuf[i] = 2.0f * s + 1.0f;
+    }
+    PD_Tensor inputs[2];
+    memset(inputs, 0, sizeof(inputs));
+    snprintf(inputs[0].name, PD_MAX_NAME, "x");
+    inputs[0].dtype = PD_FLOAT32;
+    inputs[0].ndim = 2;
+    inputs[0].shape[0] = B;
+    inputs[0].shape[1] = D;
+    inputs[0].data = xbuf;
+    snprintf(inputs[1].name, PD_MAX_NAME, "y");
+    inputs[1].dtype = PD_FLOAT32;
+    inputs[1].ndim = 2;
+    inputs[1].shape[0] = B;
+    inputs[1].shape[1] = 1;
+    inputs[1].data = ybuf;
+
+    PD_Tensor* outputs = NULL;
+    int n_out = 0;
+    if (PD_PredictorRun(pred, inputs, 2, &outputs, &n_out) != 0) {
+      fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+      PD_PredictorDestroy(pred);
+      return 1;
+    }
+    if (n_out < 1 || outputs[0].dtype != PD_FLOAT32) {
+      fprintf(stderr, "expected a float32 loss fetch\n");
+      return 1;
+    }
+    last = ((float*)outputs[0].data)[0];
+    if (step == 0) first = last;
+    printf("step %d loss %.6f\n", step, last);
+    PD_TensorsFree(outputs, n_out);
+  }
+  PD_PredictorDestroy(pred);
+  if (!(last < first)) {
+    fprintf(stderr, "loss did not decrease: first=%f last=%f\n", first, last);
+    return 1;
+  }
+  return 0;
+}

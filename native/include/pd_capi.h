@@ -1,0 +1,65 @@
+/* C inference/training API for paddle_tpu.
+ *
+ * Reference parity: paddle/fluid/inference/capi/ (pd_predictor.cc,
+ * pd_config.cc) — a C surface over the predictor so C programs (and FFIs:
+ * the reference's Go binding go/paddle/predictor.go wraps exactly this) can
+ * run saved models.  TPU-native design: the compute engine is JAX/XLA in a
+ * Python runtime, so this library is a zero-dependency CLIENT that spawns
+ * the paddle_tpu.inference.capi_worker service as a child process and
+ * exchanges tensors over a length-prefixed pipe protocol; the model still
+ * executes on the real backend (TPU or CPU).  One handle serves both the
+ * inference dirs written by save_inference_model() and the trainable
+ * prefixes written by static.save() — running a program that contains
+ * backward+optimizer ops through PD_PredictorRun IS a training step
+ * (the reference's fluid/train/demo contract).
+ */
+#ifndef PD_CAPI_H_
+#define PD_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_FLOAT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+} PD_DataType;
+
+#define PD_MAX_NAME 128
+#define PD_MAX_RANK 8
+
+typedef struct {
+  char name[PD_MAX_NAME];
+  int dtype;                 /* PD_DataType */
+  int ndim;
+  long long shape[PD_MAX_RANK];
+  void* data;                /* owned by caller for inputs; by the library
+                                for outputs (free with PD_TensorsFree) */
+} PD_Tensor;
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* model_path: a save_inference_model directory or a static.save prefix.
+ * python_exe: interpreter to run the worker with (NULL = "python3").
+ * Returns NULL on failure. */
+PD_Predictor* PD_PredictorCreate(const char* model_path,
+                                 const char* python_exe);
+
+/* Runs one feed->fetch round trip.  outputs/n_outputs are filled with
+ * library-owned tensors (release with PD_TensorsFree).  Returns 0 on
+ * success, nonzero on failure (PD_GetLastError describes it). */
+int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
+                    PD_Tensor** outputs, int* n_outputs);
+
+void PD_TensorsFree(PD_Tensor* tensors, int n);
+void PD_PredictorDestroy(PD_Predictor* pred);
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_CAPI_H_ */

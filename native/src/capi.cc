@@ -1,0 +1,242 @@
+// C inference/training API: pipe-protocol client for the capi_worker
+// Executor service.  See native/include/pd_capi.h for the design note
+// (ref paddle/fluid/inference/capi/pd_predictor.cc).
+#include "pd_capi.h"
+
+#include <signal.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+size_t DtypeSize(int dtype) {
+  switch (dtype) {
+    case PD_FLOAT32: return 4;
+    case PD_INT32: return 4;
+    case PD_INT64: return 8;
+    case PD_FLOAT64: return 8;
+    case PD_UINT8: return 1;
+    case PD_BOOL: return 1;
+    default: return 0;
+  }
+}
+
+long long Numel(const PD_Tensor& t) {
+  long long n = 1;
+  for (int i = 0; i < t.ndim; ++i) n *= t.shape[i];
+  return n;
+}
+
+bool WriteAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t w = write(fd, p, len);
+    if (w <= 0) return false;
+    p += w;
+    len -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool ReadAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t r = read(fd, p, len);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+struct PD_Predictor {
+  pid_t pid = -1;
+  int to_worker = -1;    // write end
+  int from_worker = -1;  // read end
+};
+
+extern "C" {
+
+PD_Predictor* PD_PredictorCreate(const char* model_path,
+                                 const char* python_exe) {
+  if (model_path == nullptr) {
+    SetError("model_path is NULL");
+    return nullptr;
+  }
+  const char* py = python_exe ? python_exe : "python3";
+  int in_pipe[2], out_pipe[2];
+  if (pipe(in_pipe) != 0 || pipe(out_pipe) != 0) {
+    SetError("pipe() failed");
+    return nullptr;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    SetError("fork() failed");
+    return nullptr;
+  }
+  if (pid == 0) {
+    // child: stdin <- in_pipe[0], stdout -> out_pipe[1]
+    dup2(in_pipe[0], STDIN_FILENO);
+    dup2(out_pipe[1], STDOUT_FILENO);
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
+    execlp(py, py, "-m", "paddle_tpu.inference.capi_worker", model_path,
+           static_cast<char*>(nullptr));
+    std::fprintf(stderr, "pd_capi: execlp(%s) failed\n", py);
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  auto* pred = new PD_Predictor;
+  pred->pid = pid;
+  pred->to_worker = in_pipe[1];
+  pred->from_worker = out_pipe[0];
+  char ready[4];
+  if (!ReadAll(pred->from_worker, ready, 4) ||
+      std::memcmp(ready, "PDOK", 4) != 0) {
+    SetError("worker failed to start (is paddle_tpu importable by " +
+             std::string(py) + "?)");
+    PD_PredictorDestroy(pred);
+    return nullptr;
+  }
+  return pred;
+}
+
+int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
+                    PD_Tensor** outputs, int* n_outputs) {
+  if (!pred || pred->pid < 0) {
+    SetError("invalid predictor");
+    return -1;
+  }
+  int fd = pred->to_worker;
+  if (!WriteAll(fd, "PDRQ", 4)) { SetError("write failed"); return -1; }
+  int32_t n = n_inputs;
+  WriteAll(fd, &n, 4);
+  for (int i = 0; i < n_inputs; ++i) {
+    const PD_Tensor& t = inputs[i];
+    int32_t name_len = static_cast<int32_t>(std::strlen(t.name));
+    WriteAll(fd, &name_len, 4);
+    WriteAll(fd, t.name, name_len);
+    int32_t dtype = t.dtype, ndim = t.ndim;
+    WriteAll(fd, &dtype, 4);
+    WriteAll(fd, &ndim, 4);
+    for (int d = 0; d < t.ndim; ++d) {
+      int64_t dim = t.shape[d];
+      WriteAll(fd, &dim, 8);
+    }
+    if (!WriteAll(fd, t.data, Numel(t) * DtypeSize(t.dtype))) {
+      SetError("tensor write failed");
+      return -1;
+    }
+  }
+  char magic[4];
+  if (!ReadAll(pred->from_worker, magic, 4)) {
+    SetError("worker closed the pipe");
+    return -1;
+  }
+  if (std::memcmp(magic, "PDER", 4) == 0) {
+    int32_t len = 0;
+    ReadAll(pred->from_worker, &len, 4);
+    std::string msg(len, '\0');
+    ReadAll(pred->from_worker, msg.data(), len);
+    SetError("worker error: " + msg);
+    return -2;
+  }
+  if (std::memcmp(magic, "PDRS", 4) != 0) {
+    SetError("bad response magic");
+    return -1;
+  }
+  int32_t n_out = 0;
+  if (!ReadAll(pred->from_worker, &n_out, 4)) {
+    SetError("truncated response");
+    return -1;
+  }
+  if (n_out < 0 || n_out > 4096) {
+    SetError("implausible output count (protocol desync?)");
+    return -1;
+  }
+  auto* outs = static_cast<PD_Tensor*>(std::calloc(n_out, sizeof(PD_Tensor)));
+  for (int i = 0; i < n_out; ++i) {
+    PD_Tensor& t = outs[i];
+    int32_t name_len = 0;
+    if (!ReadAll(pred->from_worker, &name_len, 4) || name_len < 0 ||
+        name_len > 4096) {
+      SetError("bad tensor name length");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    std::string name(name_len, '\0');
+    if (!ReadAll(pred->from_worker, name.data(), name_len)) {
+      SetError("truncated tensor name");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    std::snprintf(t.name, PD_MAX_NAME, "%s", name.c_str());
+    int32_t dtype = 0, ndim = 0;
+    if (!ReadAll(pred->from_worker, &dtype, 4) ||
+        !ReadAll(pred->from_worker, &ndim, 4) || DtypeSize(dtype) == 0 ||
+        ndim < 0 || ndim > PD_MAX_RANK) {
+      SetError("bad tensor header (dtype/ndim out of range for pd_capi)");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    t.dtype = dtype;
+    t.ndim = ndim;
+    for (int d = 0; d < ndim; ++d) {
+      int64_t dim = 0;
+      if (!ReadAll(pred->from_worker, &dim, 8) || dim < 0) {
+        SetError("bad tensor dim");
+        PD_TensorsFree(outs, i);
+        return -1;
+      }
+      t.shape[d] = dim;
+    }
+    size_t bytes = static_cast<size_t>(Numel(t)) * DtypeSize(t.dtype);
+    t.data = std::malloc(bytes ? bytes : 1);
+    if (!ReadAll(pred->from_worker, t.data, bytes)) {
+      SetError("truncated tensor payload");
+      PD_TensorsFree(outs, i + 1);
+      return -1;
+    }
+  }
+  *outputs = outs;
+  *n_outputs = n_out;
+  return 0;
+}
+
+void PD_TensorsFree(PD_Tensor* tensors, int n) {
+  if (!tensors) return;
+  for (int i = 0; i < n; ++i) std::free(tensors[i].data);
+  std::free(tensors);
+}
+
+void PD_PredictorDestroy(PD_Predictor* pred) {
+  if (!pred) return;
+  if (pred->to_worker >= 0) close(pred->to_worker);
+  if (pred->from_worker >= 0) close(pred->from_worker);
+  if (pred->pid > 0) {
+    int status = 0;
+    // worker exits on stdin EOF; reap it (kill after a grace period is the
+    // caller's job if it wants hard deadlines)
+    waitpid(pred->pid, &status, 0);
+  }
+  delete pred;
+}
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

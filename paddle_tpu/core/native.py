@@ -67,6 +67,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_prof_summary.argtypes = [c.c_char_p, c.c_int]
     lib.pt_prof_summary.restype = c.c_int
 
+    lib.pd_aes_ctr_crypt.argtypes = [c.c_char_p, c.c_int, c.c_char_p,
+                                     c.POINTER(c.c_uint8), c.c_longlong]
+    lib.pd_aes_ctr_crypt.restype = c.c_int
+    lib.pd_aes_encrypt_block.argtypes = [c.c_char_p, c.c_int, c.c_char_p,
+                                         c.POINTER(c.c_uint8)]
+    lib.pd_aes_encrypt_block.restype = c.c_int
+
     lib.pt_feed_create.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int]
     lib.pt_feed_create.restype = c.c_void_p
     lib.pt_feed_set_files.argtypes = [c.c_void_p, c.c_char_p]

@@ -1,0 +1,114 @@
+"""Executor service behind the C inference API.
+
+Reference parity: the C API (paddle/fluid/inference/capi/pd_predictor.cc)
+wraps the in-process C++ AnalysisPredictor.  In the TPU-native rebuild the
+compute engine is JAX/XLA living in a Python process, so the C library
+(native/src/capi.cc) runs THIS worker as a child process and speaks a
+length-prefixed binary protocol over stdin/stdout — the C side stays a thin
+zero-dependency client while inference executes on the real backend.  One
+worker serves both roles of the reference's native surfaces: inference
+(save_inference_model dirs; capi/) and train-from-saved-program
+(static.save prefixes; train/demo/demo_trainer.cc) — scope state persists
+across calls, so running a program whose ops include backward+optimizer
+steps IS training.
+
+Wire format (little-endian):
+  request:  b"PDRQ" | i32 n_inputs | n x tensor
+  tensor:   i32 name_len | name | i32 dtype | i32 ndim | i64 dims[] | data
+  response: b"PDRS" | i32 n_outputs | n x tensor   (fetch order)
+  error:    b"PDER" | i32 len | utf-8 message
+  dtype codes: 0=f32 1=i32 2=i64 3=f64 4=u8 5=bool
+"""
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.float64,
+           4: np.uint8, 5: np.bool_}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def _read_exact(f, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return buf
+
+
+def _read_tensor(f):
+    (name_len,) = struct.unpack("<i", _read_exact(f, 4))
+    name = _read_exact(f, name_len).decode()
+    dtype_code, ndim = struct.unpack("<ii", _read_exact(f, 8))
+    dims = struct.unpack(f"<{ndim}q", _read_exact(f, 8 * ndim)) if ndim else ()
+    dt = np.dtype(_DTYPES[dtype_code])
+    n = int(np.prod(dims)) if dims else 1
+    data = np.frombuffer(_read_exact(f, n * dt.itemsize), dtype=dt)
+    return name, data.reshape(dims)
+
+def _write_tensor(f, name, arr):
+    arr = np.ascontiguousarray(arr)
+    code = _CODES.get(arr.dtype)
+    if code is None:  # e.g. bf16 fetches — promote to f32 over the wire
+        arr = arr.astype(np.float32)
+        code = 0
+    nb = name.encode()
+    f.write(struct.pack("<i", len(nb)) + nb)
+    f.write(struct.pack("<ii", code, arr.ndim))
+    f.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+    f.write(arr.tobytes())
+
+
+def main():
+    model_path = sys.argv[1]
+    import jax
+
+    # The image's sitecustomize imports jax at interpreter start and
+    # registers the TPU-tunnel plugin, so JAX_PLATFORMS in the environment
+    # is captured too early to matter — honor it here via jax.config before
+    # any backend use (the C client inherits the caller's environment).
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import paddle_tpu.static as static
+
+    exe = static.Executor()
+    if os.path.isdir(model_path):
+        program, feeds, fetches = static.load_inference_model(model_path, exe)
+    else:
+        program, feeds, fetches = static.load(model_path, exe)
+    inp, out = sys.stdin.buffer, sys.stdout.buffer
+    out.write(b"PDOK")
+    out.flush()
+    while True:
+        try:
+            magic = inp.read(4)
+        except Exception:
+            break
+        if magic != b"PDRQ":
+            break
+        try:
+            (n_in,) = struct.unpack("<i", _read_exact(inp, 4))
+            feed = {}
+            for _ in range(n_in):
+                name, arr = _read_tensor(inp)
+                feed[name] = arr
+            results = exe.run(program, feed=feed, fetch_list=list(fetches))
+            out.write(b"PDRS" + struct.pack("<i", len(results)))
+            for name, arr in zip(fetches, results):
+                _write_tensor(out, str(name), np.asarray(arr))
+            out.flush()
+        except Exception as e:  # report and keep serving
+            msg = f"{type(e).__name__}: {e}".encode()
+            out.write(b"PDER" + struct.pack("<i", len(msg)) + msg)
+            out.flush()
+
+
+if __name__ == "__main__":
+    main()

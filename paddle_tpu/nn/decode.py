@@ -194,7 +194,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits, max_step_num: int,
                        final_state.log_probs[None, :, :])
     predicted = gather_tree(ids, parents)
     out = BeamSearchOutput(scores=scores, predicted_ids=predicted,
-                           parent_ids=buf["parents"])
+                           parent_ids=parents)
     if return_length:
         return out, final_state, final_state.lengths
     return out, final_state

@@ -43,8 +43,10 @@ from .framework import (
     unique_name,
 )
 from .io import (
+    load,
     load_inference_model,
     load_persistables,
+    save,
     save_inference_model,
     save_persistables,
 )

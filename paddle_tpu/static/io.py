@@ -153,8 +153,11 @@ def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
 def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars: Sequence[Variable], executor: Executor,
                          main_program: Optional[Program] = None,
-                         scope: Optional[Scope] = None):
-    """ref fluid/io.py:1164."""
+                         scope: Optional[Scope] = None,
+                         cipher=None):
+    """ref fluid/io.py:1164.  ``cipher`` (utils.crypto.Cipher) encrypts the
+    saved parameter file like the reference's encrypted inference models
+    (framework/io/crypto/): params.npz becomes params.npz.enc."""
     from .framework import default_main_program
     program = main_program or default_main_program()
     scope = scope or global_scope()
@@ -166,20 +169,83 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
         json.dump({"program": _program_to_json(pruned),
                    "feeds": list(feeded_var_names),
                    "fetches": fetch_names}, f, indent=1)
-    np.savez(os.path.join(dirname, "params.npz"),
-             **_persistable_values(pruned, scope))
+    plain = os.path.join(dirname, "params.npz")
+    enc = plain + ".enc"
+    if cipher is None:
+        np.savez(plain, **_persistable_values(pruned, scope))
+        if os.path.exists(enc):  # stale ciphertext from a prior cipher save
+            os.remove(enc)
+    else:
+        import io as _io
+
+        buf = _io.BytesIO()
+        np.savez(buf, **_persistable_values(pruned, scope))
+        cipher.encrypt_to_file(buf.getvalue(), enc)
+        if os.path.exists(plain):  # stale plaintext from a prior plain save
+            os.remove(plain)
     return fetch_names
 
 
 def load_inference_model(dirname: str, executor: Executor,
-                         scope: Optional[Scope] = None
-                         ) -> Tuple[Program, List[str], List[str]]:
-    """ref fluid/io.py:1374 — returns (program, feed_names, fetch_names)."""
+                         scope: Optional[Scope] = None,
+                         cipher=None) -> Tuple[Program, List[str], List[str]]:
+    """ref fluid/io.py:1374 — returns (program, feed_names, fetch_names).
+    Pass the ``cipher`` used at save time to read encrypted params."""
     scope = scope or global_scope()
     with open(os.path.join(dirname, "program.json")) as f:
         d = json.load(f)
     program = _program_from_json(d["program"])
-    data = np.load(os.path.join(dirname, "params.npz"))
+    enc = os.path.join(dirname, "params.npz.enc")
+    if cipher is not None:
+        import io as _io
+
+        data = np.load(_io.BytesIO(cipher.decrypt_from_file(enc)))
+    elif os.path.exists(enc):
+        raise ValueError(
+            f"{dirname} holds an encrypted model (params.npz.enc); pass "
+            "cipher= with the key it was saved with")
+    else:
+        data = np.load(os.path.join(dirname, "params.npz"))
     for name in data.files:
         scope.set(name, data[name])
     return program, d["feeds"], d["fetches"]
+
+
+def save(program: Program, model_prefix: str, executor: Executor = None,
+         scope: Optional[Scope] = None, fetches: Sequence = ()) -> None:
+    """Save a FULL program (including backward/optimizer ops) + its
+    persistable state: ``<prefix>.pdmodel`` (JSON program) and
+    ``<prefix>.pdparams`` (npz) (ref fluid/io.py save :1669 — program +
+    state serialization; JSON replaces the pickled ProgramDesc, see the
+    wire-compat descope note in this module's docstring).
+
+    Unlike save_inference_model this does NOT prune: the saved program can
+    keep TRAINING when reloaded (the reference's C++ train-from-saved-
+    program demo contract, train/demo/demo_trainer.cc).
+    """
+    scope = scope or global_scope()
+    os.makedirs(os.path.dirname(model_prefix) or ".", exist_ok=True)
+    feeds = [v.name for v in program.global_block().vars.values()
+             if getattr(v, "is_data", False)]
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in fetches]
+    with open(model_prefix + ".pdmodel", "w") as f:
+        json.dump({"program": _program_to_json(program), "feeds": feeds,
+                   "fetches": fetch_names}, f, indent=1)
+    with open(model_prefix + ".pdparams", "wb") as f:
+        np.savez(f, **_persistable_values(program, scope))
+
+
+def load(model_prefix: str, executor: Executor = None,
+         scope: Optional[Scope] = None
+         ) -> Tuple[Program, List[str], List[str]]:
+    """Load a program + state saved by ``save`` (ref fluid/io.py load
+    :1730).  Returns (program, feed_names, fetch_names)."""
+    scope = scope or global_scope()
+    with open(model_prefix + ".pdmodel") as f:
+        d = json.load(f)
+    program = _program_from_json(d["program"])
+    data = np.load(model_prefix + ".pdparams")
+    for name in data.files:
+        scope.set(name, data[name])
+    return program, d["feeds"], d.get("fetches", [])

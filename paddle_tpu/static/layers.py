@@ -1025,3 +1025,124 @@ def dynamic_lstm(input, size, sequence_length=None, h0=None, c0=None,
         rnn.step_output(c_new)
     h_tm, c_tm = rnn()
     return transpose(h_tm, [1, 0, 2]), transpose(c_tm, [1, 0, 2])
+
+
+def sequence_pool(input, pool_type, sequence_length, pad_value=0.0) -> Variable:
+    """Pool over each padded sequence's valid steps (ref fluid/layers
+    sequence_pool over LoD -> sequence_ops/sequence_pool_op)."""
+    out = _out(input.dtype, (input.shape[0],) + tuple(input.shape[2:]))
+    _append("sequence_pool_padded",
+            {"X": [input.name], "Lengths": [sequence_length.name]},
+            {"Out": [out.name]},
+            {"pooltype": pool_type, "pad_value": float(pad_value)})
+    return out
+
+
+def sequence_first_step(input, sequence_length) -> Variable:
+    """ref fluid/layers sequence_first_step."""
+    out = _out(input.dtype, (input.shape[0],) + tuple(input.shape[2:]))
+    _append("sequence_first_step_padded",
+            {"X": [input.name], "Lengths": [sequence_length.name]},
+            {"Out": [out.name]}, {})
+    return out
+
+
+def sequence_softmax(input, sequence_length) -> Variable:
+    """ref fluid/layers sequence_softmax (softmax within each sequence)."""
+    out = _out(input.dtype, input.shape)
+    _append("sequence_softmax_padded",
+            {"X": [input.name], "Lengths": [sequence_length.name]},
+            {"Out": [out.name]}, {})
+    return out
+
+
+def sequence_reverse(input, sequence_length) -> Variable:
+    """ref fluid/layers sequence_reverse (valid prefix reversed in place)."""
+    out = _out(input.dtype, input.shape)
+    _append("sequence_reverse_padded",
+            {"X": [input.name], "Lengths": [sequence_length.name]},
+            {"Y": [out.name]}, {})
+    return out
+
+
+def dynamic_gru(input, size, sequence_length=None, h0=None, param_attr=None,
+                bias_attr=None, is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", name=None) -> Variable:
+    """GRU over a padded (batch, seq, 3H) pre-projected input (ref
+    fluid/layers/nn.py dynamic_gru -> gru_op.cc).
+
+    Like dynamic_lstm, callers pre-project the input with an fc of size 3H;
+    this layer owns the recurrent weight (H, 3H) and bias (3H).  Gate chunk
+    order (r, z, c) with the reset gate applied AFTER the hidden matmul,
+    matching nn.layer.rnn.GRUCell's weight-layout parity contract.
+    ``is_reverse`` runs the recurrence right-to-left over the valid prefix
+    (the reference attribute), implemented by sequence_reverse on both ends.
+    Returns hidden (batch, seq, H).
+    """
+    from .control_flow import StaticRNN
+
+    if size % 3:
+        raise ValueError(f"dynamic_gru size must be 3*hidden, got {size}")
+    H = size // 3
+    b, s = int(input.shape[0]), int(input.shape[1])
+    if s < 0:
+        raise ValueError(
+            "dynamic_gru requires a static (padded) sequence length in "
+            "input.shape[1]; pad sequences and pass sequence_length")
+    acts = {"sigmoid": sigmoid, "tanh": tanh, "relu": relu,
+            "identity": lambda v: v}
+    try:
+        gate_act = acts[gate_activation]
+        cand_act = acts[candidate_activation]
+    except KeyError as e:
+        raise ValueError(f"dynamic_gru: unsupported activation {e}; "
+                         f"one of {sorted(acts)}") from None
+
+    if is_reverse:
+        if sequence_length is None:
+            raise ValueError("dynamic_gru(is_reverse=True) needs "
+                             "sequence_length to locate each valid prefix")
+        input = sequence_reverse(input, sequence_length)
+
+    w = create_parameter((H, 3 * H), input.dtype, attr=param_attr,
+                         name=f"{name}.w" if name else None)
+    bias = create_parameter((3 * H,), input.dtype, attr=bias_attr,
+                            default_initializer=I.Constant(0.0),
+                            name=f"{name}.b" if name else None)
+    if h0 is None:
+        h0 = fill_constant_batch_size_like(input, (b, H), input.dtype, 0.0)
+
+    x_tm = transpose(input, [1, 0, 2])                     # (s, b, 3H)
+    if sequence_length is not None:
+        mask = sequence_mask(sequence_length, s, dtype=input.dtype)
+        mask_tm = unsqueeze(transpose(mask, [1, 0]), [2])  # (s, b, 1)
+
+    w_rz, w_c = split(w, [2 * H, H], dim=1)
+    b_rz, b_c = split(bias, [2 * H, H], dim=0)
+
+    rnn = StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x_tm)                          # (b, 3H)
+        mt = rnn.step_input(mask_tm) if sequence_length is not None else None
+        h_prev = rnn.memory(init=h0)
+        x_rz, x_c = split(xt, [2 * H, H], dim=1)
+        rz = gate_act(elementwise_add(
+            elementwise_add(x_rz, matmul(h_prev, w_rz)), b_rz))
+        r, z = split(rz, 2, dim=1)
+        c = cand_act(elementwise_add(
+            elementwise_add(x_c, elementwise_mul(r, matmul(h_prev, w_c))),
+            b_c))
+        one = fill_constant_batch_size_like(xt, (b, 1), input.dtype, 1.0)
+        h_new = elementwise_add(elementwise_mul(z, h_prev),
+                                elementwise_mul(elementwise_sub(one, z), c))
+        if mt is not None:
+            inv = elementwise_sub(one, mt)
+            h_new = elementwise_add(elementwise_mul(h_new, mt),
+                                    elementwise_mul(h_prev, inv))
+        rnn.update_memory(h_prev, h_new)
+        rnn.step_output(h_new)
+    h_tm = rnn()
+    hidden = transpose(h_tm, [1, 0, 2])
+    if is_reverse:
+        hidden = sequence_reverse(hidden, sequence_length)
+    return hidden

@@ -776,3 +776,45 @@ def _sequence_last_step_padded(ins, attrs, op):
 
     return {"Out": [_seq.sequence_last_step(_one(ins, "X"),
                                             _one(ins, "Lengths"))]}
+
+
+@register_op("sequence_pool_padded")
+def _sequence_pool_padded(ins, attrs, op):
+    """Padded-layout sequence_pool (ref sequence_ops/sequence_pool_op:
+    sum/average/max/min/sqrt/first/last over each sequence's valid steps)."""
+    from ..ops import sequence as _seq
+
+    pool = attrs.get("pooltype", "sum").lower()
+    pool = {"average": "mean"}.get(pool, pool)  # fluid name for mean
+    out = _seq.sequence_pool(_one(ins, "X"), _one(ins, "Lengths"),
+                             pool_type=pool,
+                             pad_value=float(attrs.get("pad_value", 0.0)))
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax_padded")
+def _sequence_softmax_padded(ins, attrs, op):
+    """Padded-layout sequence_softmax (ref sequence_softmax_op): softmax over
+    each sequence's valid positions, zeros on padding."""
+    from ..ops import sequence as _seq
+
+    return {"Out": [_seq.sequence_softmax(_one(ins, "X"),
+                                          _one(ins, "Lengths"))]}
+
+
+@register_op("sequence_reverse_padded")
+def _sequence_reverse_padded(ins, attrs, op):
+    """Padded-layout sequence_reverse (ref sequence_reverse_op): reverses
+    the valid prefix of each row, padding stays in place."""
+    from ..ops import sequence as _seq
+
+    return {"Y": [_seq.sequence_reverse(_one(ins, "X"),
+                                        _one(ins, "Lengths"))]}
+
+
+@register_op("sequence_first_step_padded")
+def _sequence_first_step_padded(ins, attrs, op):
+    from ..ops import sequence as _seq
+
+    return {"Out": [_seq.sequence_first_step(_one(ins, "X"),
+                                             _one(ins, "Lengths"))]}

@@ -199,3 +199,139 @@ def test_short_rows_padded(tmp_path):
     (batch,) = list(feed)
     np.testing.assert_allclose(batch["x"][0], [1.0, 2.0, 0.0, 0.0])
     assert batch["label"][0, 0] == 7
+
+
+# -- crypto (native/src/crypto.cc; ref framework/io/crypto/) -----------------
+
+class TestCrypto:
+    def test_aes256_fips197_kat(self):
+        """FIPS-197 appendix C.3 single-block vector."""
+        import binascii, ctypes
+        from paddle_tpu.core import native
+        lib = native.get_lib()
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        key = binascii.unhexlify(
+            "000102030405060708090a0b0c0d0e0f"
+            "101112131415161718191a1b1c1d1e1f")
+        pt = binascii.unhexlify("00112233445566778899aabbccddeeff")
+        out = (ctypes.c_uint8 * 16)()
+        assert lib.pd_aes_encrypt_block(key, 32, pt, out) == 0
+        assert bytes(out).hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+    def test_aes256_ctr_sp80038a_kat(self):
+        """SP 800-38A F.5.5 CTR-AES256 first block."""
+        import binascii
+        from paddle_tpu.utils.crypto import Cipher
+        key = binascii.unhexlify(
+            "603deb1015ca71be2b73aef0857d7781"
+            "1f352c073b6108d72d9810a30914dff4")
+        iv = binascii.unhexlify("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+        pt = binascii.unhexlify("6bc1bee22e409f96e93d7e117393172a")
+        blob = Cipher(key).encrypt(pt, iv=iv)
+        ct = blob[-len(pt):]
+        assert ct.hex() == "601ec313775789a5b7a7f504bbf3d228"
+
+    def test_roundtrip_and_file(self, tmp_path):
+        from paddle_tpu.utils.crypto import Cipher, generate_key
+        key = generate_key(32)
+        c = Cipher(key)
+        msg = b"model bytes \x00\x01" * 1000 + b"tail"
+        assert c.decrypt(c.encrypt(msg)) == msg
+        p = str(tmp_path / "m.enc")
+        c.encrypt_to_file(msg, p)
+        assert Cipher(key).decrypt_from_file(p) == msg
+        # wrong key yields garbage, not the plaintext
+        assert Cipher(generate_key(32)).decrypt_from_file(p) != msg
+        with pytest.raises(ValueError):
+            Cipher(b"short")
+        with pytest.raises(ValueError):
+            c.decrypt(b"NOTMAGIC" + b"x" * 40)
+
+
+# -- fs (paddle_tpu/utils/fs.py; ref fleet/utils/fs.py) ----------------------
+
+class TestFS:
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.utils.fs import LocalFS
+        fs = LocalFS()
+        d = tmp_path / "a" / "b"
+        fs.mkdirs(str(d))
+        assert fs.is_dir(str(d))
+        f = d / "x.txt"
+        fs.touch(str(f))
+        assert fs.is_file(str(f)) and fs.is_exist(str(f))
+        dirs, files = fs.ls_dir(str(d))
+        assert files == ["x.txt"]
+        fs.rename(str(f), str(d / "y.txt"))
+        assert fs.is_file(str(d / "y.txt"))
+        fs.delete(str(d))
+        assert not fs.is_exist(str(d))
+
+    def test_hdfs_client_command_plumbing(self, tmp_path):
+        """Drive HDFSClient against a stub `hadoop` executable that logs its
+        argv and emulates -test/-ls, validating the full command builder +
+        retry path without a cluster (the reference's design is exactly this
+        CLI contract)."""
+        import stat
+        from paddle_tpu.utils.fs import ExecuteError, HDFSClient
+        stub = tmp_path / "hadoop"
+        log = tmp_path / "log"
+        stub.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+while [ "$1" != "fs" ] && [ $# -gt 0 ]; do shift; done
+shift   # drop "fs"
+while [ "$1" = "-D" ]; do shift 2; done   # skip generic options
+case "$1" in
+  -test) [ "$3" = "hdfs:/exists" ] && exit 0 || exit 1 ;;
+  -ls) echo "drwxr-xr-x - u g 0 2026-01-01 00:00 hdfs:/p/sub";
+       echo "-rw-r--r-- 1 u g 9 2026-01-01 00:00 hdfs:/p/file.txt"; exit 0 ;;
+  -fail) exit 1 ;;
+esac
+exit 0
+""")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        fs = HDFSClient(hadoop_bin=str(stub), configs={"fs.default.name":
+                                                       "hdfs://ns"},
+                        sleep_inter=1, retries=2)
+        assert fs.is_exist("hdfs:/exists")
+        assert not fs.is_exist("hdfs:/missing")
+        dirs, files = fs.ls_dir("hdfs:/p")
+        assert dirs == ["sub"] and files == ["file.txt"]
+        fs.mkdirs("hdfs:/new")
+        fs.upload(__file__, "hdfs:/new/t.py")
+        argv = log.read_text()
+        # FsShell ordering: generic -D options AFTER the fs subcommand
+        assert "fs -D fs.default.name=hdfs://ns -mkdir -p hdfs:/new" in argv
+        assert "-D fs.default.name=hdfs://ns -put -f" in argv
+        with pytest.raises(ExecuteError):
+            fs._run("-fail", "x")
+
+    def test_encrypted_inference_model_roundtrip(self, tmp_path):
+        """save/load_inference_model with a Cipher (ref encrypted inference
+        models, framework/io/crypto/)."""
+        import numpy as np
+        import paddle_tpu.static as static
+        from paddle_tpu.static import layers as L
+        from paddle_tpu.utils.crypto import Cipher, generate_key
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = L.data("x", [4])
+            y = L.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        key = generate_key()
+        d = str(tmp_path / "enc_model")
+        static.save_inference_model(d, ["x"], [y], exe, main_program=main,
+                                    cipher=Cipher(key))
+        import os
+        assert os.path.exists(d + "/params.npz.enc")
+        with pytest.raises(ValueError):
+            static.load_inference_model(d, exe)   # encrypted, no cipher
+        prog, feeds, fetches = static.load_inference_model(
+            d, exe, cipher=Cipher(key))
+        probe = np.random.rand(3, 4).astype("float32")
+        out, = exe.run(prog, feed={"x": probe}, fetch_list=fetches)
+        ref, = exe.run(main, feed={"x": probe}, fetch_list=[y])
+        np.testing.assert_allclose(out, ref, rtol=1e-6)

@@ -1,0 +1,128 @@
+"""Padded sequence DSL: dynamic_lstm / dynamic_gru / sequence_* layers
+(ref fluid/layers/nn.py dynamic_lstm/dynamic_gru/sequence_pool/... over LoD;
+padded layout per SURVEY §7).  dynamic_gru is oracle-checked against the
+eager nn.GRUCell (same weight layout and gate formulas)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+B, S, H = 4, 6, 8
+
+
+@pytest.fixture()
+def _progs():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        yield main, startup
+
+
+def _feed(din, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (B, S, din)).astype("float32")
+    lens = np.array([S, 3, 4, 1], np.int64)
+    return x, lens
+
+
+def test_dynamic_gru_matches_grucell_oracle(_progs):
+    main, startup = _progs
+    x_np, lens = _feed(3 * H, seed=5)
+    x = L.data("x", [S, 3 * H])
+    xl = L.data("xl", [], "int64")
+    h = L.dynamic_gru(x, 3 * H, sequence_length=xl, name="gru")
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": x_np, "xl": lens}, fetch_list=[h])
+
+    # oracle: eager GRUCell with the SAME recurrent weight/bias; the static
+    # layer consumes a pre-projected input, so weight_ih := identity
+    scope = static.global_scope()
+    w = np.asarray(scope.find_var("gru.w"))      # (H, 3H)
+    b = np.asarray(scope.find_var("gru.b"))      # (3H,)
+    cell = nn.GRUCell(3 * H, H)
+    cell.weight_ih.value = jnp.eye(3 * H)        # (3H, 3H): pass-through
+    cell.weight_hh.value = jnp.asarray(w.T)      # (3H, H)
+    cell.bias_ih.value = jnp.asarray(b)
+    cell.bias_hh.value = jnp.zeros((3 * H,))
+    hh = jnp.zeros((B, H))
+    ref = np.zeros((B, S, H), np.float32)
+    for t in range(S):
+        h_new, hh_new = cell(jnp.asarray(x_np[:, t]), hh)
+        mask = (t < lens)[:, None]
+        hh = jnp.where(mask, hh_new, hh)
+        ref[:, t] = np.asarray(hh)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_dynamic_gru_reverse_runs_backwards(_progs):
+    main, startup = _progs
+    x_np, lens = _feed(3 * H, seed=6)
+    x = L.data("x", [S, 3 * H])
+    xl = L.data("xl", [], "int64")
+    h_fwd = L.dynamic_gru(x, 3 * H, sequence_length=xl, name="g")
+    h_rev = L.dynamic_gru(x, 3 * H, sequence_length=xl, is_reverse=True,
+                          name="g")  # shared weights
+    exe = static.Executor()
+    exe.run(startup)
+    f, r = exe.run(main, feed={"x": x_np, "xl": lens},
+                   fetch_list=[h_fwd, h_rev])
+    # a length-1 sequence is direction-invariant
+    np.testing.assert_allclose(f[3, 0], r[3, 0], rtol=1e-5)
+    # reverse differs from forward on longer rows
+    assert not np.allclose(f[0], r[0])
+
+
+def test_sequence_pool_variants_and_softmax(_progs):
+    main, startup = _progs
+    x_np, lens = _feed(H, seed=7)
+    x = L.data("x", [S, H])
+    xl = L.data("xl", [], "int64")
+    outs = [L.sequence_pool(x, p, xl)
+            for p in ("sum", "average", "max", "sqrt")]
+    first = L.sequence_first_step(x, xl)
+    rev = L.sequence_reverse(x, xl)
+    scores = L.fc(x, 1, num_flatten_dims=2)
+    sm = L.sequence_softmax(scores, xl)
+    exe = static.Executor()
+    exe.run(startup)
+    res = exe.run(main, feed={"x": x_np, "xl": lens},
+                  fetch_list=outs + [first, rev, sm])
+    s_, avg, mx, sq, fst, rv, smx = res
+    row = 1  # length 3
+    valid = x_np[row, :3]
+    np.testing.assert_allclose(s_[row], valid.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(avg[row], valid.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(mx[row], valid.max(0), rtol=1e-5)
+    np.testing.assert_allclose(sq[row], valid.sum(0) / np.sqrt(3), rtol=1e-5)
+    np.testing.assert_allclose(fst[row], x_np[row, 0], rtol=1e-5)
+    np.testing.assert_allclose(rv[row, :3], valid[::-1], rtol=1e-5)
+    np.testing.assert_allclose(rv[row, 3:], x_np[row, 3:], rtol=1e-5)
+    assert np.allclose(smx[row, 3:], 0) and np.isclose(smx[row, :3].sum(), 1)
+
+
+def test_dynamic_lstm_trains_through_backward(_progs):
+    """append_backward through the scan: gradients reach the recurrent
+    weight and the loss drops under SGD."""
+    main, startup = _progs
+    x_np, lens = _feed(8, seed=8)
+    tgt = np.random.default_rng(9).normal(0, 1, (B, H)).astype("float32")
+    x = L.data("x", [S, 8])
+    xl = L.data("xl", [], "int64")
+    y = L.data("y", [H])
+    proj = L.fc(x, 4 * H, num_flatten_dims=2)
+    h, _ = L.dynamic_lstm(proj, 4 * H, sequence_length=xl)
+    last = L.sequence_last_step(h, xl)
+    loss = L.mean(L.square_error_cost(last, y))
+    static.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(40):
+        lv, = exe.run(main, feed={"x": x_np, "xl": lens, "y": tgt},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
