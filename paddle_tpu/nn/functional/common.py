@@ -40,14 +40,17 @@ def dropout2d(x, p=0.5, training=True):
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
-    """ref: pad/pad2d/pad3d ops. ``pad`` is [l, r] per trailing dim (paddle
-    order: last dim first) or a full per-dim spec."""
+    """ref: paddle.nn.functional.pad (common.py:1127) / pad2d/pad3d ops.
+
+    Partial specs follow paddle's LAST-DIM-FIRST pair order: 4-D NCHW input
+    with pad=(l, r, t, b) pads W by (l, r) and H by (t, b); a full
+    2*ndim spec is per-dim in dim order."""
     if len(pad) == 2 * x.ndim:
         cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
     else:
         n_spatial = len(pad) // 2
-        cfg = [(0, 0)] * (x.ndim - n_spatial) + [
-            (pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        cfg = [(0, 0)] * (x.ndim - n_spatial) + pairs[::-1]
         if data_format.endswith("C"):  # channels-last: spatial dims before C
             cfg = ([(0, 0)] + cfg[2:] + [(0, 0)])[: x.ndim]
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
@@ -69,8 +72,15 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         size = (int(h * sf[0]), int(w * sf[1]))
     oh, ow = size
     if mode == "nearest":
-        ridx = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
-        cidx = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        if align_corners and oh > 1 and ow > 1:
+            # corner-aligned grid (ref interpolate_v2 nearest w/ align_corners)
+            ridx = jnp.round(jnp.arange(oh) * (h - 1) / (oh - 1)).astype(
+                jnp.int32)
+            cidx = jnp.round(jnp.arange(ow) * (w - 1) / (ow - 1)).astype(
+                jnp.int32)
+        else:
+            ridx = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+            cidx = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
         out = x[:, :, ridx][:, :, :, cidx]
     elif mode in ("bilinear", "linear"):
         if align_corners and oh > 1 and ow > 1:

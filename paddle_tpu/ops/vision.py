@@ -154,6 +154,24 @@ def anchor_generator(feature_hw: Tuple[int, int],
     return anchors, var
 
 
+def expand_aspect_ratios(aspect_ratios, flip: bool):
+    """ref prior_box_op.cc ExpandAspectRatios: 1.0 always first, near-
+    duplicates (within 1e-6) dropped, flip appends reciprocals (also
+    deduped).  Shared by the eager kernel and the static DSL's prior-count
+    shape inference so the two can never drift."""
+    out = [1.0]
+
+    def _add(v):
+        if all(abs(v - e) > 1e-6 for e in out):
+            out.append(v)
+
+    for a in aspect_ratios:
+        _add(float(a))
+        if flip:
+            _add(1.0 / float(a))
+    return out
+
+
 def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
               min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
               aspect_ratios: Sequence[float] = (1.0,), flip: bool = False,
@@ -168,11 +186,7 @@ def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
     img_h, img_w = image_hw
     step_w = steps[0] or img_w / W
     step_h = steps[1] or img_h / H
-    # ref prior_box_op ExpandAspectRatios: ratio 1.0 is always present, and
-    # flip adds reciprocals
-    ratios = [1.0] + [a for a in aspect_ratios if abs(a - 1.0) > 1e-6]
-    if flip:
-        ratios += [1.0 / a for a in aspect_ratios if abs(a - 1.0) > 1e-6]
+    ratios = expand_aspect_ratios(aspect_ratios, flip)
     if max_sizes and len(max_sizes) != len(min_sizes):
         raise ValueError("max_sizes must pair 1:1 with min_sizes "
                          f"(got {len(max_sizes)} vs {len(min_sizes)})")

@@ -50,6 +50,7 @@ from .io import (
     save_inference_model,
     save_persistables,
 )
+from . import nets
 from .registry import register_op, registered_ops
 
 data = layers.data

@@ -325,8 +325,8 @@ def _elementwise(op_type, x, y, axis=-1) -> Variable:
     return out
 
 
-def elementwise_add(x, y, axis=-1):
-    return _elementwise("elementwise_add", x, y, axis)
+def elementwise_add(x, y, axis=-1, act=None):
+    return _apply_act(_elementwise("elementwise_add", x, y, axis), act)
 
 
 def elementwise_sub(x, y, axis=-1):
@@ -1146,3 +1146,182 @@ def dynamic_gru(input, size, sequence_length=None, h0=None, param_attr=None,
     if is_reverse:
         hidden = sequence_reverse(hidden, sequence_length)
     return hidden
+
+
+# -- conv-transpose / norm / vision long tail --------------------------------
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py conv2d_transpose (NCHW; weight layout
+    (in_c, out_c/groups, kh, kw) like conv_transpose_op.cc)."""
+    ks, st = _pair(filter_size), _pair(stride)
+    pd, dl, op_ = _pair(padding), _pair(dilation), _pair(output_padding)
+    cin = input.shape[1]
+    w = create_parameter((cin, num_filters // groups, ks[0], ks[1]),
+                         input.dtype, attr=param_attr,
+                         name=f"{name}.w" if name else None)
+
+    def _tout(sz, k, s, p, d, o):
+        if sz < 0:
+            return -1
+        return (sz - 1) * s - 2 * p + (k - 1) * d + 1 + o
+
+    h = _tout(input.shape[2], ks[0], st[0], pd[0], dl[0], op_[0])
+    wd = _tout(input.shape[3], ks[1], st[1], pd[1], dl[1], op_[1])
+    out = _out(input.dtype, (input.shape[0], num_filters, h, wd))
+    inputs = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), input.dtype, attr=bias_attr,
+                             default_initializer=I.Constant(0.0),
+                             name=f"{name}.b" if name else None)
+        inputs["Bias"] = [b.name]
+    _append("conv2d_transpose", inputs, {"Output": [out.name]},
+            {"strides": stride, "paddings": padding, "dilations": dilation,
+             "output_padding": output_padding, "groups": groups})
+    return _apply_act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py group_norm -> group_norm_op.cc (NCHW)."""
+    C = input.shape[1]
+    scale = create_parameter((C,), input.dtype, attr=param_attr,
+                             default_initializer=I.Constant(1.0),
+                             name=f"{name}.w" if name else None)
+    bias = create_parameter((C,), input.dtype, attr=bias_attr,
+                            default_initializer=I.Constant(0.0),
+                            name=f"{name}.b" if name else None)
+    out = _out(input.dtype, input.shape)
+    _append("group_norm", {"X": [input.name], "Scale": [scale.name],
+                           "Bias": [bias.name]}, {"Y": [out.name]},
+            {"groups": int(groups), "epsilon": float(epsilon)})
+    return _apply_act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None) -> Variable:
+    """ref fluid/layers/nn.py instance_norm -> instance_norm_op.cc."""
+    C = input.shape[1]
+    scale = create_parameter((C,), input.dtype, attr=param_attr,
+                             default_initializer=I.Constant(1.0),
+                             name=f"{name}.w" if name else None)
+    bias = create_parameter((C,), input.dtype, attr=bias_attr,
+                            default_initializer=I.Constant(0.0),
+                            name=f"{name}.b" if name else None)
+    out = _out(input.dtype, input.shape)
+    _append("instance_norm", {"X": [input.name], "Scale": [scale.name],
+                              "Bias": [bias.name]}, {"Y": [out.name]},
+            {"epsilon": float(epsilon)})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py prelu (mode: all|channel)."""
+    if mode == "all":
+        alpha_shape = (1,)
+    elif mode == "channel":
+        alpha_shape = (x.shape[1],)
+    else:
+        raise ValueError("prelu mode must be 'all' or 'channel' "
+                         "(per-'element' alpha is descoped)")
+    alpha = create_parameter(alpha_shape, x.dtype, attr=param_attr,
+                             default_initializer=I.Constant(0.25),
+                             name=f"{name}.alpha" if name else None)
+    out = _out(x.dtype, x.shape)
+    _append("prelu", {"X": [x.name], "Alpha": [alpha.name]},
+            {"Out": [out.name]}, {"mode": mode})
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          name=None) -> Variable:
+    """ref fluid/layers/nn.py pad2d (NCHW, [top, bottom, left, right])."""
+    t, b, l, r = paddings
+    shape = list(input.shape)
+    if shape[2] >= 0:
+        shape[2] += t + b
+    if shape[3] >= 0:
+        shape[3] += l + r
+    out = _out(input.dtype, tuple(shape))
+    _append("pad2d", {"X": [input.name]}, {"Out": [out.name]},
+            {"paddings": list(paddings), "mode": mode,
+             "pad_value": float(pad_value)})
+    return out
+
+
+def _resize(input, out_shape, method, align_corners):
+    out = _out(input.dtype,
+               (input.shape[0], input.shape[1]) + tuple(out_shape))
+    _append("resize_interp", {"X": [input.name]}, {"Out": [out.name]},
+            {"out_shape": list(out_shape), "interp_method": method,
+             "align_corners": bool(align_corners)})
+    return out
+
+
+def resize_bilinear(input, out_shape, align_corners=True, name=None):
+    """ref fluid/layers/nn.py resize_bilinear -> bilinear_interp_op
+    (fluid defaults align_corners=True)."""
+    return _resize(input, out_shape, "bilinear", align_corners)
+
+
+def resize_nearest(input, out_shape, align_corners=True, name=None):
+    """ref fluid/layers/nn.py resize_nearest -> nearest_interp_op
+    (fluid defaults align_corners=True)."""
+    return _resize(input, out_shape, "nearest", align_corners)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """ref fluid/layers/detection.py prior_box -> prior_box_op.cc.
+    Returns (boxes, variances), each (H, W, num_priors, 4)."""
+    from ..ops.vision import expand_aspect_ratios
+
+    # shared with the eager kernel so count inference can never drift
+    n_ratio = len(expand_aspect_ratios(aspect_ratios, flip))
+    num = len(min_sizes) * n_ratio + len(max_sizes or [])
+    H, W = input.shape[2], input.shape[3]
+    boxes = _out(input.dtype, (H, W, num, 4))
+    variances = _out(input.dtype, (H, W, num, 4))
+    _append("prior_box", {"Input": [input.name], "Image": [image.name]},
+            {"Boxes": [boxes.name], "Variances": [variances.name]},
+            {"min_sizes": list(min_sizes),
+             "max_sizes": list(max_sizes or []),
+             "aspect_ratios": list(aspect_ratios),
+             "variances": list(variance), "flip": flip, "clip": clip,
+             "steps": list(steps), "offset": offset})
+    return boxes, variances
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type,
+              box_normalized=True, axis=0, name=None) -> Variable:
+    """ref fluid/layers/detection.py box_coder -> box_coder_op.cc.
+    encode_center_size: target (N, 4) x priors (M, 4) -> (N, M, 4);
+    decode_center_size keeps the target's shape."""
+    if str(code_type).startswith("encode"):
+        out_shape = (target_box.shape[0], prior_box.shape[0], 4)
+    else:
+        out_shape = target_box.shape
+    out = _out(target_box.dtype, out_shape)
+    inputs = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var.name]
+    _append("box_coder", inputs, {"OutputBox": [out.name]},
+            {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None) -> Variable:
+    """ref fluid/layers/detection.py roi_align -> roi_align_op.cc
+    (batch-1 static-shape policy; see the lowering's docstring)."""
+    C = input.shape[1]
+    out = _out(input.dtype, (rois.shape[0], C, pooled_height, pooled_width))
+    _append("roi_align", {"X": [input.name], "ROIs": [rois.name]},
+            {"Out": [out.name]},
+            {"pooled_height": pooled_height, "pooled_width": pooled_width,
+             "spatial_scale": spatial_scale,
+             "sampling_ratio": sampling_ratio})
+    return out

@@ -640,10 +640,13 @@ def _fill_zeros_like(ins, attrs, op):
 
 @register_op("pad2d")
 def _pad2d(ins, attrs, op):
-    x = _one(ins, "X")
-    p = attrs["paddings"]  # [top, bottom, left, right], NCHW
-    return {"Out": [jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])),
-                            constant_values=attrs.get("pad_value", 0.0))]}
+    """ref pad2d_op: NCHW [top, bottom, left, right]; constant/reflect/edge
+    modes via the eager F.pad kernel."""
+    p = attrs["paddings"]
+    return {"Out": [F.pad(_one(ins, "X"), [p[2], p[3], p[0], p[1]],
+                          mode=attrs.get("mode", "constant"),
+                          value=attrs.get("pad_value", 0.0),
+                          data_format="NCHW")]}
 
 
 @register_op("pad")
@@ -818,3 +821,96 @@ def _sequence_first_step_padded(ins, attrs, op):
 
     return {"Out": [_seq.sequence_first_step(_one(ins, "X"),
                                              _one(ins, "Lengths"))]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ins, attrs, op):
+    out = F.conv2d_transpose(_one(ins, "Input"), _one(ins, "Filter"),
+                             bias=_one(ins, "Bias"),
+                             stride=attrs.get("strides", 1),
+                             padding=attrs.get("paddings", 0),
+                             output_padding=attrs.get("output_padding", 0),
+                             dilation=attrs.get("dilations", 1),
+                             groups=attrs.get("groups", 1))
+    return {"Output": [out]}
+
+
+@register_op("group_norm")
+def _group_norm(ins, attrs, op):
+    out = F.group_norm(_one(ins, "X"), attrs["groups"],
+                       weight=_one(ins, "Scale"), bias=_one(ins, "Bias"),
+                       epsilon=attrs.get("epsilon", 1e-5))
+    return {"Y": [out]}
+
+
+@register_op("instance_norm")
+def _instance_norm(ins, attrs, op):
+    out = F.instance_norm(_one(ins, "X"), weight=_one(ins, "Scale"),
+                          bias=_one(ins, "Bias"),
+                          epsilon=attrs.get("epsilon", 1e-5))
+    return {"Y": [out]}
+
+
+@register_op("prelu")
+def _prelu(ins, attrs, op):
+    return {"Out": [F.prelu(_one(ins, "X"), _one(ins, "Alpha"))]}
+
+
+@register_op("resize_interp")
+def _resize_interp(ins, attrs, op):
+    """Shared lowering for resize_bilinear / resize_nearest (ref
+    interpolate_op family)."""
+    out = F.interpolate(_one(ins, "X"), size=tuple(attrs["out_shape"]),
+                        mode=attrs["interp_method"],
+                        align_corners=attrs.get("align_corners", False))
+    return {"Out": [out]}
+
+
+@register_op("prior_box")
+def _prior_box(ins, attrs, op):
+    from ..ops import vision as V
+
+    x = _one(ins, "Input")
+    img = _one(ins, "Image")
+    boxes, variances = V.prior_box(
+        (x.shape[2], x.shape[3]), (img.shape[2], img.shape[3]),
+        min_sizes=list(attrs["min_sizes"]),
+        max_sizes=list(attrs.get("max_sizes", [])),
+        aspect_ratios=list(attrs.get("aspect_ratios", [1.0])),
+        variances=list(attrs.get("variances", [0.1, 0.1, 0.2, 0.2])),
+        flip=attrs.get("flip", False), clip=attrs.get("clip", False),
+        steps=attrs.get("steps", (0.0, 0.0)),
+        offset=attrs.get("offset", 0.5))
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@register_op("box_coder")
+def _box_coder(ins, attrs, op):
+    from ..ops import vision as V
+
+    out = V.box_coder(_one(ins, "PriorBox"), _one(ins, "PriorBoxVar"),
+                      _one(ins, "TargetBox"), attrs["code_type"],
+                      box_normalized=attrs.get("box_normalized", True),
+                      axis=attrs.get("axis", 0))
+    return {"OutputBox": [out]}
+
+
+@register_op("roi_align")
+def _roi_align(ins, attrs, op):
+    """Batch-1 RoIAlign (the eager kernel's static-shape contract; the
+    reference's LoD multi-image batching is descoped to per-image calls)."""
+    from ..ops import vision as V
+
+    x = _one(ins, "X")
+    if x.ndim == 4:
+        if x.shape[0] != 1:
+            raise ValueError(
+                "static roi_align lowers the batch-1 eager kernel; split "
+                f"the batch into per-image calls (got N={x.shape[0]})")
+        x = x[0]
+    out = V.roi_align(x, _one(ins, "ROIs"),
+                      output_size=(attrs["pooled_height"],
+                                   attrs["pooled_width"]),
+                      spatial_scale=attrs.get("spatial_scale", 1.0),
+                      sampling_ratio=attrs.get("sampling_ratio", -1))
+    return {"Out": [out]}

@@ -1,0 +1,211 @@
+"""Book regressions: image_classification, understand_sentiment,
+recommender_system (ref fluid/tests/book/test_image_classification.py,
+notest_understand_sentiment.py, test_recommender_system.py) — the static
+model topologies verbatim-modulo-datasets (tiny synthetic data, shrunk
+widths for suite speed; LoD text becomes padded ids + lengths)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+from paddle_tpu.static import nets
+
+
+@pytest.fixture()
+def _progs():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        yield main, startup
+
+
+def _train(main, startup, feeder, loss, steps=12, lr=None):
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(steps):
+        lv, = exe.run(main, feed=feeder(i), fetch_list=[loss])
+        assert np.isfinite(float(lv)), f"NaN at step {i}"
+        losses.append(float(lv))
+    return losses
+
+
+# -- image_classification ---------------------------------------------------
+
+def _conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                   bias_attr=False):
+    tmp = L.conv2d(input, ch_out, filter_size, stride=stride, padding=padding,
+                   act=None, bias_attr=bias_attr)
+    return L.batch_norm(tmp, act=act)
+
+
+def _resnet_cifar10(input, depth=8):
+    """ref test_image_classification.py resnet_cifar10 (depth 32 -> 8)."""
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return _conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = _conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = _conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None, bias_attr=None)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return L.elementwise_add(tmp, short, act="relu")
+
+    def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+        tmp = block_func(input, ch_in, ch_out, stride)
+        for _ in range(1, count):
+            tmp = block_func(tmp, ch_out, ch_out, 1)
+        return tmp
+
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = _conv_bn_layer(input, 8, 3, 1, 1)
+    res1 = layer_warp(basicblock, conv1, 8, 8, n, 1)
+    res2 = layer_warp(basicblock, res1, 8, 16, n, 2)
+    res3 = layer_warp(basicblock, res2, 16, 32, n, 2)
+    return L.pool2d(res3, 4, pool_type="avg", pool_stride=1)
+
+
+def _vgg_lite(input):
+    """ref test_image_classification.py vgg16_bn_drop, shrunk widths."""
+
+    def conv_block(input, num_filter, groups, dropouts):
+        return nets.img_conv_group(
+            input, conv_num_filter=[num_filter] * groups, pool_size=2,
+            pool_stride=2, conv_filter_size=3, conv_act="relu",
+            conv_with_batchnorm=True, conv_batchnorm_drop_rate=dropouts)
+
+    conv1 = conv_block(input, 8, 2, [0.3, 0.0])
+    conv2 = conv_block(conv1, 16, 2, [0.4, 0.0])
+    drop = L.dropout(conv2, dropout_prob=0.5)
+    fc1 = L.fc(drop, 32, act=None)
+    bn = L.batch_norm(fc1, act="relu")
+    drop2 = L.dropout(bn, dropout_prob=0.5)
+    return L.fc(drop2, 32, act=None)
+
+
+def _cifar_batch(i, b=8):
+    rng = np.random.default_rng(100 + i)
+    x = rng.normal(0, 1, (b, 3, 16, 16)).astype("float32")
+    y = rng.integers(0, 10, (b, 1)).astype("int64")
+    return {"pixel": x, "label": y}
+
+
+@pytest.mark.parametrize("net", ["resnet", "vgg"])
+def test_image_classification_book(net, _progs):
+    main, startup = _progs
+    images = L.data("pixel", [3, 16, 16])
+    label = L.data("label", [1], dtype="int64")
+    body = _resnet_cifar10(images) if net == "resnet" else _vgg_lite(images)
+    predict = L.fc(body, 10, act="softmax")
+    cost = L.cross_entropy(predict, label)
+    avg_cost = L.mean(cost)
+    acc = L.accuracy(predict, label)
+    static.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    losses = _train(main, startup, _cifar_batch, avg_cost, steps=8)
+    assert all(np.isfinite(losses))
+
+
+# -- understand_sentiment ---------------------------------------------------
+
+DICT, EMB, HID, SLEN = 80, 16, 16, 10
+
+
+TRIGGER = 7
+
+
+def _sent_batch(i, b=8):
+    """Synthetic learnable sentiment: positive iff the TRIGGER token occurs
+    in the valid prefix (detectable by max pooling over embeddings)."""
+    rng = np.random.default_rng(200 + i)
+    ids = rng.integers(8, DICT, (b, SLEN)).astype("int64")
+    lens = rng.integers(4, SLEN + 1, (b,)).astype("int64")
+    pos = rng.random(b) < 0.5
+    for r, ln in enumerate(lens):
+        ids[r, ln:] = 0
+        if pos[r]:
+            ids[r, rng.integers(0, ln)] = TRIGGER
+    y = pos.astype("int64")[:, None]
+    return {"words": ids, "seq_len": lens, "label": y}
+
+
+def test_understand_sentiment_conv(_progs):
+    """ref notest_understand_sentiment.py convolution_net: embedding +
+    windowed conv + max pooling over time + fc softmax.  The LoD sequence_
+    conv becomes a 1-wide conv over the padded layout masked by length."""
+    main, startup = _progs
+    words = L.data("words", [SLEN], dtype="int64")
+    seq_len = L.data("seq_len", [], dtype="int64")
+    label = L.data("label", [1], dtype="int64")
+    emb = L.embedding(words, (DICT, EMB))
+    proj = L.fc(emb, HID, num_flatten_dims=2, act="tanh")
+    pooled = L.sequence_pool(proj, "max", seq_len)
+    predict = L.fc(pooled, 2, act="softmax")
+    avg_cost = L.mean(L.cross_entropy(predict, label))
+    static.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    losses = _train(main, startup, _sent_batch, avg_cost, steps=25)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_understand_sentiment_dynamic_lstm(_progs):
+    """ref notest_understand_sentiment.py stacked_lstm_net (depth 1):
+    fc -> dynamic_lstm -> max pools -> fc softmax."""
+    main, startup = _progs
+    words = L.data("words", [SLEN], dtype="int64")
+    seq_len = L.data("seq_len", [], dtype="int64")
+    label = L.data("label", [1], dtype="int64")
+    emb = L.embedding(words, (DICT, EMB))
+    fc1 = L.fc(emb, HID * 4, num_flatten_dims=2)
+    lstm_h, _ = L.dynamic_lstm(fc1, HID * 4, sequence_length=seq_len)
+    fc_pool = L.sequence_pool(fc1, "max", seq_len)
+    lstm_pool = L.sequence_pool(lstm_h, "max", seq_len)
+    predict = L.fc(L.concat([fc_pool, lstm_pool], axis=1), 2, act="softmax")
+    avg_cost = L.mean(L.cross_entropy(predict, label))
+    static.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    losses = _train(main, startup, _sent_batch, avg_cost, steps=25)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+# -- recommender_system -----------------------------------------------------
+
+N_USER, N_MOVIE, N_JOB, N_AGE = 30, 40, 5, 4
+
+
+def _rec_batch(i, b=16):
+    rng = np.random.default_rng(300 + i)
+    uid = rng.integers(0, N_USER, (b, 1)).astype("int64")
+    gender = rng.integers(0, 2, (b, 1)).astype("int64")
+    age = rng.integers(0, N_AGE, (b, 1)).astype("int64")
+    job = rng.integers(0, N_JOB, (b, 1)).astype("int64")
+    mid = rng.integers(0, N_MOVIE, (b, 1)).astype("int64")
+    score = ((uid % 5) + (mid % 3)).astype("float32") / 2.0 + 1.0
+    return {"user_id": uid, "gender_id": gender, "age_id": age,
+            "job_id": job, "movie_id": mid, "score": score}
+
+
+def test_recommender_system_book(_progs):
+    """ref test_recommender_system.py: per-feature embeddings -> fc fusion
+    towers -> cos_sim-style interaction (here fc over concat) -> square
+    error on the score; loss decreases on a learnable rating function."""
+    main, startup = _progs
+
+    def emb_fc(name, vocab):
+        idv = L.data(name, [1], dtype="int64")
+        e = L.embedding(idv, (vocab, 8))
+        return L.fc(L.flatten(e, axis=1), 16)
+
+    usr = emb_fc("user_id", N_USER)
+    gender = emb_fc("gender_id", 2)
+    age = emb_fc("age_id", N_AGE)
+    job = emb_fc("job_id", N_JOB)
+    usr_combined = L.fc(L.concat([usr, gender, age, job], axis=1), 32,
+                        act="tanh")
+    mov = emb_fc("movie_id", N_MOVIE)
+    mov_combined = L.fc(mov, 32, act="tanh")
+    inference = L.fc(L.concat([usr_combined, mov_combined], axis=1), 1)
+    score = L.data("score", [1])
+    avg_cost = L.mean(L.square_error_cost(inference, score))
+    static.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    losses = _train(main, startup, _rec_batch, avg_cost, steps=30)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
