@@ -1325,3 +1325,36 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
              "spatial_scale": spatial_scale,
              "sampling_ratio": sampling_ratio})
     return out
+
+
+def linear_chain_crf(input, label, length, param_attr=None,
+                     name=None) -> Variable:
+    """ref fluid/layers/nn.py linear_chain_crf -> linear_chain_crf_op.h.
+    Owns the (num_tags + 2, num_tags) transition parameter (start/stop
+    rows + pairwise); share it with crf_decoding via param_attr name.
+    Returns the per-sequence NLL (b, 1) (the reference's negated
+    log-likelihood output)."""
+    D = input.shape[-1]
+    # layer `name` must NOT rename the parameter (it would break the
+    # param_attr sharing contract with crf_decoding); fluid's name arg is a
+    # display name only
+    transition = create_parameter((D + 2, D), input.dtype, attr=param_attr)
+    out = _out(input.dtype, (input.shape[0], 1))
+    _append("linear_chain_crf",
+            {"Emission": [input.name], "Label": [label.name],
+             "Transition": [transition.name], "Length": [length.name]},
+            {"LogLikelihood": [out.name]}, {})
+    return out
+
+
+def crf_decoding(input, length, param_attr=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py crf_decoding -> crf_decoding_op.h (Viterbi);
+    pass the SAME param_attr name used for linear_chain_crf."""
+    D = input.shape[-1]
+    transition = create_parameter((D + 2, D), input.dtype, attr=param_attr)
+    out = _out("int32", input.shape[:-1])
+    _append("crf_decoding",
+            {"Emission": [input.name], "Transition": [transition.name],
+             "Length": [length.name]},
+            {"ViterbiPath": [out.name]}, {})
+    return out

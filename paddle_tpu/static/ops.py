@@ -914,3 +914,21 @@ def _roi_align(ins, attrs, op):
                       spatial_scale=attrs.get("spatial_scale", 1.0),
                       sampling_ratio=attrs.get("sampling_ratio", -1))
     return {"Out": [out]}
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ins, attrs, op):
+    from ..ops import crf as _crf
+
+    nll = _crf.linear_chain_crf(_one(ins, "Emission"), _one(ins, "Label"),
+                                _one(ins, "Transition"), _one(ins, "Length"))
+    return {"LogLikelihood": [nll]}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ins, attrs, op):
+    from ..ops import crf as _crf
+
+    path = _crf.crf_decoding(_one(ins, "Emission"), _one(ins, "Transition"),
+                             _one(ins, "Length"))
+    return {"ViterbiPath": [path]}

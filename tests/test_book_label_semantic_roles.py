@@ -1,0 +1,101 @@
+"""Book regression: label_semantic_roles (ref fluid/tests/book/
+test_label_semantic_roles.py): feature embeddings -> stacked bidirectional
+dynamic_lstm -> fc emission -> linear_chain_crf loss, crf_decoding for
+inference.  Padded layout; CRF NLL/Viterbi brute-force-validated in
+paddle_tpu/ops/crf.py's own construction (see tests below for a learnable
+tagging task)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+DICT, N_TAGS, EMB, HID, SLEN = 40, 5, 12, 12, 8
+
+
+@pytest.fixture()
+def _progs():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        yield main, startup
+
+
+def _srl_batch(i, b=8):
+    """Learnable tagging: tag = word % N_TAGS (a per-token function the
+    word embedding can encode directly; the CRF learns the tag chain)."""
+    rng = np.random.default_rng(400 + i)
+    words = rng.integers(1, DICT, (b, SLEN)).astype("int64")
+    pred = rng.integers(0, DICT, (b, 1)).astype("int64")
+    lens = rng.integers(3, SLEN + 1, (b,)).astype("int64")
+    tags = (words % N_TAGS).astype("int64")
+    for r, ln in enumerate(lens):
+        words[r, ln:] = 0
+        tags[r, ln:] = 0
+    return {"word": words, "predicate": pred, "target": tags,
+            "seq_len": lens}
+
+
+def _db_lstm():
+    """ref test_label_semantic_roles.py db_lstm, shrunk: word + predicate
+    embeddings -> fc -> bidirectional dynamic_lstm pair -> fc emission."""
+    word = L.data("word", [SLEN], dtype="int64")
+    predicate = L.data("predicate", [1], dtype="int64")
+    seq_len = L.data("seq_len", [], dtype="int64")
+    w_emb = L.embedding(word, (DICT, EMB), param_attr="word_emb")
+    p_emb = L.embedding(predicate, (DICT, EMB), param_attr="pred_emb")
+    p_tiled = L.tile(p_emb, [1, SLEN, 1])
+    feat = L.concat([w_emb, p_tiled], axis=2)
+    proj = L.fc(feat, HID * 4, num_flatten_dims=2)
+    fwd, _ = L.dynamic_lstm(proj, HID * 4, sequence_length=seq_len)
+    rev_in = L.sequence_reverse(proj, seq_len)
+    bwd_r, _ = L.dynamic_lstm(rev_in, HID * 4, sequence_length=seq_len)
+    bwd = L.sequence_reverse(bwd_r, seq_len)
+    both = L.concat([fwd, bwd], axis=2)
+    return L.fc(both, N_TAGS, num_flatten_dims=2), seq_len
+
+
+def test_label_semantic_roles_trains(_progs):
+    main, startup = _progs
+    emission, seq_len = _db_lstm()
+    target = L.data("target", [SLEN], dtype="int64")
+    crf_cost = L.linear_chain_crf(emission, target, seq_len,
+                                  param_attr="crfw")
+    avg_cost = L.mean(crf_cost)
+    static.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(60):
+        lv, = exe.run(main, feed=_srl_batch(i), fetch_list=[avg_cost])
+        assert np.isfinite(float(lv))
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_label_semantic_roles_decode_shares_crfw(_progs):
+    """crf_decoding shares 'crfw' with the trained CRF (the reference's
+    param_attr contract) and emits valid in-range tag paths."""
+    main, startup = _progs
+    emission, seq_len = _db_lstm()
+    target = L.data("target", [SLEN], dtype="int64")
+    crf_cost = L.linear_chain_crf(emission, target, seq_len,
+                                  param_attr="crfw")
+    avg_cost = L.mean(crf_cost)
+    decode = L.crf_decoding(emission, seq_len, param_attr="crfw")
+    static.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    exe = static.Executor()
+    exe.run(startup)
+    batch = _srl_batch(0)
+    loss, path = exe.run(main, feed=batch, fetch_list=[avg_cost, decode])
+    assert path.shape == (8, SLEN)
+    assert (path >= 0).all() and (path < N_TAGS).all()
+    pad = np.arange(SLEN)[None, :] >= batch["seq_len"][:, None]
+    assert (path[pad] == 0).all()
+    # training with decode in the same program improves tagging accuracy
+    accs = []
+    for i in range(30):
+        b = _srl_batch(i)
+        _, p = exe.run(main, feed=b, fetch_list=[avg_cost, decode])
+        valid = np.arange(SLEN)[None, :] < b["seq_len"][:, None]
+        accs.append((p[valid] == b["target"][valid]).mean())
+    assert np.mean(accs[-5:]) > np.mean(accs[:5]), (accs[:5], accs[-5:])
