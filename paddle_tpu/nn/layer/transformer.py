@@ -8,6 +8,7 @@ feeding the MXU; norm/residual math runs in float32 under bf16 params.
 from __future__ import annotations
 
 import collections
+import jax
 from typing import Optional
 
 import jax.numpy as jnp
@@ -98,6 +99,37 @@ class MultiHeadAttention(Layer):
         return MultiHeadAttention.Cache(k, k)
 
 
+def _sublayer_epilogue(layer, out, residual, norm, dropout_layer):
+    """src = norm(residual + dropout(out)) — the post-LN sublayer tail
+    shared by encoder AND decoder layers.  On TPU this dispatches to the
+    fused Pallas kernel (one HBM pass per direction, in-kernel replayable
+    dropout); elsewhere or for unsupported shapes it composes the
+    reference chain."""
+    from ...core import flags as _flags
+    from ...ops.pallas import layer_norm as _fln
+
+    rate = float(dropout_layer.p) if layer.training else 0.0
+    if (not layer.normalize_before
+            and norm.weight is not None and norm.bias is not None
+            and _flags.get_flag("use_fused_layer_norm")
+            and jax.default_backend() not in ("cpu", "gpu")
+            and _fln.supported(out, norm.normalized_shape)):
+        seed = None
+        if rate > 0.0:
+            from ...core import random as _random
+
+            seed = jax.random.randint(_random.next_key(), (1,),
+                                      jnp.iinfo(jnp.int32).min,
+                                      jnp.iinfo(jnp.int32).max, jnp.int32)
+        return _fln.fused_residual_dropout_layer_norm(
+            out, residual, norm.weight.value, norm.bias.value,
+            dropout_rate=rate, seed=seed, epsilon=norm.epsilon)
+    src = residual + dropout_layer(out)
+    if not layer.normalize_before:
+        src = norm(src)
+    return src
+
+
 class TransformerEncoderLayer(Layer):
     """ref: transformer.py TransformerEncoderLayer (normalize_before toggles
     pre-/post-LN)."""
@@ -128,16 +160,14 @@ class TransformerEncoderLayer(Layer):
         else:
             out, cache = self.self_attn(src, src, src, attn_mask=src_mask,
                                         cache=cache)
-        src = residual + self.dropout1(out)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        src = _sublayer_epilogue(self, out, residual, self.norm1,
+                                 self.dropout1)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.act_dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        src = _sublayer_epilogue(self, src, residual, self.norm2,
+                                 self.dropout2)
         return src if cache is None else (src, cache)
 
 
@@ -223,9 +253,8 @@ class TransformerDecoderLayer(Layer):
         else:
             out, sc = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask,
                                      cache=cache[0])
-        tgt = residual + self.dropout1(out)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
+        tgt = _sublayer_epilogue(self, out, residual, self.norm1,
+                                 self.dropout1)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -233,16 +262,14 @@ class TransformerDecoderLayer(Layer):
                               cache=cache[1] if cache is not None and
                               isinstance(cache[1], MultiHeadAttention.StaticCache)
                               else None)
-        tgt = residual + self.dropout2(out)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
+        tgt = _sublayer_epilogue(self, out, residual, self.norm2,
+                                 self.dropout2)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.act_dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
+        tgt = _sublayer_epilogue(self, tgt, residual, self.norm3,
+                                 self.dropout3)
         return tgt if cache is None else (tgt, (sc, cache[1]))
 
 

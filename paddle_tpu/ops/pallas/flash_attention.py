@@ -77,6 +77,18 @@ def _dropout_keep(seed, bh, q_pos, k_pos, rate):
     return h >= threshold  # keep with prob (1 - rate)
 
 
+def _keep_from_hw_bits(seed_words, shape, rate):
+    """Draw a keep mask from the hardware PRNG seeded with up to two int32
+    words (the Mosaic limit).  Shared by the flash-attention and fused-LN
+    dropout paths so the threshold/seeding convention cannot drift."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(*seed_words)
+    bits = pltpu.prng_random_bits(shape)  # int32 tile
+    threshold = np.int32(min(int(rate * 2**32), 2**32 - 1) - 2**31)
+    return bits >= threshold  # keep with prob (1 - rate)
+
+
 def _dropout_keep_hw(seed, bh, qi, kv_idx, shape, rate):
     """Hardware-PRNG keep-mask for one (block_q, block_k) tile.
 
@@ -88,15 +100,12 @@ def _dropout_keep_hw(seed, bh, qi, kv_idx, shape, rate):
     Requires block sizes to agree across forward and backward, which
     flash_attention() guarantees.
     """
-    from jax.experimental.pallas import tpu as pltpu
-
     # Mosaic takes at most two 32-bit seed words: fold (seed, bh) into one
     # (odd-constant multiply is injective in bh mod 2^32) and (qi, kv) into
     # the other (block indices are far below 2^16).
-    pltpu.prng_seed(seed + bh * jnp.int32(_P3), qi * jnp.int32(65536) + kv_idx)
-    bits = pltpu.prng_random_bits(shape)  # int32 tile
-    threshold = np.int32(min(int(rate * 2**32), 2**32 - 1) - 2**31)
-    return bits >= threshold  # keep with prob (1 - rate)
+    return _keep_from_hw_bits(
+        (seed + bh * jnp.int32(_P3), qi * jnp.int32(65536) + kv_idx),
+        shape, rate)
 
 
 def _keep_mask(seed, bh, qi, kv_idx, q_pos, k_pos, rate):

@@ -183,3 +183,191 @@ def fused_layer_norm(x, weight, bias, epsilon=1e-5):
     block_rows = _rows_block(n)
     out = _fused_ln(x2, weight, bias, float(epsilon), block_rows, out_dtype)
     return out.reshape(orig_shape)
+
+
+# -- fused residual + dropout + LayerNorm ------------------------------------
+#
+# The post-LN transformer sublayer epilogue  out = LN(residual + dropout(x))
+# costs XLA ~5 HBM passes forward and more backward (dropout mask
+# materialization, the sum, LN stats, then the chain in reverse).  This
+# kernel does forward in ONE pass (read x + residual, write out + stats) and
+# backward in one (recompute the sum h and the keep mask in-register from
+# the replayable per-tile hardware PRNG stream -- nothing but (x, residual)
+# is re-read, no mask or h tensor ever hits HBM).
+
+def _keep_tile(seed, tile_idx, shape, rate):
+    """Keep-mask for one (block_rows, dim) tile; hardware PRNG on TPU
+    (re-seeded per tile => replayable in backward), position hash in
+    interpret mode (same contract as flash_attention's dropout)."""
+    if not _interpret():
+        from .flash_attention import _keep_from_hw_bits
+
+        return _keep_from_hw_bits((seed, tile_idx), shape, rate)
+    from .flash_attention import _dropout_keep
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + tile_idx * shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return _dropout_keep(seed, jnp.int32(0), rows, cols, rate)
+
+
+def _rdln_fwd_kernel(seed_ref, x_ref, res_ref, w_ref, b_ref, o_ref, mean_ref,
+                     rstd_ref, *, eps, rate):
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    res = res_ref[...].astype(jnp.float32)
+    if rate > 0.0:
+        keep = _keep_tile(seed_ref[0], i, x.shape, rate)
+        x = jnp.where(keep, x / (1.0 - rate), 0.0)
+    h = res + x
+    dim = h.shape[-1]
+    mean = jnp.sum(h, axis=-1, keepdims=True) / dim
+    centered = h - mean
+    var = jnp.sum(centered * centered, axis=-1, keepdims=True) / dim
+    rstd = jax.lax.rsqrt(var + eps)
+    out = (centered * rstd * w_ref[...].astype(jnp.float32)
+           + b_ref[...].astype(jnp.float32))
+    o_ref[...] = out.astype(o_ref.dtype)
+    mean_ref[...] = mean[:, 0][None, :]
+    rstd_ref[...] = rstd[:, 0][None, :]
+
+
+def _rdln_bwd_kernel(seed_ref, x_ref, res_ref, w_ref, mean_ref, rstd_ref,
+                     dy_ref, dx_ref, dres_ref, dw_ref, db_ref, *, rate):
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    res = res_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    if rate > 0.0:
+        keep = _keep_tile(seed_ref[0], i, x.shape, rate)
+        x = jnp.where(keep, x / (1.0 - rate), 0.0)
+    h = res + x
+    dim = h.shape[-1]
+    mean = mean_ref[0][:, None]
+    rstd = rstd_ref[0][:, None]
+    xhat = (h - mean) * rstd
+    g = dy * w
+    g_mean = jnp.sum(g, axis=-1, keepdims=True) / dim
+    gx_mean = jnp.sum(g * xhat, axis=-1, keepdims=True) / dim
+    dh = rstd * (g - g_mean - xhat * gx_mean)
+    dres_ref[...] = dh.astype(dres_ref.dtype)
+    if rate > 0.0:
+        dx = jnp.where(keep, dh / (1.0 - rate), 0.0)
+    else:
+        dx = dh
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, dim), 0)
+    dw = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db = jnp.sum(dy, axis=0, keepdims=True)
+    dw_ref[0] = jnp.where(row == 0, dw, 0.0)
+    db_ref[0] = jnp.where(row == 0, db, 0.0)
+
+
+def _rdln_fwd(x2, res2, w, b, seed, eps, rate, block_rows, out_dtype):
+    n, dim = x2.shape
+    return pl.pallas_call(
+        functools.partial(_rdln_fwd_kernel, eps=eps, rate=rate),
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_smem_space()),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, dim), out_dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(seed, x2, res2, w.reshape(1, dim), b.reshape(1, dim))
+
+
+def _rdln_bwd(x2, res2, w, mean, rstd, seed, dy2, rate, block_rows):
+    n, dim = x2.shape
+    n_blocks = n // block_rows
+    dx, dres, dw_part, db_part = pl.pallas_call(
+        functools.partial(_rdln_bwd_kernel, rate=rate),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_smem_space()),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, dim), lambda i: (0, 0)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, dim), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, dim), x2.dtype),
+            jax.ShapeDtypeStruct((n, dim), res2.dtype),
+            jax.ShapeDtypeStruct((n_blocks, 8, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_blocks, 8, dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(seed, x2, res2, w.reshape(1, dim), mean, rstd, dy2)
+    return dx, dres, dw_part.sum(axis=(0, 1)), db_part.sum(axis=(0, 1))
+
+
+def _smem_space():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _fused_rdln(x2, res2, w, b, seed, eps, rate, block_rows, out_dtype):
+    out, _, _ = _rdln_fwd(x2, res2, w, b, seed, eps, rate, block_rows,
+                          out_dtype)
+    return out
+
+
+def _fused_rdln_vjp_fwd(x2, res2, w, b, seed, eps, rate, block_rows,
+                        out_dtype):
+    out, mean, rstd = _rdln_fwd(x2, res2, w, b, seed, eps, rate, block_rows,
+                                out_dtype)
+    return out, (x2, res2, w, mean, rstd, seed)
+
+
+def _fused_rdln_vjp_bwd(eps, rate, block_rows, out_dtype, resids, dy2):
+    x2, res2, w, mean, rstd, seed = resids
+    dx, dres, dw, db = _rdln_bwd(x2, res2, w, mean, rstd, seed, dy2, rate,
+                                 block_rows)
+    return dx, dres, dw.astype(w.dtype), db.astype(w.dtype), None
+
+
+_fused_rdln.defvjp(_fused_rdln_vjp_fwd, _fused_rdln_vjp_bwd)
+
+
+def fused_residual_dropout_layer_norm(x, residual, weight, bias,
+                                      dropout_rate=0.0, seed=None,
+                                      epsilon=1e-5):
+    """out = LayerNorm(residual + dropout(x)) in one HBM pass per direction.
+    Callers must check ``supported()`` (same shape contract as
+    fused_layer_norm).  ``seed`` is an int32 scalar array driving the
+    in-kernel keep mask when ``dropout_rate > 0``."""
+    orig_shape = x.shape
+    dim = orig_shape[-1]
+    n = x.size // dim
+    out_dtype = jnp.result_type(x.dtype, residual.dtype, weight.dtype,
+                                bias.dtype)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    out = _fused_rdln(x.reshape(n, dim), residual.reshape(n, dim), weight,
+                      bias, seed, float(epsilon), float(dropout_rate),
+                      _rows_block(n), out_dtype)
+    return out.reshape(orig_shape)

@@ -1,0 +1,67 @@
+"""linear_chain_crf / crf_decoding vs exhaustive path enumeration (ref
+operators/linear_chain_crf_op.h, crf_decoding_op.h).  This is the regression
+guard for the scan-based forward/Viterbi math — the book test
+(test_book_label_semantic_roles.py) only checks end-to-end behavior."""
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.crf import crf_decoding, linear_chain_crf
+
+B, S, D = 3, 5, 4
+
+
+@pytest.fixture(scope="module")
+def _case():
+    rng = np.random.default_rng(0)
+    emission = rng.normal(0, 1, (B, S, D)).astype("float32")
+    transition = rng.normal(0, 0.5, (D + 2, D)).astype("float32")
+    lengths = np.array([S, 3, 1])
+    label = rng.integers(0, D, (B, S))
+    return emission, transition, lengths, label
+
+
+def _score(emission, transition, lengths, bi, path):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    L = lengths[bi]
+    sc = start[path[0]] + emission[bi, 0, path[0]]
+    for t in range(1, L):
+        sc += trans[path[t - 1], path[t]] + emission[bi, t, path[t]]
+    return sc + stop[path[L - 1]]
+
+
+def test_nll_matches_enumeration(_case):
+    emission, transition, lengths, label = _case
+    nll = np.asarray(linear_chain_crf(emission, label, transition, lengths))
+    for bi in range(B):
+        L = lengths[bi]
+        scores = np.array([
+            _score(emission, transition, lengths, bi, p)
+            for p in itertools.product(range(D), repeat=L)])
+        log_z = np.log(np.exp(scores - scores.max()).sum()) + scores.max()
+        gold = _score(emission, transition, lengths, bi, list(label[bi, :L]))
+        assert abs(nll[bi, 0] - (log_z - gold)) < 1e-4, bi
+
+
+def test_viterbi_matches_enumeration(_case):
+    emission, transition, lengths, label = _case
+    dec = np.asarray(crf_decoding(emission, transition, lengths))
+    for bi in range(B):
+        L = lengths[bi]
+        paths = list(itertools.product(range(D), repeat=L))
+        scores = np.array([
+            _score(emission, transition, lengths, bi, p) for p in paths])
+        best = paths[int(np.argmax(scores))]
+        assert tuple(dec[bi, :L]) == best, (bi, dec[bi, :L], best)
+        assert (dec[bi, L:] == 0).all()
+
+
+def test_crf_nll_gradient_is_finite_and_nonzero(_case):
+    import jax
+    import jax.numpy as jnp
+
+    emission, transition, lengths, label = _case
+    g = jax.grad(lambda t: jnp.sum(linear_chain_crf(
+        emission, label, t, lengths)))(jnp.asarray(transition))
+    assert bool(jnp.all(jnp.isfinite(g))) and float(jnp.abs(g).max()) > 0
