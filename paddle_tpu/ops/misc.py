@@ -1,0 +1,363 @@
+"""Long-tail operator parity batch (ref operators/*.cc names in each
+docstring): pixel/space rearrangement, similarity/norm reductions, ranking
+and focal losses, LRN, crop/pad utilities, multiplex/strided_slice,
+pooling-with-index, affine_grid + grid_sampler, roi_pool, row_conv,
+temporal_shift.  All are jnp compositions — XLA fuses them; none need
+Pallas.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "affine_grid", "cos_sim", "crop_tensor", "frobenius_norm",
+    "grid_sampler", "l1_norm", "lrn", "max_pool2d_with_index", "minus",
+    "multiplex", "p_norm", "pad_constant_like", "pixel_shuffle",
+    "pixel_unshuffle", "rank_loss", "reverse", "roi_pool", "row_conv",
+    "shuffle_channel", "sigmoid_focal_loss", "space_to_depth",
+    "strided_slice", "temporal_shift",
+]
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format="NCHW"):
+    """ref pixel_shuffle_op.cc: (N, C*r^2, H, W) -> (N, C, H*r, W*r)."""
+    r = int(upscale_factor)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if c % (r * r):
+        raise ValueError(f"channels {c} not divisible by upscale^2 {r*r}")
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3)).reshape(
+        n, c // (r * r), h * r, w * r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format="NCHW"):
+    """Inverse of pixel_shuffle (paddle 2.x API)."""
+    r = int(downscale_factor)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = jnp.transpose(out, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * r * r, h // r, w // r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def space_to_depth(x, blocksize: int):
+    """ref space_to_depth_op.cc (NCHW)."""
+    b = int(blocksize)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    return jnp.transpose(out, (0, 3, 5, 1, 2, 4)).reshape(
+        n, c * b * b, h // b, w // b)
+
+
+def shuffle_channel(x, group: int):
+    """ref shuffle_channel_op.cc: interleave channel groups (ShuffleNet)."""
+    n, c, h, w = x.shape
+    return x.reshape(n, group, c // group, h, w).transpose(
+        0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25):
+    """ref temporal_shift_op.cc (TSM): shift 1/4 channels one step back,
+    1/4 one step forward along the segment axis, zero-padded."""
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [x5[:, 1:, :c1], jnp.zeros_like(x5[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x5[:, :1, c1:c2]), x5[:, :-1, c1:c2]], axis=1)
+    return jnp.concatenate([back, fwd, x5[:, :, c2:]], axis=2).reshape(
+        nt, c, h, w)
+
+
+def cos_sim(x, y):
+    """ref cos_sim_op.cc: row-wise cosine similarity -> (N, 1)."""
+    x = jnp.asarray(x)
+    y = jnp.broadcast_to(jnp.asarray(y), x.shape)
+    flat_x = x.reshape(x.shape[0], -1)
+    flat_y = y.reshape(y.shape[0], -1)
+    num = (flat_x * flat_y).sum(-1)
+    den = jnp.linalg.norm(flat_x, axis=-1) * jnp.linalg.norm(flat_y, axis=-1)
+    return (num / jnp.maximum(den, 1e-12))[:, None]
+
+
+def p_norm(x, p=2.0, axis=None, epsilon=1e-12, keepdim=False):
+    """ref p_norm_op.cc."""
+    x = jnp.asarray(x)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    if p == float("inf"):
+        out = jnp.abs(x).max(axis=axis, keepdims=keepdim)
+    elif p == float("-inf"):
+        out = jnp.abs(x).min(axis=axis, keepdims=keepdim)
+    else:
+        out = (jnp.abs(x) ** p).sum(axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return jnp.maximum(out, epsilon) if p > 0 else out
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    """ref frobenius_norm_op.cc."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+
+
+def l1_norm(x):
+    """ref l1_norm_op.cc: sum of absolute values (scalar)."""
+    return jnp.abs(x).sum()
+
+
+def minus(x, y):
+    """ref minus_op.cc."""
+    return jnp.asarray(x) - jnp.asarray(y)
+
+
+def reverse(x, axis):
+    """ref reverse_op.cc."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def multiplex(inputs: Sequence, index):
+    """ref multiplex_op.cc: per-row select among candidate tensors."""
+    stacked = jnp.stack(list(inputs), axis=0)          # (K, N, ...)
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(idx.shape[0])
+    return stacked[idx, rows]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    """ref strided_slice_op.cc (static shapes; negative strides allowed)."""
+    x = jnp.asarray(x)
+    slices = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        slices[ax] = slice(s, e, st)
+    return x[tuple(slices)]
+
+
+def rank_loss(label, left, right):
+    """ref rank_loss_op.cc: RankNet pairwise loss (stable softplus form —
+    log1p(exp(diff)) overflows for diff > ~88 in f32)."""
+    diff = jnp.asarray(left) - jnp.asarray(right)
+    label = jnp.asarray(label)
+    return jnp.logaddexp(0.0, diff) - label * diff
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """ref sigmoid_focal_loss_op.cc (RetinaNet): x (N, C) logits, label
+    (N, 1) int in [0, C] where 0 is background, fg_num scalar normalizer."""
+    x = jnp.asarray(x, jnp.float32)
+    n, c = x.shape
+    lab = jnp.asarray(label).reshape(-1)
+    # one-hot over classes 1..C (background 0 contributes no positive)
+    target = (lab[:, None] == jnp.arange(1, c + 1)[None, :]).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, jnp.where(target > 0, -x, x))
+    p_t = jnp.where(target > 0, p, 1 - p)
+    a_t = jnp.where(target > 0, alpha, 1 - alpha)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    return loss / jnp.maximum(jnp.asarray(fg_num, jnp.float32), 1.0)
+
+
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    """ref lrn_op.cc: local response normalization across channels (NCHW)."""
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    # sliding-window channel sum via cumulative sums
+    csum = jnp.cumsum(pad, axis=1)
+    zeros = jnp.zeros_like(csum[:, :1])
+    csum = jnp.concatenate([zeros, csum], axis=1)
+    win = csum[:, n:] - csum[:, :-n]
+    return x / ((k + alpha * win) ** beta)
+
+
+def pad_constant_like(x, y, pad_value=0.0):
+    """ref pad_constant_like_op.cc: pad y up to x's shape."""
+    y = jnp.asarray(y)
+    cfg = [(0, int(xd) - int(yd)) for xd, yd in zip(x.shape, y.shape)]
+    return jnp.pad(y, cfg, constant_values=pad_value)
+
+
+def crop_tensor(x, shape=None, offsets=None):
+    """ref crop_tensor_op.cc; shape=None keeps x's shape (the reference's
+    default when only offsets shift the window)."""
+    x = jnp.asarray(x)
+    offsets = list(offsets or [0] * x.ndim)
+    shape = list(shape) if shape is not None else list(x.shape)
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """ref max_pool2d_with_index_op.cc: returns (out, flat argmax indices
+    within each image's H*W plane)."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                 constant_values=neg_inf)
+    ip = jnp.pad(flat_idx, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                 constant_values=-1)
+    oh = (h + 2 * pd[0] - ks[0]) // st[0] + 1
+    ow = (w + 2 * pd[1] - ks[1]) // st[1] + 1
+    # unfold windows: (n, c, oh, ow, kh, kw)
+    i0 = jnp.arange(oh) * st[0]
+    j0 = jnp.arange(ow) * st[1]
+    wins = jax.vmap(lambda i: jax.vmap(lambda j: jax.lax.dynamic_slice(
+        xp, (0, 0, i, j), (n, c, ks[0], ks[1])))(j0))(i0)
+    iwins = jax.vmap(lambda i: jax.vmap(lambda j: jax.lax.dynamic_slice(
+        ip, (0, 0, i, j), (n, c, ks[0], ks[1])))(j0))(i0)
+    wins = jnp.moveaxis(wins, (0, 1), (2, 3)).reshape(n, c, oh, ow, -1)
+    iwins = jnp.moveaxis(iwins, (0, 1), (2, 3)).reshape(n, c, oh, ow, -1)
+    arg = jnp.argmax(wins, axis=-1)
+    out = jnp.take_along_axis(wins, arg[..., None], axis=-1)[..., 0]
+    idx = jnp.take_along_axis(iwins, arg[..., None], axis=-1)[..., 0]
+    return out.astype(x.dtype), idx
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """ref affine_grid_op.cc: theta (N, 2, 3) -> sampling grid
+    (N, H, W, 2) in [-1, 1] (x, y) order."""
+    n, _, _ = theta.shape
+    _, _, h, w = out_shape
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)                      # (h, w)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+    # sampling coordinates need full f32 precision: on TPU the default
+    # matmul runs bf16 passes and a 1e-3 coordinate error becomes a visible
+    # value error after bilinear interpolation (the matmul is tiny anyway)
+    return jnp.einsum("hwk,nck->nhwc", base, theta,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def grid_sampler(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """ref grid_sampler_op.cc: sample NCHW x at grid (N, H', W', 2) of
+    normalized (x, y) coords.  padding_mode: zeros|border ("reflection"
+    raises — unimplemented rather than silently clamping)."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sampler padding_mode {padding_mode!r}: only zeros/border "
+            "are implemented")
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def gather(iy, ix):
+        iy_c = jnp.clip(iy, 0, h - 1)
+        ix_c = jnp.clip(ix, 0, w - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iy_c, ix_c]  # (n, H', W', c)
+        if padding_mode == "zeros":
+            inb = ((iy >= 0) & (iy < h) & (ix >= 0) & (ix < w))
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(fy).astype(jnp.int32),
+                     jnp.round(fx).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0)[..., None]
+        wy = (fy - y0)[..., None]
+        out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+               + gather(y0, x0 + 1) * (1 - wy) * wx
+               + gather(y0 + 1, x0) * wy * (1 - wx)
+               + gather(y0 + 1, x0 + 1) * wy * wx)
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)    # (n, c, H', W')
+
+
+def roi_pool(input, rois, output_size, spatial_scale=1.0):
+    """ref roi_pool_op.cc: max pooling over ROI bins (batch-1 feature map,
+    same static-shape policy as roi_align).  input (C, H, W), rois (R, 4)
+    xyxy; returns (R, C, ph, pw)."""
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    C, H, W = input.shape
+    boxes = jnp.round(jnp.asarray(rois, jnp.float32) * spatial_scale)
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(box):
+        x1, y1, x2, y2 = box
+        rh = jnp.maximum(y2 - y1 + 1, 1.0) / ph
+        rw = jnp.maximum(x2 - x1 + 1, 1.0) / pw
+
+        def one_bin(i, j):
+            ys_lo = y1 + i * rh
+            ys_hi = y1 + (i + 1) * rh
+            xs_lo = x1 + j * rw
+            xs_hi = x1 + (j + 1) * rw
+            m = ((ys[:, None] >= jnp.floor(ys_lo))
+                 & (ys[:, None] < jnp.ceil(ys_hi))
+                 & (xs[None, :] >= jnp.floor(xs_lo))
+                 & (xs[None, :] < jnp.ceil(xs_hi)))
+            vals = jnp.where(m[None], input, -jnp.inf)
+            out = vals.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        bins = jax.vmap(jax.vmap(one_bin))(ii.astype(jnp.float32),
+                                           jj.astype(jnp.float32))
+        return jnp.moveaxis(bins, -1, 0)               # (C, ph, pw)
+
+    return jax.vmap(one_roi)(boxes).astype(input.dtype)
+
+
+def row_conv(x, weight, lengths=None):
+    """ref row_conv_op.cc (lookahead conv for streaming ASR): x (b, s, d),
+    weight (future_context + 1, d); out[t] = sum_k w[k] * x[t + k].
+
+    With ``lengths``, the lookahead window STOPS at each sequence boundary
+    (the reference's per-sequence semantics): padded frames are zeroed
+    before the sum so they cannot leak into valid positions, and output
+    rows past the length are zeroed."""
+    x = jnp.asarray(x)
+    k, d = weight.shape
+    if lengths is not None:
+        from .sequence import sequence_mask
+
+        m = sequence_mask(lengths, x.shape[1], dtype=x.dtype)
+        x = x * m[..., None]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+        out = out + shifted * weight[i][None, None, :]
+    if lengths is not None:
+        out = out * m[..., None]
+    return out

@@ -1,0 +1,173 @@
+"""Operator-parity batch in ops/misc.py, oracle-checked against numpy or
+torch-style reference formulas (op names cite operators/*.cc)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import misc as M
+
+
+def test_pixel_shuffle_roundtrip():
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (2, 8, 3, 4)),
+                    jnp.float32)
+    y = M.pixel_shuffle(x, 2)
+    assert y.shape == (2, 2, 6, 8)
+    back = M.pixel_unshuffle(y, 2)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+    # block placement: channel c*r*r maps to (r, r) spatial offsets
+    x0 = jnp.zeros((1, 4, 1, 1)).at[0, 1, 0, 0].set(1.0)
+    y0 = np.asarray(M.pixel_shuffle(x0, 2))[0, 0]
+    assert y0[0, 1] == 1.0 and y0.sum() == 1.0
+
+
+def test_space_to_depth_inverts_pixel_shuffle_layout():
+    x = jnp.asarray(np.arange(2 * 4 * 4, dtype="float32").reshape(1, 2, 4, 4))
+    y = M.space_to_depth(x, 2)
+    assert y.shape == (1, 8, 2, 2)
+    np.testing.assert_allclose(np.asarray(y[0, 0]), np.asarray(x[0, 0, ::2, ::2]))
+
+
+def test_shuffle_channel():
+    x = jnp.arange(6, dtype=jnp.float32).reshape(1, 6, 1, 1)
+    y = np.asarray(M.shuffle_channel(x, 2)).ravel()
+    np.testing.assert_allclose(y, [0, 3, 1, 4, 2, 5])
+
+
+def test_temporal_shift_shapes_and_zero_pad():
+    x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (4, 8, 2, 2)),
+                    jnp.float32)  # n=2 segments of 2
+    y = M.temporal_shift(x, 2, 0.25)
+    assert y.shape == x.shape
+    x5 = np.asarray(x).reshape(2, 2, 8, 2, 2)
+    y5 = np.asarray(y).reshape(2, 2, 8, 2, 2)
+    np.testing.assert_allclose(y5[:, 0, :2], x5[:, 1, :2])   # shift back
+    np.testing.assert_allclose(y5[:, 1, :2], 0)              # zero pad
+    np.testing.assert_allclose(y5[:, 1, 2:4], x5[:, 0, 2:4]) # shift fwd
+    np.testing.assert_allclose(y5[:, :, 4:], x5[:, :, 4:])   # rest static
+
+
+def test_cos_sim_and_norms():
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (4, 6)).astype("float32")
+    y = rng.normal(0, 1, (4, 6)).astype("float32")
+    cs = np.asarray(M.cos_sim(x, y))[:, 0]
+    ref = (x * y).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(cs, ref, rtol=1e-5)
+    np.testing.assert_allclose(float(M.p_norm(x, 3.0)),
+                               (np.abs(x) ** 3).sum() ** (1 / 3), rtol=1e-5)
+    np.testing.assert_allclose(float(M.frobenius_norm(x)),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(float(M.l1_norm(x)), np.abs(x).sum(), rtol=1e-5)
+
+
+def test_rank_and_focal_losses():
+    lab = jnp.asarray([[1.0], [0.0]])
+    left = jnp.asarray([[2.0], [0.5]])
+    right = jnp.asarray([[1.0], [1.5]])
+    rl = np.asarray(M.rank_loss(lab, left, right))
+    ref = np.log1p(np.exp([1.0, -1.0])) - np.asarray([[1.0], [0.0]])[:, 0] * np.asarray([1.0, -1.0])
+    np.testing.assert_allclose(rl[:, 0], ref, rtol=1e-5)
+
+    x = jnp.asarray(np.random.default_rng(3).normal(0, 1, (5, 3)), jnp.float32)
+    lab = jnp.asarray([[1], [0], [2], [3], [0]])
+    fl = np.asarray(M.sigmoid_focal_loss(x, lab, fg_num=3))
+    assert fl.shape == (5, 3) and np.isfinite(fl).all() and (fl >= 0).all()
+
+
+def test_lrn_matches_direct_window_sum():
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (2, 7, 3, 3)).astype("float32")
+    n, k, alpha, beta = 5, 2.0, 1e-2, 0.75
+    out = np.asarray(M.lrn(jnp.asarray(x), n=n, k=k, alpha=alpha, beta=beta))
+    sq = x ** 2
+    ref = np.empty_like(x)
+    half = n // 2
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + (n - half))
+        win = sq[:, lo:hi].sum(axis=1)
+        ref[:, c] = x[:, c] / ((k + alpha * win) ** beta)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_pad_crop_minus_reverse_multiplex_stride():
+    x = jnp.asarray(np.arange(12, dtype="float32").reshape(3, 4))
+    y = jnp.ones((2, 2), jnp.float32)
+    p = np.asarray(M.pad_constant_like(x, y, -1))
+    assert p.shape == (3, 4) and p[0, 0] == 1 and p[2, 3] == -1
+    c = np.asarray(M.crop_tensor(x, shape=[2, 2], offsets=[1, 1]))
+    np.testing.assert_allclose(c, [[5, 6], [9, 10]])
+    np.testing.assert_allclose(np.asarray(M.minus(x, x)), 0)
+    np.testing.assert_allclose(np.asarray(M.reverse(x, 1))[0], [3, 2, 1, 0])
+    a, b = jnp.zeros((3, 2)), jnp.ones((3, 2))
+    sel = np.asarray(M.multiplex([a, b], jnp.asarray([[0], [1], [0]])))
+    np.testing.assert_allclose(sel[:, 0], [0, 1, 0])
+    ss = np.asarray(M.strided_slice(x, [1], [3], [0], [-2]))
+    np.testing.assert_allclose(ss[0], [3, 1])
+
+
+def test_max_pool2d_with_index():
+    x = jnp.asarray(np.random.default_rng(5).normal(0, 1, (2, 3, 6, 6)),
+                    jnp.float32)
+    out, idx = M.max_pool2d_with_index(x, 2, stride=2)
+    assert out.shape == (2, 3, 3, 3) and idx.shape == out.shape
+    xn = np.asarray(x)
+    flat = xn.reshape(2, 3, -1)
+    gathered = np.take_along_axis(flat, np.asarray(idx).reshape(2, 3, -1),
+                                  axis=2).reshape(out.shape)
+    np.testing.assert_allclose(np.asarray(out), gathered)
+
+
+def test_affine_grid_and_grid_sampler_identity():
+    x = jnp.asarray(np.random.default_rng(6).normal(0, 1, (2, 3, 5, 7)),
+                    jnp.float32)
+    theta = jnp.tile(jnp.asarray([[[1.0, 0, 0], [0, 1.0, 0]]]), (2, 1, 1))
+    grid = M.affine_grid(theta, (2, 3, 5, 7), align_corners=True)
+    out = M.grid_sampler(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-4,
+                               atol=1e-5)
+    # horizontal flip via theta
+    theta_f = jnp.tile(jnp.asarray([[[-1.0, 0, 0], [0, 1.0, 0]]]), (2, 1, 1))
+    out_f = M.grid_sampler(x, M.affine_grid(theta_f, (2, 3, 5, 7)))
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(x)[..., ::-1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    feat = jnp.asarray(np.arange(16, dtype="float32").reshape(1, 4, 4))
+    rois = jnp.asarray([[0, 0, 3, 3]], jnp.float32)
+    out = np.asarray(M.roi_pool(feat, rois, 2))
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_row_conv_lookahead():
+    x = jnp.asarray(np.random.default_rng(7).normal(0, 1, (2, 5, 3)),
+                    jnp.float32)
+    w = jnp.asarray(np.random.default_rng(8).normal(0, 1, (2, 3)), jnp.float32)
+    out = np.asarray(M.row_conv(x, w))
+    xn, wn = np.asarray(x), np.asarray(w)
+    ref = np.zeros_like(xn)
+    for t in range(5):
+        for k in range(2):
+            if t + k < 5:
+                ref[:, t] += xn[:, t + k] * wn[k]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_row_conv_padding_does_not_leak():
+    # code-review r03: padded frames must not leak through the lookahead
+    x = jnp.asarray([[[1.0], [2.0], [100.0], [100.0]]])
+    w = jnp.ones((2, 1), jnp.float32)
+    out = np.asarray(M.row_conv(x, w, lengths=jnp.asarray([2])))
+    np.testing.assert_allclose(out[0, :, 0], [3.0, 2.0, 0.0, 0.0])
+
+
+def test_rank_loss_stable_and_crop_default():
+    assert np.isfinite(float(M.rank_loss(jnp.asarray(1.0),
+                                         jnp.asarray(100.0),
+                                         jnp.asarray(0.0))))
+    x = jnp.arange(6.0).reshape(2, 3)
+    np.testing.assert_allclose(np.asarray(M.crop_tensor(x)), np.asarray(x))
+    with pytest.raises(NotImplementedError):
+        M.grid_sampler(jnp.ones((1, 1, 2, 2)),
+                       jnp.zeros((1, 2, 2, 2)), padding_mode="reflection")
