@@ -127,10 +127,11 @@ def minus(x, y):
 
 
 def reverse(x, axis):
-    """ref reverse_op.cc."""
-    if isinstance(axis, int):
-        axis = [axis]
-    return jnp.flip(x, axis=tuple(axis))
+    """ref reverse_op.cc — the fluid-era name for flip; delegates to the
+    2.x manipulation.flip implementation."""
+    from .manipulation import flip
+
+    return flip(x, axis)
 
 
 def multiplex(inputs: Sequence, index):
@@ -177,7 +178,10 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
 def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75):
     """ref lrn_op.cc: local response normalization across channels (NCHW)."""
     sq = jnp.square(x)
-    half = n // 2
+    # window start matches lrn_op.cc: c + (-(n-1)/2) with C integer
+    # truncation, i.e. (n-1)//2 channels of left context (n//2 centers one
+    # channel early for even n)
+    half = (n - 1) // 2
     pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
     # sliding-window channel sum via cumulative sums
     csum = jnp.cumsum(pad, axis=1)
@@ -195,12 +199,12 @@ def pad_constant_like(x, y, pad_value=0.0):
 
 
 def crop_tensor(x, shape=None, offsets=None):
-    """ref crop_tensor_op.cc; shape=None keeps x's shape (the reference's
-    default when only offsets shift the window)."""
-    x = jnp.asarray(x)
-    offsets = list(offsets or [0] * x.ndim)
-    shape = list(shape) if shape is not None else list(x.shape)
-    return jax.lax.dynamic_slice(x, offsets, shape)
+    """ref crop_tensor_op.cc; delegates to extra.crop (which also resolves
+    -1/None shape entries).  shape=None keeps x's shape."""
+    from .extra import crop
+
+    shape = list(shape) if shape is not None else list(jnp.asarray(x).shape)
+    return crop(x, shape=shape, offsets=offsets)
 
 
 def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):

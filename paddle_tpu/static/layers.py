@@ -1358,3 +1358,130 @@ def crf_decoding(input, length, param_attr=None, name=None) -> Variable:
              "Length": [length.name]},
             {"ViterbiPath": [out.name]}, {})
     return out
+
+
+# -- misc op-parity layer functions ------------------------------------------
+
+def _same_shape_op(op_type, x, attrs=None, in_name="X", out_name="Out",
+                   out_shape=None, out_dtype=None):
+    out = _out(out_dtype or x.dtype, out_shape if out_shape is not None
+               else x.shape)
+    _append(op_type, {in_name: [x.name]}, {out_name: [out.name]}, attrs or {})
+    return out
+
+
+def pixel_shuffle(x, upscale_factor) -> Variable:
+    """ref pixel_shuffle layer (2.x nn.functional.pixel_shuffle)."""
+    r = int(upscale_factor)
+    n, c, h, w = x.shape
+    shape = (n, c // (r * r) if c >= 0 else -1,
+             h * r if h >= 0 else -1, w * r if w >= 0 else -1)
+    return _same_shape_op("pixel_shuffle", x, {"upscale_factor": r},
+                          out_shape=shape)
+
+
+def space_to_depth(x, blocksize) -> Variable:
+    """ref fluid/layers/nn.py space_to_depth."""
+    b = int(blocksize)
+    n, c, h, w = x.shape
+    shape = (n, c * b * b if c >= 0 else -1,
+             h // b if h >= 0 else -1, w // b if w >= 0 else -1)
+    return _same_shape_op("space_to_depth", x, {"blocksize": b},
+                          out_shape=shape)
+
+
+def shuffle_channel(x, group) -> Variable:
+    """ref fluid/layers/nn.py shuffle_channel."""
+    return _same_shape_op("shuffle_channel", x, {"group": int(group)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25) -> Variable:
+    """ref fluid/layers/nn.py temporal_shift."""
+    return _same_shape_op("temporal_shift", x,
+                          {"seg_num": int(seg_num),
+                           "shift_ratio": float(shift_ratio)})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75) -> Variable:
+    """ref fluid/layers/nn.py lrn."""
+    return _same_shape_op("lrn", input,
+                          {"n": n, "k": k, "alpha": alpha, "beta": beta})
+
+
+def cos_sim(X, Y) -> Variable:
+    """ref fluid/layers/nn.py cos_sim."""
+    out = _out(X.dtype, (X.shape[0], 1))
+    _append("cos_sim", {"X": [X.name], "Y": [Y.name]}, {"Out": [out.name]})
+    return out
+
+
+def multiplex(inputs, index) -> Variable:
+    """ref fluid/layers/nn.py multiplex."""
+    out = _out(inputs[0].dtype, inputs[0].shape)
+    _append("multiplex", {"X": [v.name for v in inputs],
+                          "Ids": [index.name]}, {"Out": [out.name]})
+    return out
+
+
+def rank_loss(label, left, right) -> Variable:
+    """ref fluid/layers/loss.py rank_loss."""
+    out = _out(left.dtype, left.shape)
+    _append("rank_loss", {"Label": [label.name], "Left": [left.name],
+                          "Right": [right.name]}, {"Out": [out.name]})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25) -> Variable:
+    """ref fluid/layers/detection.py sigmoid_focal_loss."""
+    out = _out(x.dtype, x.shape)
+    _append("sigmoid_focal_loss",
+            {"X": [x.name], "Label": [label.name], "FgNum": [fg_num.name]},
+            {"Out": [out.name]}, {"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def affine_grid(theta, out_shape) -> Variable:
+    """ref fluid/layers/nn.py affine_grid."""
+    n, _, h, w = out_shape
+    out = _out(theta.dtype, (n, h, w, 2))
+    _append("affine_grid", {"Theta": [theta.name]}, {"Output": [out.name]},
+            {"output_shape": list(out_shape)})
+    return out
+
+
+def grid_sampler(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True) -> Variable:
+    """ref fluid/layers/nn.py grid_sampler."""
+    out = _out(x.dtype, (x.shape[0], x.shape[1], grid.shape[1],
+                         grid.shape[2]))
+    _append("grid_sampler", {"X": [x.name], "Grid": [grid.name]},
+            {"Output": [out.name]},
+            {"mode": mode, "padding_mode": padding_mode,
+             "align_corners": align_corners})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0) -> Variable:
+    """ref fluid/layers/detection.py roi_pool (batch-1 static policy)."""
+    out = _out(input.dtype, (rois.shape[0], input.shape[1], pooled_height,
+                             pooled_width))
+    _append("roi_pool", {"X": [input.name], "ROIs": [rois.name]},
+            {"Out": [out.name]},
+            {"pooled_height": pooled_height, "pooled_width": pooled_width,
+             "spatial_scale": spatial_scale})
+    return out
+
+
+def row_conv(input, future_context_size, sequence_length=None,
+             param_attr=None) -> Variable:
+    """ref fluid/layers/nn.py row_conv (owns the lookahead filter)."""
+    d = input.shape[-1]
+    w = create_parameter((future_context_size + 1, d), input.dtype,
+                         attr=param_attr)
+    out = _out(input.dtype, input.shape)
+    inputs = {"X": [input.name], "Filter": [w.name]}
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length.name]
+    _append("row_conv", inputs, {"Out": [out.name]}, {})
+    return out

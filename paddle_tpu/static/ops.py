@@ -932,3 +932,96 @@ def _crf_decoding(ins, attrs, op):
     path = _crf.crf_decoding(_one(ins, "Emission"), _one(ins, "Transition"),
                              _one(ins, "Length"))
     return {"ViterbiPath": [path]}
+
+
+def _misc_op(op_type, in_slots, out_slot="Out", attr_names=()):
+    """Register a lowering that forwards to the eager ops.misc function of
+    the same name (fluid layer-function parity batch)."""
+    from ..ops import misc as _misc
+
+    fn = getattr(_misc, op_type)
+
+    @register_op(op_type)
+    def _lowered(ins, attrs, op, fn=fn, in_slots=in_slots,
+                 attr_names=attr_names, out_slot=out_slot):
+        args = [_one(ins, slot) for slot in in_slots]
+        kwargs = {name: attrs[name] for name in attr_names if name in attrs}
+        return {out_slot: [fn(*args, **kwargs)]}
+    return _lowered
+
+
+_misc_op("pixel_shuffle", ["X"], attr_names=("upscale_factor",))
+_misc_op("space_to_depth", ["X"], attr_names=("blocksize",))
+_misc_op("shuffle_channel", ["X"], attr_names=("group",))
+_misc_op("temporal_shift", ["X"], attr_names=("seg_num", "shift_ratio"))
+_misc_op("cos_sim", ["X", "Y"])
+_misc_op("lrn", ["X"], attr_names=("n", "k", "alpha", "beta"))
+
+@register_op("multiplex")
+def _multiplex(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    return {"Out": [_misc.multiplex(ins["X"], _one(ins, "Ids"))]}
+
+
+
+@register_op("rank_loss")
+def _rank_loss(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    return {"Out": [_misc.rank_loss(_one(ins, "Label"), _one(ins, "Left"),
+                                    _one(ins, "Right"))]}
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    return {"Out": [_misc.sigmoid_focal_loss(
+        _one(ins, "X"), _one(ins, "Label"), _one(ins, "FgNum"),
+        gamma=attrs.get("gamma", 2.0), alpha=attrs.get("alpha", 0.25))]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    return {"Output": [_misc.grid_sampler(
+        _one(ins, "X"), _one(ins, "Grid"),
+        mode=attrs.get("mode", "bilinear"),
+        padding_mode=attrs.get("padding_mode", "zeros"),
+        align_corners=attrs.get("align_corners", True))]}
+
+
+@register_op("affine_grid")
+def _affine_grid(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    return {"Output": [_misc.affine_grid(
+        _one(ins, "Theta"), tuple(attrs["output_shape"]),
+        align_corners=attrs.get("align_corners", True))]}
+
+
+@register_op("roi_pool")
+def _roi_pool(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    x = _one(ins, "X")
+    if x.ndim == 4:
+        if x.shape[0] != 1:
+            raise ValueError("static roi_pool lowers the batch-1 kernel "
+                             f"(got N={x.shape[0]})")
+        x = x[0]
+    return {"Out": [_misc.roi_pool(
+        x, _one(ins, "ROIs"),
+        (attrs["pooled_height"], attrs["pooled_width"]),
+        spatial_scale=attrs.get("spatial_scale", 1.0))]}
+
+
+@register_op("row_conv")
+def _row_conv(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    lengths = ins.get("Lengths")
+    return {"Out": [_misc.row_conv(_one(ins, "X"), _one(ins, "Filter"),
+                                   lengths=lengths[0] if lengths else None)]}

@@ -171,3 +171,19 @@ def test_rank_loss_stable_and_crop_default():
     with pytest.raises(NotImplementedError):
         M.grid_sampler(jnp.ones((1, 1, 2, 2)),
                        jnp.zeros((1, 2, 2, 2)), padding_mode="reflection")
+
+
+def test_lrn_even_window_alignment():
+    # code-review r03: even n uses (n-1)//2 left context like lrn_op.cc
+    x = jnp.asarray(np.random.default_rng(9).normal(0, 1, (1, 6, 2, 2)),
+                    jnp.float32)
+    n, k, alpha, beta = 4, 2.0, 1e-2, 0.75
+    out = np.asarray(M.lrn(x, n=n, k=k, alpha=alpha, beta=beta))
+    xn = np.asarray(x)
+    sq = xn ** 2
+    ref = np.empty_like(xn)
+    for c in range(6):
+        lo = max(0, c - (n - 1) // 2)         # 1 left
+        hi = min(6, c - (n - 1) // 2 + n)     # 2 right
+        ref[:, c] = xn[:, c] / ((k + alpha * sq[:, lo:hi].sum(1)) ** beta)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)

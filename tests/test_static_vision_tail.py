@@ -124,3 +124,58 @@ def test_detection_layers_match_eager(_progs):
     ref_e = V.box_coder(jnp.asarray(prior_np), None, jnp.asarray(tgt_np),
                         "encode_center_size")
     np.testing.assert_allclose(e, np.asarray(ref_e), rtol=1e-5)
+
+
+def test_misc_layer_functions(_progs):
+    """fluid layer fns over the ops/misc.py batch — lowered through the
+    Executor and matched against the eager kernels."""
+    from paddle_tpu.ops import misc as M
+
+    main, startup = _progs
+    rng = np.random.default_rng(9)
+    x_np = rng.normal(0, 1, (2, 8, 4, 4)).astype("float32")
+    x = L.data("x", [8, 4, 4])
+    outs = [L.pixel_shuffle(x, 2), L.space_to_depth(x, 2),
+            L.shuffle_channel(x, 2), L.temporal_shift(x, 2),
+            L.lrn(x)]
+    theta = L.data("theta", [2, 3])
+    grid = L.affine_grid(theta, (2, 8, 4, 4))
+    sampled = L.grid_sampler(x, grid)
+    res = _run(main, startup,
+               {"x": x_np, "theta": np.tile(
+                   np.asarray([[[1.0, 0, 0], [0, 1, 0]]], "float32"),
+                   (2, 1, 1))},
+               outs + [sampled])
+    refs = [M.pixel_shuffle(jnp.asarray(x_np), 2),
+            M.space_to_depth(jnp.asarray(x_np), 2),
+            M.shuffle_channel(jnp.asarray(x_np), 2),
+            M.temporal_shift(jnp.asarray(x_np), 2),
+            M.lrn(jnp.asarray(x_np))]
+    for got, ref in zip(res[:-1], refs):
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(res[-1], x_np, rtol=1e-4, atol=1e-5)
+
+
+def test_misc_loss_and_rowconv_layers(_progs):
+    main, startup = _progs
+    left = L.data("left", [1])
+    right = L.data("right", [1])
+    lab = L.data("lab", [1])
+    rl = L.rank_loss(lab, left, right)
+    seq = L.data("seq", [5, 6])
+    sl = L.data("sl", [], dtype="int64")
+    rc = L.row_conv(seq, 2, sequence_length=sl)
+    loss = L.mean(rc)
+    static.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    out = _run(main, startup,
+               {"left": np.asarray([[2.0], [0.5]], "float32"),
+                "right": np.asarray([[1.0], [1.5]], "float32"),
+                "lab": np.asarray([[1.0], [0.0]], "float32"),
+                "seq": np.random.default_rng(1).normal(
+                    0, 1, (2, 5, 6)).astype("float32"),
+                "sl": np.asarray([5, 3])},
+               [rl, rc, loss])
+    assert out[0].shape == (2, 1) and np.isfinite(out[0]).all()
+    assert out[1].shape == (2, 5, 6)
+    assert np.allclose(out[1][1, 3:], 0)  # masked past length
+    assert np.isfinite(float(out[2]))
