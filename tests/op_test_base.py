@@ -1,0 +1,150 @@
+"""OpTest harness (ref python/paddle/fluid/tests/unittests/op_test.py:170 —
+the backbone of the reference's ~500 per-op test files).
+
+A subclass declares ``op_type``, numpy ``inputs``/``attrs``/``outputs``;
+``check_output`` builds a single-op Program, runs it through the real static
+Executor (scratch Scope, same path as training), and compares against the
+declared outputs.  ``check_grad`` compares analytic gradients — produced by
+``static.gradients`` on a mean-of-output loss, exactly like the reference —
+against central finite differences computed by re-running the FORWARD-only
+program with perturbed feeds (ref op_test.py:57 get_numeric_gradient,
+delta≈5e-3).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import paddle_tpu.static as static
+
+
+def _as_list(value):
+    return list(value) if isinstance(value, (list, tuple)) else [value]
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict = {}
+    outputs: Dict[str, np.ndarray] = {}
+
+    # -- program construction ------------------------------------------------
+
+    def _build(self, grad_of: Tuple[str, Sequence[str]] = None):
+        """Build the single-op program.  With ``grad_of=(output_slot,
+        input_slots)`` also appends loss = mean(output) and its gradients
+        w.r.t. every array of each listed input slot.  Returns
+        (main, startup, out_fetches, loss_var, grad_fetches)."""
+        from paddle_tpu.static import layers as L
+
+        main, startup = static.Program(), static.Program()
+        loss = None
+        grad_fetches: List = []
+        with static.program_guard(main, startup):
+            block = main.current_block()
+            in_names: Dict[str, List[str]] = {}
+            in_vars: Dict[str, List] = {}
+            for slot, value in self.inputs.items():
+                names, varlist = [], []
+                for i, arr in enumerate(_as_list(value)):
+                    name = f"{slot.lower()}_{i}"
+                    v = block.create_var(name=name, shape=tuple(arr.shape),
+                                         dtype=str(arr.dtype), is_data=True,
+                                         stop_gradient=False)
+                    names.append(name)
+                    varlist.append(v)
+                in_names[slot] = names
+                in_vars[slot] = varlist
+            out_names: Dict[str, List[str]] = {}
+            out_vars: Dict[str, List] = {}
+            for slot, value in self.outputs.items():
+                names, varlist = [], []
+                for i, arr in enumerate(_as_list(value)):
+                    name = f"out_{slot.lower()}_{i}"
+                    v = block.create_var(name=name,
+                                         shape=tuple(np.asarray(arr).shape),
+                                         dtype=str(np.asarray(arr).dtype))
+                    names.append(name)
+                    varlist.append(v)
+                out_names[slot] = names
+                out_vars[slot] = varlist
+            block.append_op(self.op_type, inputs=in_names,
+                            outputs=out_names, attrs=dict(self.attrs))
+            if grad_of is not None:
+                output_slot, input_slots = grad_of
+                loss = L.mean(out_vars[output_slot][0])
+                wrt = [v for slot in input_slots for v in in_vars[slot]]
+                grad_fetches = list(static.gradients([loss], wrt))
+        out_fetches = [n for names in out_names.values() for n in names]
+        return main, startup, out_fetches, loss, grad_fetches
+
+    def _feed(self):
+        """Fresh contiguous copies every call: the numeric sweep perturbs
+        the fed arrays in place and must never mutate self.inputs (or be
+        defeated by a non-contiguous view whose reshape(-1) is a copy)."""
+        feed = {}
+        for slot, value in self.inputs.items():
+            for i, arr in enumerate(_as_list(value)):
+                feed[f"{slot.lower()}_{i}"] = np.ascontiguousarray(arr)
+        return feed
+
+    # -- checks --------------------------------------------------------------
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, out_fetches, _, _ = self._build()
+        exe = static.Executor()
+        exe.run(startup)
+        got = exe.run(main, feed=self._feed(), fetch_list=out_fetches)
+        i = 0
+        for slot, value in self.outputs.items():
+            for expected in _as_list(value):
+                np.testing.assert_allclose(
+                    got[i], expected, atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}")
+                i += 1
+
+    def check_grad(self, inputs_to_check: Sequence[str], output_name: str,
+                   numeric_delta: float = 5e-3,
+                   max_relative_error: float = 5e-3):
+        """Analytic (static.gradients, ref backward.py:1215) vs central
+        finite differences on loss = mean(output).  Checks EVERY array of
+        each listed input slot; the numeric sweep runs the forward-only
+        program (the backward subgraph would double every probe's cost)."""
+        from paddle_tpu.static import layers as L
+
+        g_main, g_startup, _, _, grad_fetches = self._build(
+            grad_of=(output_name, inputs_to_check))
+        exe = static.Executor()
+        exe.run(g_startup)
+        feed = self._feed()
+        analytic = exe.run(g_main, feed=feed, fetch_list=grad_fetches)
+
+        # forward-only program for the numeric probes
+        f_main, f_startup, _, f_loss, _ = self._build(
+            grad_of=(output_name, ()))
+        exe.run(f_startup)
+
+        idx = 0
+        for slot in inputs_to_check:
+            for i, _ in enumerate(_as_list(self.inputs[slot])):
+                a_grad = np.asarray(analytic[idx])
+                idx += 1
+                arr = feed[f"{slot.lower()}_{i}"]
+                numeric = np.zeros(arr.shape, np.float64)
+                flat = arr.reshape(-1)          # in-place view (contiguous)
+                nflat = numeric.reshape(-1)
+                for j in range(flat.size):
+                    orig = flat[j]
+                    for sign in (+1, -1):
+                        flat[j] = orig + sign * numeric_delta
+                        out, = exe.run(f_main, feed=feed,
+                                       fetch_list=[f_loss])
+                        nflat[j] += sign * float(out)
+                    flat[j] = orig
+                numeric /= (2 * numeric_delta)
+                denom = np.maximum(np.abs(numeric), 1e-3)
+                rel = np.abs(a_grad - numeric) / denom
+                assert rel.max() <= max_relative_error, (
+                    f"{self.op_type} grad w.r.t. {slot}[{i}]: max rel err "
+                    f"{rel.max():.2e} > {max_relative_error:.0e}")
