@@ -51,6 +51,22 @@ class MultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
+        if cache is None and not self.need_weights:
+            # packed fast path: feed the projection outputs straight to the
+            # kernel in (b, s, h*d) layout — the split/merge transposes cost
+            # ~19 ms/step on the ERNIE flagship (pure layout copies)
+            qp = self.q_proj(query)
+            kp = self.k_proj(key)
+            vp = self.v_proj(value)
+            out = attn_ops.flash_attention_packed(
+                qp, kp, vp, self.num_heads, attn_mask=attn_mask,
+                dropout_p=self.dropout, training=self.training)
+            if out is not None:
+                return self.out_proj(out)
+            q = self._split_heads(qp)
+            k = self._split_heads(kp)
+            v = self._split_heads(vp)
+            return self._attend(q, k, v, attn_mask, None)
         q = self._split_heads(self.q_proj(query))
         if isinstance(cache, MultiHeadAttention.StaticCache):
             k, v = cache.k, cache.v
@@ -62,6 +78,9 @@ class MultiHeadAttention(Layer):
                 v = jnp.concatenate([cache.v, v], axis=2)
                 cache = MultiHeadAttention.Cache(k, v)
 
+        return self._attend(q, k, v, attn_mask, cache)
+
+    def _attend(self, q, k, v, attn_mask, cache):
         weights = None
         if self.need_weights:
             # explicit-weights path (flash kernel never materializes them)
@@ -114,13 +133,7 @@ def _sublayer_epilogue(layer, out, residual, norm, dropout_layer):
             and _flags.get_flag("use_fused_layer_norm")
             and jax.default_backend() not in ("cpu", "gpu")
             and _fln.supported(out, norm.normalized_shape)):
-        seed = None
-        if rate > 0.0:
-            from ...core import random as _random
-
-            seed = jax.random.randint(_random.next_key(), (1,),
-                                      jnp.iinfo(jnp.int32).min,
-                                      jnp.iinfo(jnp.int32).max, jnp.int32)
+        seed = attn_ops.draw_dropout_seed() if rate > 0.0 else None
         return _fln.fused_residual_dropout_layer_norm(
             out, residual, norm.weight.value, norm.bias.value,
             dropout_rate=rate, seed=seed, epsilon=norm.epsilon)

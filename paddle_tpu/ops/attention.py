@@ -61,6 +61,47 @@ def _as_padding_bias(attn_mask, b, s):
     return jnp.broadcast_to(m.astype(jnp.float32), (b, s))
 
 
+def draw_dropout_seed():
+    """One int32 seed from the framework key stream for in-kernel dropout.
+    Single definition so the seeding convention used by the flash and
+    fused-LN kernels cannot drift between call sites."""
+    from ..core import random as _random
+
+    return jax.random.randint(_random.next_key(), (1,),
+                              jnp.iinfo(jnp.int32).min,
+                              jnp.iinfo(jnp.int32).max, jnp.int32)
+
+
+def flash_attention_packed(q, k, v, num_heads, attn_mask=None,
+                           dropout_p=0.0, is_causal=False, scale=None,
+                           training=True):
+    """Packed-layout dispatch: q/k/v are (batch, seq, heads*head_dim) —
+    the projection output, no head transposes (see
+    pallas/flash_attention_packed.py).  Returns (batch, seq, heads*head_dim)
+    or None when the kernel path is not eligible (caller falls back to the
+    standard split-head path)."""
+    from ..core import flags
+    from .pallas import flash_attention_packed as fap
+
+    b, s, packed = q.shape
+    hd = packed // num_heads
+    # cheap gates first: every eager fallback call would otherwise build
+    # and discard the mask conversion
+    if not (flags.get_flag("use_flash_attention")
+            and _is_tpu()
+            and q.shape == k.shape == v.shape
+            and fap.supported(s, num_heads, hd)):
+        return None
+    bias = _as_padding_bias(attn_mask, b, s)
+    if bias is None:
+        return None
+    rate = float(dropout_p) if training else 0.0
+    seed = draw_dropout_seed() if rate > 0.0 else None
+    return fap.flash_attention_packed(q, k, v, num_heads, bias=bias,
+                                      sm_scale=scale, causal=is_causal,
+                                      dropout_rate=rate, seed=seed)
+
+
 def flash_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                     scale=None, training=True):
     """Dispatch to the Pallas flash-attention kernel when the backend/shape
@@ -83,13 +124,7 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         and fa.supported(s, d)
     )
     if use_kernel:
-        seed = None
-        if rate > 0.0:
-            from ..core import random as _random
-
-            seed = jax.random.randint(_random.next_key(), (1,),
-                                      jnp.iinfo(jnp.int32).min,
-                                      jnp.iinfo(jnp.int32).max, jnp.int32)
+        seed = draw_dropout_seed() if rate > 0.0 else None
         return fa.flash_attention(q, k, v, bias=bias, sm_scale=scale,
                                   causal=is_causal, dropout_rate=rate,
                                   seed=seed)

@@ -158,3 +158,85 @@ class TestDispatch:
         ref = sdpa(q, q, q, attn_mask=mask)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
+
+
+# -- packed layout (no head transposes) --------------------------------------
+
+class TestPackedLayout:
+    def _data(self, b=2, h=4, s=256, d=64, dtype=jnp.float32):
+        rng = np.random.default_rng(0)
+        q4, k4, v4 = (jnp.asarray(rng.normal(0, 1, (b, h, s, d)), dtype)
+                      for _ in range(3))
+        bias = jnp.asarray(rng.normal(0, 1, (b, s)), jnp.float32)
+        pack = lambda t: jnp.moveaxis(t, 1, 2).reshape(b, s, h * d)
+        return q4, k4, v4, bias, pack
+
+    def test_packed_matches_standard_kernel_fwd_and_grads(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention as std
+        from paddle_tpu.ops.pallas.flash_attention_packed import (
+            flash_attention_packed as packed,
+        )
+
+        q4, k4, v4, bias, pack = self._data()
+        b, h, s, d = q4.shape
+        ref = std(q4, k4, v4, bias=bias)
+        out = packed(pack(q4), pack(k4), pack(v4), h, bias=bias)
+        out4 = jnp.moveaxis(out.reshape(b, s, h, d), 2, 1)
+        np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        g_ref = jax.grad(lambda t: (std(t[0], t[1], t[2], bias=bias) ** 2
+                                    ).sum())((q4, k4, v4))
+        g_pk = jax.grad(lambda t: (packed(pack(t[0]), pack(t[1]), pack(t[2]),
+                                          h, bias=bias) ** 2).sum())(
+            (q4, k4, v4))
+        for name, a, r in zip("qkv", g_pk, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-5, atol=1e-5, err_msg=name)
+
+    def test_packed_causal_and_dropout_replay(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention as std
+        from paddle_tpu.ops.pallas.flash_attention_packed import (
+            flash_attention_packed as packed,
+        )
+
+        q4, k4, v4, bias, pack = self._data(s=128)
+        b, h, s, d = q4.shape
+        ref = std(q4, k4, v4, bias=bias, causal=True)
+        out = packed(pack(q4), pack(k4), pack(v4), h, bias=bias, causal=True)
+        out4 = jnp.moveaxis(out.reshape(b, s, h, d), 2, 1)
+        np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        seed = jnp.asarray([5], jnp.int32)
+        a1 = packed(pack(q4), pack(k4), pack(v4), h, dropout_rate=0.2,
+                    seed=seed)
+        a2 = packed(pack(q4), pack(k4), pack(v4), h, dropout_rate=0.2,
+                    seed=seed)
+        assert np.array_equal(np.asarray(a1), np.asarray(a2))
+
+    def test_mha_packed_dispatch(self, monkeypatch):
+        """MultiHeadAttention takes the transpose-free path when the gate
+        opens and matches the split-head fallback."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.autograd import functional_call, parameters_dict
+        from paddle_tpu.ops import attention as attn_mod
+
+        mha = nn.MultiHeadAttention(128, 2)
+        mha.eval()
+        p = parameters_dict(mha)
+        x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (2, 128, 128)),
+                        jnp.float32)
+        ref = functional_call(mha, p, (x,))
+        calls = []
+        orig = attn_mod.flash_attention_packed
+
+        def spy(*a, **k):
+            out = orig(*a, **k)
+            calls.append(out is not None)
+            return out
+
+        monkeypatch.setattr(attn_mod, "flash_attention_packed", spy)
+        monkeypatch.setattr(attn_mod, "_is_tpu", lambda: True)
+        out = functional_call(mha, p, (x,))
+        assert calls == [True], "packed path did not engage"
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
