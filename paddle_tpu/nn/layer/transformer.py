@@ -93,7 +93,14 @@ class MultiHeadAttention(Layer):
                     else s_ + attn_mask.astype(jnp.float32)
             weights = jnp.exp(s_ - jnp.max(s_, axis=-1, keepdims=True))
             weights = (weights / jnp.sum(weights, axis=-1, keepdims=True)).astype(q.dtype)
-            out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+            p = weights
+            if self.dropout > 0.0 and self.training:
+                # the reference applies attention dropout on this path too
+                # (transformer.py MultiHeadAttention: F.dropout on weights)
+                from ...nn import functional as _F
+
+                p = _F.dropout(weights, p=self.dropout, training=True)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         else:
             out = attn_ops.flash_attention(
                 q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,

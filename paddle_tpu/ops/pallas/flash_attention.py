@@ -387,6 +387,21 @@ def _bwd(sm_scale, causal, dropout_rate, block_q, block_k, res, g):
 _flash_attention_bhsd.defvjp(_fwd, _bwd)
 
 
+def _normalize_bias_seed(bias, seed, b, s):
+    """Shared by the standard and packed wrappers: pad-bias broadcast with
+    the non-differentiable contract, and int32 seed normalization."""
+    if bias is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    else:
+        bias = jax.lax.stop_gradient(
+            jnp.broadcast_to(bias.astype(jnp.float32), (b, s)))
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    return bias, seed
+
+
 def supported(seq_len: int, head_dim: int) -> bool:
     """Shapes the kernel handles: sublane-aligned head_dim (64 covers the
     BERT/ERNIE family; Mosaic pads lanes), block-divisible seq."""
@@ -422,19 +437,9 @@ def flash_attention(q, k, v, bias=None, sm_scale=None, causal=False,
             raise ValueError(
                 f"flash_attention requires seq_len % 128 == 0 on TPU, got {s}")
         bq, bk = max(bq, 128), max(bk, 128)
-    if bias is None:
-        bias = jnp.zeros((b, s), jnp.float32)
-    else:
-        # The kernel does not emit a bias gradient (padding masks carry no
-        # trainable state).  stop_gradient makes that zero-grad behaviour
-        # explicit at the trace level; the docstring carries the warning —
-        # a learned (ALiBi-style) bias must NOT be passed here.
-        bias = jax.lax.stop_gradient(
-            jnp.broadcast_to(bias.astype(jnp.float32), (b, s)))
-    if seed is None:
-        seed = jnp.zeros((1,), jnp.int32)
-    else:
-        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    # bias is non-differentiable (padding masks carry no trainable state;
+    # the docstring carries the learned-bias warning)
+    bias, seed = _normalize_bias_seed(bias, seed, b, s)
     merged = lambda x: x.reshape(b * h, s, d)
     out = _flash_attention_bhsd(merged(q), merged(k), merged(v), bias, seed,
                                 sm_scale, causal, float(dropout_rate), bq, bk)

@@ -5,10 +5,11 @@ which forces the model to materialize (b, s, h, d) -> (b, h, s, d)
 transposes around every attention call — measured ~19 ms/step of pure
 layout copies on the ERNIE flagship.  This variant reads the projection
 output LAYOUT DIRECTLY: blocks are (1, block_q, 2*dim) slices of the
-(b, s, h*d) array covering a PAIR of heads (Mosaic requires 128-divisible
-lane blocks; head_dim is 64 on the BERT/ERNIE family), and each grid cell
-runs the online-softmax recursion for its two heads back to back.  No
-transpose ever exists in the program.
+(b, s, h*d) array covering 128 lanes of heads (Mosaic requires
+128-divisible lane blocks): a PAIR of 64-wide heads (BERT/ERNIE family) or
+ONE 128-wide head (LLaMA-class models); each grid cell runs the
+online-softmax recursion for its heads back to back.  No transpose ever
+exists in the program.
 
 Numerics, dropout (hardware-PRNG per-tile reseed keyed by the GLOBAL head
 index, replayable in both backward kernels), bias handling, and the matmul
@@ -31,6 +32,7 @@ from .flash_attention import (
     NEG_INF,
     _interpret,
     _keep_mask,
+    _normalize_bias_seed,
     _smem,
 )
 
@@ -49,10 +51,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     else:
         num_kv_iter = num_kv
 
-    for head in (0, 1):
+    for head in range(128 // head_dim):
         lo = head * head_dim
         q = q2[:, lo:lo + head_dim]
-        bh_global = pair * 2 + head     # dropout stream key
+        bh_global = pair * (128 // head_dim) + head  # dropout stream key
 
         def body(kv_idx, carry, q=q, bh_global=bh_global, lo=lo):
             acc, m_prev, l_prev = carry
@@ -101,11 +103,11 @@ def _bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     num_q = seq_len // block_q
     qi_start = (kv_idx * block_k) // block_q if causal else 0
 
-    for head in (0, 1):
+    for head in range(128 // head_dim):
         lo = head * head_dim
         k = k_ref[0, :, lo:lo + head_dim]       # (block_k, d)
         v = v_ref[0, :, lo:lo + head_dim]
-        bh_global = pair * 2 + head
+        bh_global = pair * (128 // head_dim) + head
 
         def body(qi, carry, k=k, v=v, bh_global=bh_global, lo=lo, head=head):
             dk_acc, dv_acc = carry
@@ -157,13 +159,15 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     else:
         num_kv_iter = num_kv
 
-    for head in (0, 1):
+    for head in range(128 // head_dim):
         lo = head * head_dim
         q = q_ref[0, :, lo:lo + head_dim]
         do = do_ref[0, :, lo:lo + head_dim]
-        lse = lse_ref[0, 0, head]
-        delta = delta_ref[0, 0, head]
-        bh_global = pair * 2 + head
+        # lse/delta ride full-seq blocks (shared spec with the dkdv kernel);
+        # this cell only needs its q-block slice
+        lse = lse_ref[0, 0, head, pl.dslice(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, head, pl.dslice(qi * block_q, block_q)]
+        bh_global = pair * (128 // head_dim) + head
 
         def body(kv_idx, dq_acc, q=q, do=do, lse=lse, delta=delta,
                  bh_global=bh_global, lo=lo):
@@ -195,13 +199,13 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dq_ref[0, :, lo:lo + head_dim] = dq.astype(dq_ref.dtype)
 
 
-def _specs(b, seq_len, hd, pairs, block, full_seq=False):
-    """BlockSpec over the packed (b, seq, h*d) array: dim2 indexed by pair."""
-    width = 2 * hd
-    if full_seq:
-        return pl.BlockSpec((1, seq_len, width),
+def _specs(seq_len, pairs, block=None):
+    """BlockSpec over the packed (b, seq, h*d) array: dim2 indexed by the
+    128-lane head group; block=None takes the full sequence."""
+    if block is None:
+        return pl.BlockSpec((1, seq_len, 128),
                             lambda p, i: (p // pairs, 0, p % pairs))
-    return pl.BlockSpec((1, block, width),
+    return pl.BlockSpec((1, block, 128),
                         lambda p, i: (p // pairs, i, p % pairs))
 
 
@@ -209,7 +213,8 @@ def _forward(q, k, v, bias, seed, num_heads, sm_scale, causal, dropout_rate,
              block_q, block_k):
     b, seq_len, packed = q.shape
     hd = packed // num_heads
-    pairs = num_heads // 2
+    pairs = packed // 128               # 128-lane head groups
+    hpg = 128 // hd                     # heads per group
     grid = (b * pairs, seq_len // block_q)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -220,19 +225,19 @@ def _forward(q, k, v, bias, seed, num_heads, sm_scale, causal, dropout_rate,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=_smem()),
-            _specs(b, seq_len, hd, pairs, block_q),
-            _specs(b, seq_len, hd, pairs, block_q, full_seq=True),
-            _specs(b, seq_len, hd, pairs, block_q, full_seq=True),
+            _specs(seq_len, pairs, block_q),
+            _specs(seq_len, pairs),
+            _specs(seq_len, pairs),
             pl.BlockSpec((1, 1, seq_len), lambda p, i: (p // pairs, 0, 0)),
         ],
         out_specs=[
-            _specs(b, seq_len, hd, pairs, block_q),
-            pl.BlockSpec((1, 1, 2, block_q),
+            _specs(seq_len, pairs, block_q),
+            pl.BlockSpec((1, 1, hpg, block_q),
                          lambda p, i: (p // pairs, p % pairs, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, pairs, 2, seq_len), jnp.float32),
+            jax.ShapeDtypeStruct((b, pairs, hpg, seq_len), jnp.float32),
         ],
         interpret=_interpret(),
     )(seed, q, k, v, bias.reshape(b, 1, seq_len))
@@ -242,35 +247,36 @@ def _backward(q, k, v, bias, seed, num_heads, o, lse, do, sm_scale, causal,
               dropout_rate, block_q, block_k):
     b, seq_len, packed = q.shape
     hd = packed // num_heads
-    pairs = num_heads // 2
-    # delta = rowsum(do * o) per head: (b, pairs, 2, seq)
+    pairs = packed // 128
+    hpg = 128 // hd
+    # delta = rowsum(do * o) per head: (b, pairs, heads_per_group, seq)
     do4 = do.reshape(b, seq_len, num_heads, hd).astype(jnp.float32)
     o4 = o.reshape(b, seq_len, num_heads, hd).astype(jnp.float32)
     delta = jnp.sum(do4 * o4, axis=-1)               # (b, seq, h)
-    delta = jnp.moveaxis(delta, 1, 2).reshape(b, pairs, 2, seq_len)
+    delta = jnp.moveaxis(delta, 1, 2).reshape(b, pairs, hpg, seq_len)
     bias3 = bias.reshape(b, 1, seq_len)
 
     common = dict(sm_scale=sm_scale, causal=causal, dropout_rate=dropout_rate,
                   block_q=block_q, block_k=block_k, seq_len=seq_len,
                   head_dim=hd)
-    lse_spec = pl.BlockSpec((1, 1, 2, seq_len),
+    lse_spec = pl.BlockSpec((1, 1, hpg, seq_len),
                             lambda p, i: (p // pairs, p % pairs, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, **common),
         grid=(b * pairs, seq_len // block_k),
         in_specs=[
             pl.BlockSpec(memory_space=_smem()),
-            _specs(b, seq_len, hd, pairs, block_k, full_seq=True),   # q
-            _specs(b, seq_len, hd, pairs, block_k),                  # k
-            _specs(b, seq_len, hd, pairs, block_k),                  # v
+            _specs(seq_len, pairs),   # q
+            _specs(seq_len, pairs, block_k),                  # k
+            _specs(seq_len, pairs, block_k),                  # v
             pl.BlockSpec((1, 1, block_k), lambda p, i: (p // pairs, 0, i)),
-            _specs(b, seq_len, hd, pairs, block_k, full_seq=True),   # do
+            _specs(seq_len, pairs),   # do
             lse_spec,
             lse_spec,
         ],
         out_specs=[
-            _specs(b, seq_len, hd, pairs, block_k),
-            _specs(b, seq_len, hd, pairs, block_k),
+            _specs(seq_len, pairs, block_k),
+            _specs(seq_len, pairs, block_k),
         ],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -282,15 +288,15 @@ def _backward(q, k, v, bias, seed, num_heads, o, lse, do, sm_scale, causal,
         grid=(b * pairs, seq_len // block_q),
         in_specs=[
             pl.BlockSpec(memory_space=_smem()),
-            _specs(b, seq_len, hd, pairs, block_q),                  # q
-            _specs(b, seq_len, hd, pairs, block_q, full_seq=True),   # k
-            _specs(b, seq_len, hd, pairs, block_q, full_seq=True),   # v
+            _specs(seq_len, pairs, block_q),                  # q
+            _specs(seq_len, pairs),   # k
+            _specs(seq_len, pairs),   # v
             pl.BlockSpec((1, 1, seq_len), lambda p, i: (p // pairs, 0, 0)),
-            _specs(b, seq_len, hd, pairs, block_q),                  # do
+            _specs(seq_len, pairs, block_q),                  # do
             lse_spec,
             lse_spec,
         ],
-        out_specs=_specs(b, seq_len, hd, pairs, block_q),
+        out_specs=_specs(seq_len, pairs, block_q),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
     )(seed, q, k, v, bias3, do, lse, delta)
@@ -324,8 +330,15 @@ _flash_packed.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def supported(seq_len: int, num_heads: int, head_dim: int) -> bool:
-    return (head_dim == 64 and num_heads % 2 == 0
-            and seq_len % 128 == 0 and seq_len >= 128)
+    """128-lane head groups: pairs of 64-wide heads or single 128-wide
+    heads."""
+    if head_dim == 64:
+        heads_ok = num_heads % 2 == 0
+    elif head_dim == 128:
+        heads_ok = True
+    else:
+        heads_ok = False
+    return heads_ok and seq_len % 128 == 0 and seq_len >= 128
 
 
 def flash_attention_packed(q, k, v, num_heads, bias=None, sm_scale=None,
@@ -336,7 +349,16 @@ def flash_attention_packed(q, k, v, num_heads, bias=None, sm_scale=None,
     flash_attention otherwise (bias is a non-differentiable (b, s_k)
     padding bias; seed drives in-kernel dropout)."""
     b, s, packed = q.shape
+    if packed % num_heads:
+        raise ValueError(f"packed width {packed} not divisible by "
+                         f"num_heads {num_heads}")
     hd = packed // num_heads
+    heads_ok = (hd == 64 and num_heads % 2 == 0) or hd == 128
+    if not heads_ok:
+        raise ValueError(
+            f"flash_attention_packed: unsupported head layout "
+            f"(num_heads={num_heads}, head_dim={hd}); 128-lane groups need "
+            f"head_dim 64 with even heads, or head_dim 128")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)
     bq = min(block_q, s)
@@ -351,14 +373,6 @@ def flash_attention_packed(q, k, v, num_heads, bias=None, sm_scale=None,
                 f"flash_attention_packed requires seq_len % 128 == 0 on "
                 f"TPU, got {s}")
         bq, bk = max(bq, 128), max(bk, 128)
-    if bias is None:
-        bias = jnp.zeros((b, s), jnp.float32)
-    else:
-        bias = jax.lax.stop_gradient(
-            jnp.broadcast_to(bias.astype(jnp.float32), (b, s)))
-    if seed is None:
-        seed = jnp.zeros((1,), jnp.int32)
-    else:
-        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    bias, seed = _normalize_bias_seed(bias, seed, b, s)
     return _flash_packed(q, k, v, bias, seed, int(num_heads), sm_scale,
                          causal, float(dropout_rate), bq, bk)

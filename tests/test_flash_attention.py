@@ -193,6 +193,36 @@ class TestPackedLayout:
             np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                        rtol=1e-5, atol=1e-5, err_msg=name)
 
+    def test_packed_multi_block_and_head_dim_128(self):
+        """seq > block (lse/delta slicing regression) and 128-wide heads."""
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention as std
+        from paddle_tpu.ops.pallas.flash_attention_packed import (
+            flash_attention_packed as packed,
+        )
+
+        for h, d, s in ((2, 64, 1024), (3, 128, 512)):
+            b = 1
+            rng = np.random.default_rng(0)
+            q4, k4, v4 = (jnp.asarray(rng.normal(0, 1, (b, h, s, d)),
+                                      jnp.float32) for _ in range(3))
+            pack = lambda t: jnp.moveaxis(t, 1, 2).reshape(b, s, h * d)
+            ref = std(q4, k4, v4, block_q=256, block_k=256)
+            g_ref = jax.grad(lambda t: (std(t[0], t[1], t[2], block_q=256,
+                                            block_k=256) ** 2).sum())(
+                (q4, k4, v4))
+            out = packed(pack(q4), pack(k4), pack(v4), h, block_q=256,
+                         block_k=256)
+            g_pk = jax.grad(lambda t: (packed(pack(t[0]), pack(t[1]),
+                                              pack(t[2]), h, block_q=256,
+                                              block_k=256) ** 2).sum())(
+                (q4, k4, v4))
+            out4 = jnp.moveaxis(out.reshape(b, s, h, d), 2, 1)
+            np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+            for a, r in zip(g_pk, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                           rtol=1e-5, atol=1e-5)
+
     def test_packed_causal_and_dropout_replay(self):
         from paddle_tpu.ops.pallas.flash_attention import flash_attention as std
         from paddle_tpu.ops.pallas.flash_attention_packed import (
@@ -206,6 +236,29 @@ class TestPackedLayout:
         out4 = jnp.moveaxis(out.reshape(b, s, h, d), 2, 1)
         np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+        # causal MULTI-BLOCK bounds (num_kv_iter clamp / qi_start) incl grads
+        q4, k4, v4, bias, pack = self._data(s=1024)
+        b, h, s, d = q4.shape
+        ref = std(q4, k4, v4, bias=bias, causal=True, block_q=256,
+                  block_k=256)
+        g_ref = jax.grad(lambda t: (std(t[0], t[1], t[2], bias=bias,
+                                        causal=True, block_q=256,
+                                        block_k=256) ** 2).sum())((q4, k4, v4))
+        out = packed(pack(q4), pack(k4), pack(v4), h, bias=bias, causal=True,
+                     block_q=256, block_k=256)
+        g_pk = jax.grad(lambda t: (packed(pack(t[0]), pack(t[1]), pack(t[2]),
+                                          h, bias=bias, causal=True,
+                                          block_q=256, block_k=256) ** 2
+                                   ).sum())((q4, k4, v4))
+        out4 = jnp.moveaxis(out.reshape(b, s, h, d), 2, 1)
+        np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        for a, r in zip(g_pk, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError):
+            packed(pack(q4)[..., :q4.shape[1] * 96 // 64], pack(k4), pack(v4),
+                   h)  # head_dim 96: unsupported layout must raise
         seed = jnp.asarray([5], jnp.int32)
         a1 = packed(pack(q4), pack(k4), pack(v4), h, dropout_rate=0.2,
                     seed=seed)
