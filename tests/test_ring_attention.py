@@ -50,7 +50,9 @@ def test_ring_attention_matches_full(causal):
 
 
 def test_ring_attention_grads_match_full():
-    m = dist.init_parallel_env(sp=4)
+    # sp=2 keeps a real multi-hop ring (the fwd test covers sp=4) while
+    # halving the unrolled-ring AD compile that dominated suite cold time
+    m = dist.init_parallel_env(sp=2)
     q, k, v = _qkv(s=16)
 
     def ref_loss(q_, k_, v_):
