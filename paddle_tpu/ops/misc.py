@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
-    "affine_grid", "cos_sim", "crop_tensor", "frobenius_norm",
+    "affine_grid", "cos_sim", "crop_tensor", "cvm", "data_norm",
+    "frobenius_norm", "nce_loss", "sequence_conv",
     "grid_sampler", "l1_norm", "lrn", "max_pool2d_with_index", "minus",
     "multiplex", "p_norm", "pad_constant_like", "pixel_shuffle",
     "pixel_unshuffle", "rank_loss", "reverse", "roi_pool", "row_conv",
@@ -365,3 +366,103 @@ def row_conv(x, weight, lengths=None):
     if lengths is not None:
         out = out * m[..., None]
     return out
+
+
+def sequence_conv(x, weight, lengths=None, context_length=3,
+                  context_start=None):
+    """ref sequence_conv_op.cc: windowed conv over each sequence's time
+    axis.  x (b, s, din); weight (context_length*din, dout); the window for
+    step t covers [t+context_start, t+context_start+context_length) with
+    zero padding outside the valid range (the reference's LoD boundaries
+    become the padded-layout length mask)."""
+    x = jnp.asarray(x)
+    b, s, din = x.shape
+    if context_start is None:
+        # ref sequence_lod.py: padding_start=None fills context_length/2
+        # (C-truncated) steps of past context
+        context_start = -(context_length // 2)
+    if lengths is not None:
+        from .sequence import sequence_mask
+
+        m = sequence_mask(lengths, s, dtype=x.dtype)
+        x = x * m[..., None]
+    cols = []
+    for i in range(context_length):
+        off = context_start + i
+        if abs(off) >= s:          # window entirely outside: all padding
+            shifted = jnp.zeros_like(x)
+        elif off < 0:
+            shifted = jnp.pad(x[:, :s + off], ((0, 0), (-off, 0), (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            shifted = x
+        cols.append(shifted)
+    im2col = jnp.concatenate(cols, axis=-1)        # (b, s, ctx*din)
+    out = im2col @ jnp.asarray(weight)
+    if lengths is not None:
+        out = out * m[..., None]
+    return out
+
+
+def nce_loss(input, label, weight, bias, sample_ids,
+             num_total_classes=None):
+    """ref nce_op.cc (noise-contrastive estimation): the NCE objective with
+    the noise prior folded in.  With o = exp(logit) and the uniform noise
+    prior B = num_neg / num_total_classes the per-term costs are
+    -log(o / (o + B)) for the true class and -log(B / (o + B)) for each
+    sampled negative (nce_op.h forward), equivalently logistic losses on
+    logit - log(B).
+
+    input (b, dim); label (b,) int; weight (num_classes, dim); bias
+    (num_classes,); sample_ids (b, num_neg) int negatives (drawn by the
+    caller — sampling is explicit on TPU, the reference uses an in-op
+    uniform sampler).  Returns (b, 1) loss.
+    """
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    sample_ids = jnp.asarray(sample_ids).astype(jnp.int32)
+    weight = jnp.asarray(weight)
+    if num_total_classes is None:
+        num_total_classes = weight.shape[0]
+    num_neg = sample_ids.shape[1]
+    log_b = jnp.log(jnp.asarray(num_neg / num_total_classes, jnp.float32))
+    w_pos = weight[label]                          # (b, dim)
+    b_pos = jnp.asarray(bias)[label]
+    pos_logit = jnp.sum(input * w_pos, axis=-1) + b_pos
+    w_neg = weight[sample_ids]                     # (b, k, dim)
+    b_neg = jnp.asarray(bias)[sample_ids]
+    neg_logit = jnp.einsum("bd,bkd->bk", input, w_neg) + b_neg
+    pos_loss = jnp.logaddexp(0.0, -(pos_logit - log_b))
+    neg_loss = jnp.logaddexp(0.0, neg_logit - log_b).sum(-1)
+    return (pos_loss + neg_loss)[:, None]
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """ref data_norm_op.cc (CTR models): normalize by accumulated batch
+    statistics and return the updated accumulators.
+
+    Returns (y, new_batch_size, new_batch_sum, new_batch_square_sum); the
+    caller owns the state (functional, like batch_norm here)."""
+    x = jnp.asarray(x)
+    mean = batch_sum / batch_size
+    # ref data_norm_op.cc:301-302: scales = sqrt(batch_size /
+    # batch_square_sum) — NO mean^2 subtraction (the accumulator convention
+    # is the op's contract; epsilon guards the fresh-state case)
+    scale = jnp.sqrt(batch_size / (batch_square_sum + epsilon))
+    y = (x - mean) * scale
+    n = x.shape[0]
+    return (y, batch_size + n, batch_sum + x.sum(axis=0),
+            batch_square_sum + jnp.square(x).sum(axis=0))
+
+
+def cvm(x, use_cvm=True):
+    """ref cvm_op.cc (continuous value model for CTR): the first two
+    features are show/click counts; with use_cvm they become
+    log(show+1) and log(click+1)-log(show+1), else they are dropped."""
+    x = jnp.asarray(x)
+    if use_cvm:
+        show = jnp.log(x[:, 0:1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]

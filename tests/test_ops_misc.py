@@ -187,3 +187,84 @@ def test_lrn_even_window_alignment():
         hi = min(6, c - (n - 1) // 2 + n)     # 2 right
         ref[:, c] = xn[:, c] / ((k + alpha * sq[:, lo:hi].sum(1)) ** beta)
     np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_sequence_conv_window_and_mask():
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.normal(0, 1, (2, 5, 3)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 1, (9, 4)), jnp.float32)  # ctx 3 * din 3
+    lens = jnp.asarray([5, 3])
+    out = np.asarray(M.sequence_conv(x, w, lengths=lens, context_length=3))
+    assert out.shape == (2, 5, 4)
+    # manual: window [-1, 0, 1] with zero pad and length masking
+    xm = np.asarray(x).copy()
+    xm[1, 3:] = 0
+    ref = np.zeros((2, 5, 4), np.float32)
+    wn = np.asarray(w)
+    for bi in range(2):
+        for t in range(5):
+            parts = []
+            for off in (-1, 0, 1):
+                tt = t + off
+                parts.append(xm[bi, tt] if 0 <= tt < 5 else np.zeros(3))
+            ref[bi, t] = np.concatenate(parts) @ wn
+    ref[1, 3:] = 0
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_nce_loss_matches_manual():
+    rng = np.random.default_rng(11)
+    b, dim, C, k = 4, 6, 10, 3
+    x = jnp.asarray(rng.normal(0, 1, (b, dim)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 1, (C, dim)), jnp.float32)
+    bias = jnp.asarray(rng.normal(0, 1, (C,)), jnp.float32)
+    label = jnp.asarray(rng.integers(0, C, (b,)))
+    negs = jnp.asarray(rng.integers(0, C, (b, k)))
+    out = np.asarray(M.nce_loss(x, label, w, bias, negs))
+    xn, wn, bn = map(np.asarray, (x, w, bias))
+    log_b = np.log(k / C)   # uniform noise prior num_neg/num_classes
+    for bi in range(b):
+        pos = xn[bi] @ wn[int(label[bi])] + bn[int(label[bi])]
+        loss = np.log1p(np.exp(-(pos - log_b)))
+        for ni in np.asarray(negs)[bi]:
+            neg = xn[bi] @ wn[ni] + bn[ni]
+            loss += np.log1p(np.exp(neg - log_b))
+        np.testing.assert_allclose(out[bi, 0], loss, rtol=1e-5)
+
+
+def test_sequence_conv_even_window_and_far_offsets():
+    # even context: paddle pads context_length//2 PAST steps (review r03)
+    x = jnp.asarray(np.arange(6, dtype="float32").reshape(1, 3, 2))
+    w = jnp.asarray(np.eye(8, 1, k=0), jnp.float32)  # picks first tap dim 0
+    out = np.asarray(M.sequence_conv(x, w, context_length=4))
+    # first tap offset = -2: rows [pad, pad, x0]
+    np.testing.assert_allclose(out[0, :, 0], [0.0, 0.0, 0.0])
+    # far offsets degenerate to all-padding without shape errors
+    out2 = M.sequence_conv(x, jnp.zeros((8, 1)), context_length=4,
+                           context_start=-7)
+    assert out2.shape == (1, 3, 1)
+
+
+def test_data_norm_and_cvm():
+    rng = np.random.default_rng(12)
+    x = jnp.asarray(rng.normal(5, 2, (8, 3)), jnp.float32)
+    bs = jnp.asarray(100.0)
+    bsum = jnp.asarray(rng.normal(500, 10, (3,)), jnp.float32)
+    bsq = jnp.asarray(np.abs(rng.normal(3000, 100, (3,))), jnp.float32)
+    y, nbs, nsum, nsq = M.data_norm(x, bs, bsum, bsq)
+    mean = np.asarray(bsum) / 100.0
+    scale = np.sqrt(100.0 / (np.asarray(bsq) + 1e-4))  # ref formula
+    np.testing.assert_allclose(np.asarray(y),
+                               (np.asarray(x) - mean) * scale, rtol=1e-4)
+    assert float(nbs) == 108.0
+    np.testing.assert_allclose(np.asarray(nsum),
+                               np.asarray(bsum) + np.asarray(x).sum(0),
+                               rtol=1e-5)
+
+    feats = jnp.asarray([[3.0, 1.0, 0.5, 0.7]])
+    out = np.asarray(M.cvm(feats))
+    np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.log(2.0) - np.log(4.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2:], [0.5, 0.7])
+    assert M.cvm(feats, use_cvm=False).shape == (1, 2)
