@@ -1485,3 +1485,60 @@ def row_conv(input, future_context_size, sequence_length=None,
         inputs["Lengths"] = [sequence_length.name]
     _append("row_conv", inputs, {"Out": [out.name]}, {})
     return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, padding_start=None,
+                  sequence_length=None, param_attr=None, bias_attr=None,
+                  act=None, name=None) -> Variable:
+    """ref fluid/layers/sequence_lod.py sequence_conv -> sequence_conv_op:
+    windowed conv over each padded sequence's time axis."""
+    din = input.shape[-1]
+    w = create_parameter((filter_size * din, num_filters), input.dtype,
+                         attr=param_attr, name=f"{name}.w" if name else None)
+    out = _out(input.dtype, tuple(input.shape[:-1]) + (num_filters,))
+    inputs = {"X": [input.name], "Filter": [w.name]}
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length.name]
+    _append("sequence_conv_padded", inputs, {"Out": [out.name]},
+            {"contextLength": int(filter_size),
+             "contextStart": padding_start})
+    res = out
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), input.dtype, attr=bias_attr,
+                             default_initializer=I.Constant(0.0),
+                             name=f"{name}.b" if name else None)
+        res = elementwise_add(out, b, axis=len(out.shape) - 1)
+    return _apply_act(res, act)
+
+
+def nce(input, label, num_total_classes, sample_ids, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py nce -> nce_op.cc.  The reference samples
+    negatives inside the op; the TPU-native contract takes explicit
+    ``sample_ids`` (batch, num_neg) — sampling is data-pipeline work, and
+    an in-graph sampler would re-trace per draw."""
+    if num_neg_samples is not None and \
+            int(num_neg_samples) != int(sample_ids.shape[-1]):
+        raise ValueError(
+            f"nce: num_neg_samples={num_neg_samples} disagrees with "
+            f"sample_ids width {sample_ids.shape[-1]} — the noise prior "
+            "comes from the drawn negatives")
+    dim = input.shape[-1]
+    w = create_parameter((num_total_classes, dim), input.dtype,
+                         attr=param_attr, name=f"{name}.w" if name else None)
+    if bias_attr is not False:
+        b = create_parameter((num_total_classes,), input.dtype,
+                             attr=bias_attr,
+                             default_initializer=I.Constant(0.0),
+                             name=f"{name}.b" if name else None)
+        bias_name = b.name
+    else:
+        zb = fill_constant((num_total_classes,), input.dtype, 0.0)
+        bias_name = zb.name
+    out = _out(input.dtype, (input.shape[0], 1))
+    _append("nce", {"Input": [input.name], "Label": [label.name],
+                    "Weight": [w.name], "Bias": [bias_name],
+                    "SampleIds": [sample_ids.name]},
+            {"Cost": [out.name]},
+            {"num_total_classes": int(num_total_classes)})
+    return out

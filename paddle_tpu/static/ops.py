@@ -1025,3 +1025,27 @@ def _row_conv(ins, attrs, op):
     lengths = ins.get("Lengths")
     return {"Out": [_misc.row_conv(_one(ins, "X"), _one(ins, "Filter"),
                                    lengths=lengths[0] if lengths else None)]}
+
+
+@register_op("sequence_conv_padded")
+def _sequence_conv_padded(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    lengths = ins.get("Lengths")
+    out = _misc.sequence_conv(
+        _one(ins, "X"), _one(ins, "Filter"),
+        lengths=lengths[0] if lengths else None,
+        context_length=attrs["contextLength"],
+        context_start=attrs.get("contextStart"))
+    return {"Out": [out]}
+
+
+@register_op("nce")
+def _nce(ins, attrs, op):
+    from ..ops import misc as _misc
+
+    cost = _misc.nce_loss(_one(ins, "Input"), _one(ins, "Label"),
+                          _one(ins, "Weight"), _one(ins, "Bias"),
+                          _one(ins, "SampleIds"),
+                          num_total_classes=attrs.get("num_total_classes"))
+    return {"Cost": [cost]}

@@ -126,3 +126,31 @@ def test_dynamic_lstm_trains_through_backward(_progs):
                       fetch_list=[loss])
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_sequence_conv_and_nce_layers(_progs):
+    """fluid sequence_conv + nce layer functions train end to end."""
+    main, startup = _progs
+    x = L.data("x", [S, H])
+    xl = L.data("xl", [], dtype="int64")
+    lab = L.data("lab", [], dtype="int64")
+    negs = L.data("negs", [3], dtype="int64")
+    conv = L.sequence_conv(x, 2 * H, filter_size=3, sequence_length=xl,
+                           act="relu")
+    pooled = L.sequence_pool(conv, "average", xl)
+    cost = L.nce(pooled, lab, 12, negs)
+    loss = L.mean(cost)
+    static.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(13)
+    losses = []
+    for i in range(15):
+        feed = {"x": rng.normal(0, 1, (B, S, H)).astype("float32"),
+                "xl": np.array([S, 3, 4, 2], np.int64),
+                "lab": rng.integers(0, 12, (B,)).astype(np.int64),
+                "negs": rng.integers(0, 12, (B, 3)).astype(np.int64)}
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(float(lv))
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]
