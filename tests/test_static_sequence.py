@@ -154,3 +154,38 @@ def test_sequence_conv_and_nce_layers(_progs):
         assert np.isfinite(float(lv))
         losses.append(float(lv))
     assert losses[-1] < losses[0]
+
+
+def test_nets_sequence_conv_pool_and_attention(_progs):
+    from paddle_tpu.static import nets
+
+    main, startup = _progs
+    x = L.data("x", [S, H])
+    xl = L.data("xl", [], dtype="int64")
+    pooled = nets.sequence_conv_pool(x, 2 * H, 3, xl)
+    q = L.data("q", [S, H])
+    ctx = nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+    loss = L.mean(pooled) + L.mean(ctx)
+    static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(14)
+    lv, cv = exe.run(main, feed={"x": rng.normal(0, 1, (B, S, H)).astype("float32"),
+                                 "xl": np.array([S, 3, 4, 2], np.int64),
+                                 "q": rng.normal(0, 1, (B, S, H)).astype("float32")},
+                     fetch_list=[loss, ctx])
+    assert np.isfinite(float(lv))
+    assert cv.shape == (B, S, H)
+    # oracle: single-head attention equals jnp softmax attention
+    import jax.numpy as jnp
+    import jax
+    qn = rng.normal(0, 1, (2, 4, 6)).astype("float32")
+    main2, startup2 = static.Program(), static.Program()
+    with static.program_guard(main2, startup2):
+        qq = L.data("qq", [4, 6])
+        out = nets.scaled_dot_product_attention(qq, qq, qq)
+    exe.run(startup2)
+    got, = exe.run(main2, feed={"qq": qn}, fetch_list=[out])
+    s_ = jnp.einsum("bqd,bkd->bqk", qn, qn) / np.sqrt(6)
+    ref = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s_, axis=-1), qn)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-5)
