@@ -37,7 +37,13 @@ from .common import (
     unfold,
     upsample,
 )
-from .conv import conv1d, conv2d, conv2d_transpose, conv3d
+from .conv import (
+    conv1d,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+)
 from .norm import batch_norm, group_norm, instance_norm, layer_norm, normalize, rms_norm
 from .pooling import (
     adaptive_avg_pool2d,

@@ -113,3 +113,34 @@ def _deconv_single(x, weight, stride, pad, dilation):
         rhs_dilation=dilation,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    """ref: conv3d_transpose (conv_transpose_op.cc); weight layout
+    (in_c, out_c/groups, kd, kh, kw) like conv2d_transpose."""
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    output_padding = _pair(output_padding, 3)
+
+    def one(x, w):
+        wf = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)
+        ks = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(3)]
+        pads = [(ks[i] - 1 - padding[i],
+                 ks[i] - 1 - padding[i] + output_padding[i])
+                for i in range(3)]
+        return lax.conv_general_dilated(
+            x, wf, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        out = jnp.concatenate([one(xi, wi) for xi, wi in zip(xs, ws)], axis=1)
+    else:
+        out = one(x, weight)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "affine_grid", "cos_sim", "crop_tensor", "cvm", "data_norm",
-    "frobenius_norm", "nce_loss", "sequence_conv",
+    "frobenius_norm", "nce_loss", "sequence_conv", "spectral_norm",
     "grid_sampler", "l1_norm", "lrn", "max_pool2d_with_index", "minus",
     "multiplex", "p_norm", "pad_constant_like", "pixel_shuffle",
     "pixel_unshuffle", "rank_loss", "reverse", "roi_pool", "row_conv",
@@ -466,3 +466,27 @@ def cvm(x, use_cvm=True):
         click = jnp.log(x[:, 1:2] + 1.0) - show
         return jnp.concatenate([show, click, x[:, 2:]], axis=1)
     return x[:, 2:]
+
+
+def spectral_norm(weight, u, dim=0, power_iters=1, epsilon=1e-12):
+    """ref spectral_norm_op.cc: normalize a weight by its largest singular
+    value estimated with power iteration.
+
+    weight: any-rank tensor treated as a matrix with ``dim`` as rows;
+    u: (rows,) running left singular vector.  Returns
+    (weight / sigma, new_u) — the caller owns u (functional state, like
+    batch_norm's running stats here)."""
+    w = jnp.asarray(weight)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # (rows, cols)
+    u = jnp.asarray(u)
+
+    def norm(x):
+        return x / (jnp.linalg.norm(x) + epsilon)
+
+    v = None
+    for _ in range(max(1, int(power_iters))):
+        v = norm(mat.T @ u)
+        u = norm(mat @ v)
+    sigma = u @ mat @ v
+    return w / sigma, u

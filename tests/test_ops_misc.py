@@ -268,3 +268,43 @@ def test_data_norm_and_cvm():
                                rtol=1e-5)
     np.testing.assert_allclose(out[0, 2:], [0.5, 0.7])
     assert M.cvm(feats, use_cvm=False).shape == (1, 2)
+
+
+def test_spectral_norm_power_iteration():
+    rng = np.random.default_rng(15)
+    w = jnp.asarray(rng.normal(0, 1, (6, 4)), jnp.float32)
+    u = jnp.asarray(rng.normal(0, 1, (6,)), jnp.float32)
+    wn, u = M.spectral_norm(w, u, power_iters=30)
+    # after enough iterations the top singular value of wn is ~1
+    s_top = np.linalg.svd(np.asarray(wn), compute_uv=False)[0]
+    np.testing.assert_allclose(s_top, 1.0, rtol=1e-4)
+    # conv-kernel layout: dim 0 rows
+    w4 = jnp.asarray(rng.normal(0, 1, (5, 3, 2, 2)), jnp.float32)
+    wn4, _ = M.spectral_norm(w4, jnp.ones((5,)), power_iters=30)
+    s_top4 = np.linalg.svd(np.asarray(wn4).reshape(5, -1),
+                           compute_uv=False)[0]
+    np.testing.assert_allclose(s_top4, 1.0, rtol=1e-4)
+
+
+def test_conv3d_transpose_shapes_and_adjoint():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(16)
+    x = jnp.asarray(rng.normal(0, 1, (2, 3, 4, 5, 6)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 1, (3, 4, 3, 3, 3)), jnp.float32)
+    y = F.conv3d_transpose(x, w, stride=2, padding=1, output_padding=1)
+    assert y.shape == (2, 4, 8, 10, 12)
+    # conv_transpose is the adjoint of conv (same stride/padding): the grad
+    # of <conv3d(z, w), x> w.r.t. z equals conv3d_transpose(x, w) up to the
+    # output_padding tail, so compare against lax autodiff directly
+    z = jnp.asarray(rng.normal(0, 1, (2, 3, 4, 5, 6)), jnp.float32)
+    cot = jnp.asarray(rng.normal(0, 1, F.conv3d(z, jnp.swapaxes(w, 0, 1),
+                                                stride=1,
+                                                padding=1).shape),
+                      jnp.float32)
+    g = jax.grad(lambda z_: jnp.sum(F.conv3d(z_, jnp.swapaxes(w, 0, 1),
+                                             stride=1, padding=1) * cot))(z)
+    ref = F.conv3d_transpose(cot, jnp.swapaxes(
+        jnp.swapaxes(w, 0, 1), 0, 1), stride=1, padding=1)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
