@@ -79,13 +79,20 @@ PD_Predictor* PD_PredictorCreate(const char* model_path,
   }
   const char* py = python_exe ? python_exe : "python3";
   int in_pipe[2], out_pipe[2];
-  if (pipe(in_pipe) != 0 || pipe(out_pipe) != 0) {
+  if (pipe(in_pipe) != 0) {
     SetError("pipe() failed");
+    return nullptr;
+  }
+  if (pipe(out_pipe) != 0) {
+    SetError("pipe() failed");
+    close(in_pipe[0]); close(in_pipe[1]);
     return nullptr;
   }
   pid_t pid = fork();
   if (pid < 0) {
     SetError("fork() failed");
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
     return nullptr;
   }
   if (pid == 0) {

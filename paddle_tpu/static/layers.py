@@ -466,6 +466,10 @@ def split(x, num_or_sections, dim=0):
     if isinstance(num_or_sections, int):
         n = num_or_sections
         attrs = {"num": n, "axis": dim}
+        if x.shape[ax] >= 0 and x.shape[ax] % n != 0:
+            raise ValueError(
+                f"split: dimension {ax} of size {x.shape[ax]} is not "
+                f"divisible into {n} equal sections")
         sizes = [x.shape[ax] // n if x.shape[ax] >= 0 else -1] * n
     else:
         n = len(num_or_sections)

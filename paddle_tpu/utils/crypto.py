@@ -10,17 +10,30 @@ CTR mode, driven over ctypes; files carry a 16-byte random IV header.
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import hmac as _hmac
 import os
 from typing import Optional
 
 from ..core import native as _native
 
-_MAGIC = b"PDTPU\x01"  # file header: magic + 16-byte IV
+_MAGIC = b"PDTPU\x01"   # legacy v1 header: magic + 16-byte IV (no auth tag)
+_MAGIC2 = b"PDTPU\x02"  # v2 header: magic + IV + ct + HMAC-SHA256(iv||ct)
+_TAG_LEN = 32
 
 
 class Cipher:
     """AES-CTR cipher (ref cipher.h Cipher).  ``key`` is 16/24/32 raw
-    bytes."""
+    bytes.
+
+    Blobs are authenticated: encrypt() appends an HMAC-SHA256 tag (keyed by
+    a digest-separated derivation of ``key``) over ``iv || ciphertext``, and
+    decrypt() rejects tampered or truncated blobs with ``ValueError``.
+    There is no unauthenticated fallback — pre-tag v1 blobs (``PDTPU\\x01``,
+    never shipped) are rejected, so the tag cannot be stripped by rewriting
+    the magic (downgrade attack).  The reference's AESCipher
+    (aes_cipher.cc) is unauthenticated; this is a deliberate strengthening.
+    """
 
     def __init__(self, key: bytes):
         if len(key) not in (16, 24, 32):
@@ -44,18 +57,38 @@ class Cipher:
                 raise RuntimeError("pd_aes_ctr_crypt failed")
         return bytes(buf)
 
+    def _mac_key(self) -> bytes:
+        return hashlib.sha256(b"pdtpu-mac:" + self._key).digest()
+
     def encrypt(self, plaintext: bytes, iv: Optional[bytes] = None) -> bytes:
-        """Returns header || iv || ciphertext (ref AESCipher::Encrypt)."""
+        """Returns header || iv || ciphertext || tag (ref AESCipher::Encrypt,
+        plus integrity the reference lacks)."""
         iv = os.urandom(16) if iv is None else bytes(iv)
         if len(iv) != 16:
             raise ValueError("IV must be 16 bytes")
-        return _MAGIC + iv + self._crypt(plaintext, iv)
+        ct = self._crypt(plaintext, iv)
+        tag = _hmac.new(self._mac_key(), iv + ct, hashlib.sha256).digest()
+        return _MAGIC2 + iv + ct + tag
 
     def decrypt(self, blob: bytes) -> bytes:
-        if blob[:len(_MAGIC)] != _MAGIC:
-            raise ValueError("not a paddle_tpu encrypted blob (bad magic)")
-        iv = blob[len(_MAGIC):len(_MAGIC) + 16]
-        return self._crypt(blob[len(_MAGIC) + 16:], iv)
+        if blob[:len(_MAGIC2)] == _MAGIC2:
+            body = blob[len(_MAGIC2):]
+            if len(body) < 16 + _TAG_LEN:
+                raise ValueError("encrypted blob truncated")
+            iv, ct, tag = body[:16], body[16:-_TAG_LEN], body[-_TAG_LEN:]
+            want = _hmac.new(self._mac_key(), iv + ct,
+                             hashlib.sha256).digest()
+            if not _hmac.compare_digest(tag, want):
+                raise ValueError(
+                    "encrypted blob failed authentication (wrong key or "
+                    "tampered data)")
+            return self._crypt(ct, iv)
+        if blob[:len(_MAGIC)] == _MAGIC:
+            raise ValueError(
+                "unauthenticated v1 blob rejected (re-encrypt with the "
+                "current format; v1 acceptance would enable a tag-stripping "
+                "downgrade)")
+        raise ValueError("not a paddle_tpu encrypted blob (bad magic)")
 
     def encrypt_to_file(self, plaintext: bytes, path: str) -> None:
         """ref AESCipher::EncryptToFile."""

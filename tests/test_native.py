@@ -229,7 +229,8 @@ class TestCrypto:
         iv = binascii.unhexlify("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
         pt = binascii.unhexlify("6bc1bee22e409f96e93d7e117393172a")
         blob = Cipher(key).encrypt(pt, iv=iv)
-        ct = blob[-len(pt):]
+        # blob = magic || iv || ct || 32-byte hmac tag
+        ct = blob[-32 - len(pt):-32]
         assert ct.hex() == "601ec313775789a5b7a7f504bbf3d228"
 
     def test_roundtrip_and_file(self, tmp_path):
@@ -241,8 +242,18 @@ class TestCrypto:
         p = str(tmp_path / "m.enc")
         c.encrypt_to_file(msg, p)
         assert Cipher(key).decrypt_from_file(p) == msg
-        # wrong key yields garbage, not the plaintext
-        assert Cipher(generate_key(32)).decrypt_from_file(p) != msg
+        # wrong key fails authentication
+        with pytest.raises(ValueError, match="authentication"):
+            Cipher(generate_key(32)).decrypt_from_file(p)
+        # tampered ciphertext fails authentication
+        blob = bytearray(c.encrypt(msg))
+        blob[30] ^= 0xFF
+        with pytest.raises(ValueError, match="authentication"):
+            c.decrypt(bytes(blob))
+        # v1 magic (tag-stripping downgrade) is rejected, not decrypted
+        v1 = b"PDTPU\x01" + c.encrypt(msg)[6:-32]
+        with pytest.raises(ValueError, match="downgrade"):
+            c.decrypt(v1)
         with pytest.raises(ValueError):
             Cipher(b"short")
         with pytest.raises(ValueError):
