@@ -45,6 +45,19 @@ from .ops import __all__ as _ops_all
 
 from . import ops as tensor  # paddle.tensor namespace alias
 
+# Gradient-tape instrumentation: rebind the op library (module + the
+# top-level re-exports above) to tape-aware wrappers so eager calls under
+# dygraph.guard() record backward nodes (core/tape.py; ref imperative
+# Tracer::TraceOp).  A disabled tape costs one bool check per call.
+from . import ops as _ops_mod
+from .core import tape as _tape
+
+_tape.wrap_namespace(_ops_mod, _ops_all)
+for _n in _ops_all:
+    globals()[_n] = getattr(_ops_mod, _n)
+no_grad = _tape.no_grad_ctx
+del _n
+
 __version__ = "0.1.0"
 
 
@@ -58,6 +71,7 @@ def is_tensor(x) -> bool:
 # import cycles; `paddle_tpu.nn` etc. resolve on first attribute access.
 _LAZY_SUBMODULES = (
     "nn",
+    "dygraph",
     "optimizer",
     "amp",
     "autograd",

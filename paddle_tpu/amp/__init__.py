@@ -163,16 +163,37 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good = 0
 
-    def step(self, optimizer, grads):
-        """Unscale, skip on non-finite, else optimizer.step(grads)."""
+    def step(self, optimizer, grads=None):
+        """Unscale, skip on non-finite, else optimizer.step(grads).
+
+        With ``grads=None`` (tape mode: ``scaler.scale(loss).backward()``
+        populated the parameters' ``.grad`` slots), the bound parameters'
+        tape grads are unscaled in place before the optimizer reads them
+        (ref AmpScaler.minimize → _unscale on the tracked grad vars).
+        """
+        if grads is None:
+            params = [p for p in (optimizer._parameters or [])
+                      if getattr(p, "grad", None) is not None]
+            if self._enable:
+                unscaled = self.unscale_([p.grad for p in params])
+                for p, g in zip(params, unscaled):
+                    p._leaf.grad = g
+            if not self._found_inf:
+                optimizer.step(None)
+            return not self._found_inf
         grads = self.unscale_(grads)
         if not self._found_inf:
             optimizer.step(grads)
         return not self._found_inf
 
-    def minimize(self, optimizer, scaled_loss_grads):
-        """ref AmpScaler.minimize — here grads come from the caller (no
-        global tape): behaves like step()."""
+    def minimize(self, optimizer, scaled_loss_grads=None):
+        """ref AmpScaler.minimize(optimizer, scaled_loss) — the reference
+        passes the *scaled loss tensor*; grads come from the tape.  A
+        list/tuple/dict argument is treated as explicit grads instead
+        (this framework's functional calling style)."""
+        if scaled_loss_grads is not None and not isinstance(
+                scaled_loss_grads, (list, tuple, dict)):
+            scaled_loss_grads = None  # reference contract: it's the loss
         return self.step(optimizer, scaled_loss_grads)
 
     def state_dict(self) -> Dict[str, Any]:

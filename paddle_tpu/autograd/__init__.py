@@ -121,11 +121,8 @@ def grad(layer: Layer, loss_fn: Callable):
     return compute
 
 
-@contextlib.contextmanager
-def no_grad():
-    """API-parity context (ref: paddle.no_grad).  Gradients in this framework
-    are explicit functional transforms, so this is a no-op marker."""
-    yield
+from ..core.tape import backward, no_grad_ctx as no_grad  # noqa: E402,F401
+from ..core.tape import partial_grad  # noqa: E402,F401  (paddle.grad engine)
 
 
 _CHECKPOINT_POLICIES = {

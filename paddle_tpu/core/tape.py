@@ -1,0 +1,569 @@
+"""Eager gradient tape: ``loss.backward()`` / ``Tensor.grad`` on jax arrays.
+
+Reference parity: the imperative engine's tape backward —
+``varbase_patch_methods.py:131`` (``backward`` → ``core.VarBase._run_backward``)
+and ``imperative/basic_engine.cc:38/:124/:161`` (Init / PrepareDeps /
+queue-driven Execute) with sorted gradient accumulation
+(``gradient_accumulator.cc``).
+
+TPU-native design — no per-op grad makers.  Eager ops run as plain jax calls;
+when the tape is enabled (``paddle_tpu.dygraph.guard()`` /
+``enable_tape()``), each *API-boundary* op call whose inputs are tracked
+records a node holding ``(replay_fn, args, rng_state)``.  ``backward()``
+walks the node list in reverse and re-linearizes each node with ``jax.vjp``
+on the spot (AD-of-replay, the same trick the static executor uses for
+``append_backward``): the forward is recomputed under linearization with the
+recorded RNG stream state restored, so dropout masks replay bit-exactly.
+Per-op replay costs one extra forward per node during backward — the jit
+path (``autograd.value_and_grad``) remains the performance path, exactly as
+the reference's dygraph needed ``core.ops``/dy2static to go fast.
+
+Tensors stay raw ``jax.Array``s: ``backward``/``grad``/``stop_gradient`` are
+installed onto the concrete array class the same way jax attaches its numpy
+methods (``jax/_src/numpy/array_methods.py``), and identity (``id``) keys the
+graph — nodes hold strong references, so ids are stable while a graph is
+alive.
+"""
+from __future__ import annotations
+
+import functools
+import operator
+import threading
+import types
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+
+
+import weakref
+
+
+class Leaf:
+    """Gradient slot for a leaf tensor (a Parameter value or a watched
+    tensor).  Grads accumulate across ``backward()`` calls until cleared —
+    reference ``gradient_accumulator.cc`` semantics.  The array is held
+    weakly so a dropped tensor's slot can be swept (the reference frees by
+    VarBase refcount); a Parameter keeps its Leaf alive via ``_leaf``."""
+
+    __slots__ = ("_ref", "grad")
+
+    def __init__(self, array):
+        self._ref = weakref.ref(array)
+        self.grad = None
+
+    @property
+    def array(self):
+        return self._ref()
+
+    @array.setter
+    def array(self, value):
+        self._ref = weakref.ref(value)
+
+
+class Node:
+    """Tape nodes hold their *inputs* strongly (needed for replay) but
+    their *outputs* weakly: an output nobody references can never be a
+    backward seed, so orphaned forward-only chains are pruned instead of
+    leaking (the reference gets this from VarBase refcounting)."""
+
+    __slots__ = ("fn", "flat", "treedef", "pos", "out_refs", "out_avals",
+                 "diff_idx", "rng")
+
+    def __init__(self, fn, flat, treedef, pos, outs, diff_idx, rng):
+        self.fn = fn              # pure replay callable over (args, kwargs)
+        self.flat = flat          # flattened (args, kwargs) leaves
+        self.treedef = treedef
+        self.pos = pos            # indices of tracked inputs in `flat`
+        self.out_refs = [weakref.ref(o) for o in outs]
+        self.out_avals = [(o.shape, o.dtype) for o in outs]
+        self.diff_idx = diff_idx  # their indices in tree_leaves(fn(...))
+        self.rng = rng            # RNG stream state snapshot before the call
+
+    def live_outs(self):
+        return [r() for r in self.out_refs]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.on = False            # recording enabled
+        self.depth = 0             # >0 while inside a recorded op's forward
+        self.suspended = 0         # >0 inside backward replay / no_grad
+        self.nodes: List[Node] = []
+        self.tracked: Dict[int, Any] = {}   # id -> weakref (intermediates)
+        self.leaves: Dict[int, Leaf] = {}   # id -> Leaf
+        self.records = 0           # counter driving the periodic sweep
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    return _state.on
+
+
+def recording() -> bool:
+    return _state.on and _state.depth == 0 and _state.suspended == 0
+
+
+def enable() -> None:
+    _install_array_methods()
+    _state.on = True
+
+
+def ensure_methods() -> None:
+    """Install backward/grad/stop_gradient onto the array class WITHOUT
+    turning recording on (leaf creation outside dygraph.guard must not
+    silently flip the global tape — recording is guard()'s decision)."""
+    _install_array_methods()
+
+
+def disable() -> None:
+    """Stop recording and drop the graph (leaf grads are kept)."""
+    _state.on = False
+    _state.nodes.clear()
+    _state.tracked.clear()
+
+
+class no_grad_ctx:
+    """Suspend recording (ref: paddle.no_grad).  Re-entrant."""
+
+    def __enter__(self):
+        _state.suspended += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state.suspended -= 1
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            with self.__class__():
+                return fn(*a, **k)
+
+        return inner
+
+
+# -- leaf management ---------------------------------------------------------
+
+def watch(arr) -> Leaf:
+    """Mark ``arr`` as a gradient leaf (``stop_gradient = False``)."""
+    lf = _state.leaves.get(id(arr))
+    if lf is None or lf.array is not arr:
+        lf = Leaf(arr)
+        _state.leaves[id(arr)] = lf
+    return lf
+
+
+def unwatch(arr) -> None:
+    _state.leaves.pop(id(arr), None)
+
+
+def leaf_of(arr) -> Optional[Leaf]:
+    lf = _state.leaves.get(id(arr))
+    return lf if lf is not None and lf.array is arr else None
+
+
+def rebind_leaf(leaf: Leaf, new_array) -> None:
+    """Move a Leaf to a new value (optimizer wrote the parameter), keeping
+    its accumulated grad."""
+    old = leaf.array
+    if old is not None:
+        _state.leaves.pop(id(old), None)
+    leaf.array = new_array
+    _state.leaves[id(new_array)] = leaf
+
+
+def grad_of(arr):
+    lf = leaf_of(arr)
+    return None if lf is None else lf.grad
+
+
+# -- recording ---------------------------------------------------------------
+
+_ARRAY_TYPES: tuple = ()
+
+
+def _concrete_array(x) -> bool:
+    return isinstance(x, _ARRAY_TYPES) and not isinstance(x, jax.core.Tracer)
+
+
+def _is_tracked(x) -> bool:
+    i = id(x)
+    r = _state.tracked.get(i)
+    if r is not None and r() is x:
+        return True
+    lf = _state.leaves.get(i)
+    return lf is not None and lf.array is x
+
+
+_SWEEP_EVERY = 256
+
+
+def _sweep() -> None:
+    """Drop orphaned graph state: nodes whose every output died (they can
+    never be a backward seed), dead intermediate track entries, and dead
+    leaf slots.  Cascades over successive sweeps as pruned nodes release
+    their input refs."""
+    st = _state
+    st.nodes = [n for n in st.nodes
+                if any(r() is not None for r in n.out_refs)]
+    st.tracked = {i: r for i, r in st.tracked.items() if r() is not None}
+    st.leaves = {i: lf for i, lf in st.leaves.items()
+                 if lf.array is not None}
+
+
+def _record_call(replay_fn: Callable, args: tuple, kwargs: dict,
+                 orig: Callable):
+    """Run ``orig(*args, **kwargs)``; if recording and any input is tracked,
+    push a tape node whose backward replays ``replay_fn``."""
+    st = _state
+    if not (st.on and st.depth == 0 and st.suspended == 0):
+        return orig(*args, **kwargs)
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    pos = []
+    for i, x in enumerate(flat):
+        if isinstance(x, jax.core.Tracer):
+            return orig(*args, **kwargs)  # under jit/vjp trace: plain call
+        if _concrete_array(x) and _is_tracked(x):
+            pos.append(i)
+    if not pos:
+        return orig(*args, **kwargs)
+    rng = _random.get_rng_state()
+    st.depth += 1
+    try:
+        out = orig(*args, **kwargs)
+    finally:
+        st.depth -= 1
+    out_leaves = jax.tree_util.tree_leaves(out)
+    diff_idx = [i for i, o in enumerate(out_leaves)
+                if _concrete_array(o) and jnp.issubdtype(o.dtype, jnp.inexact)]
+    if diff_idx:
+        outs = [out_leaves[i] for i in diff_idx]
+        st.nodes.append(Node(replay_fn, flat, treedef, pos, outs, diff_idx,
+                             rng))
+        for o in outs:
+            st.tracked[id(o)] = weakref.ref(o)
+        st.records += 1
+        if st.records % _SWEEP_EVERY == 0:
+            _sweep()
+    return out
+
+
+def _functional_layer_call(layer, params, pvals, args, kwargs):
+    """Run ``layer`` with ``pvals`` bound in place of its trainable
+    parameter values, restoring parameters AND buffers afterwards (so a
+    traced replay cannot leak tracers into BatchNorm running stats — the
+    eager forward already applied the real buffer update once)."""
+    old = [p._value for p in params]
+    buffers = []
+    stack = [layer]
+    while stack:
+        l = stack.pop()
+        for holder in l._buffers.values():
+            buffers.append((holder, holder.value))
+        stack.extend(l._sub_layers.values())
+    for p, v in zip(params, pvals):
+        p._value = v
+    try:
+        return layer._raw_call(*args, **kwargs)
+    finally:
+        for p, v in zip(params, old):
+            p._value = v
+        for holder, v in buffers:
+            holder.value = v
+
+
+def record_layer(layer, args: tuple, kwargs: dict):
+    """Record one tape node for a whole Layer call (ref: the imperative
+    Tracer records per-op; a coarser layer-granularity node is equivalent
+    because the replay — a functional re-execution of the layer under
+    ``jax.vjp`` — differentiates through everything inside)."""
+    params = [p for _, p in layer.named_parameters() if p.trainable]
+    pvals = [p.value for p in params]  # getter registers each as a leaf
+
+    def orig(pvals_, *a, **k):
+        del pvals_  # the eager call reads the same arrays from the layer
+        return layer._raw_call(*a, **k)
+
+    def replay(pvals_, *a, **k):
+        return _functional_layer_call(layer, params, pvals_, a, k)
+
+    return _record_call(replay, (pvals,) + tuple(args), kwargs, orig)
+
+
+def wrap_function(fn: Callable) -> Callable:
+    """Wrap an API-boundary op so calls record tape nodes.  Idempotent."""
+    if getattr(fn, "_pd_tape_wrapped", False):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _state.on:
+            return fn(*args, **kwargs)
+        return _record_call(fn, args, kwargs, fn)
+
+    wrapper._pd_tape_wrapped = True
+    wrapper._pd_tape_original = fn
+    return wrapper
+
+
+def wrap_namespace(module, names=None) -> None:
+    """Rebind every paddle_tpu-defined function in ``module`` (and any module
+    that from-imported it) to its tape-wrapped version."""
+    names = names or [n for n in dir(module) if not n.startswith("_")]
+    for name in names:
+        fn = getattr(module, name, None)
+        if (isinstance(fn, types.FunctionType)
+                and fn.__module__.startswith("paddle_tpu")
+                and not getattr(fn, "_pd_tape_wrapped", False)):
+            setattr(module, name, wrap_function(fn))
+
+
+# -- array method installation ----------------------------------------------
+
+_BINOPS = {
+    "__add__": operator.add, "__sub__": operator.sub,
+    "__mul__": operator.mul, "__truediv__": operator.truediv,
+    "__pow__": operator.pow, "__matmul__": operator.matmul,
+    "__mod__": operator.mod, "__floordiv__": operator.floordiv,
+}
+_RBINOPS = {
+    "__radd__": operator.add, "__rsub__": operator.sub,
+    "__rmul__": operator.mul, "__rtruediv__": operator.truediv,
+    "__rpow__": operator.pow, "__rmatmul__": operator.matmul,
+    "__rmod__": operator.mod, "__rfloordiv__": operator.floordiv,
+}
+_METHODS = ("sum", "mean", "max", "min", "prod", "reshape", "transpose",
+            "squeeze", "ravel", "astype", "dot", "cumsum", "clip", "take",
+            "swapaxes", "flatten")
+
+_installed = False
+
+
+def _install_array_methods() -> None:
+    """Patch backward/grad/stop_gradient and tape-recording operators onto
+    the concrete jax array class (lazy: first enable(), so importing
+    paddle_tpu never initializes an XLA backend)."""
+    global _installed, _ARRAY_TYPES
+    if _installed:
+        return
+    cls = type(jnp.zeros((), jnp.float32))
+    _ARRAY_TYPES = (cls,)
+
+    def _bin_wrapper(orig, replay):
+        @functools.wraps(orig)
+        def method(self, other):
+            if not _state.on:
+                return orig(self, other)
+            return _record_call(replay, (self, other), {}, orig)
+
+        return method
+
+    def _rbin_wrapper(orig, replay):
+        # record with operand order normalized to (other, self)
+        def flipped(a, b):
+            return replay(a, b)
+
+        @functools.wraps(orig)
+        def method(self, other):
+            if not _state.on:
+                return orig(self, other)
+            return _record_call(flipped, (other, self), {},
+                                lambda a, b: orig(b, a))
+
+        return method
+
+    for name, replay in _BINOPS.items():
+        orig = getattr(cls, name, None)
+        if orig is not None:
+            setattr(cls, name, _bin_wrapper(orig, replay))
+    for name, replay in _RBINOPS.items():
+        orig = getattr(cls, name, None)
+        if orig is not None:
+            setattr(cls, name, _rbin_wrapper(orig, replay))
+
+    orig_neg = getattr(cls, "__neg__")
+    def __neg__(self):
+        if not _state.on:
+            return orig_neg(self)
+        return _record_call(operator.neg, (self,), {}, orig_neg)
+    setattr(cls, "__neg__", __neg__)
+
+    orig_getitem = getattr(cls, "__getitem__")
+    def __getitem__(self, idx):
+        if not _state.on:
+            return orig_getitem(self, idx)
+        return _record_call(operator.getitem, (self, idx), {},
+                            lambda a, i: orig_getitem(a, i))
+    setattr(cls, "__getitem__", __getitem__)
+
+    def _method_wrapper(orig, name):
+        def replay(a, *ar, **kw):
+            return getattr(a, name)(*ar, **kw)  # Tracer dispatch
+
+        @functools.wraps(orig)
+        def method(self, *ar, **kw):
+            if not _state.on:
+                return orig(self, *ar, **kw)
+            return _record_call(replay, (self,) + ar, kw,
+                                lambda s, *a2, **k2: orig(s, *a2, **k2))
+
+        return method
+
+    for name in _METHODS:
+        orig = getattr(cls, name, None)
+        if orig is not None:
+            setattr(cls, name, _method_wrapper(orig, name))
+
+    # -- paddle VarBase surface ---------------------------------------------
+    def backward_(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    setattr(cls, "backward", backward_)
+    setattr(cls, "grad", property(grad_of))
+
+    def _get_stop_gradient(self):
+        return leaf_of(self) is None
+
+    def _set_stop_gradient(self, value):
+        if value:
+            unwatch(self)
+        else:
+            watch(self)
+
+    setattr(cls, "stop_gradient",
+            property(_get_stop_gradient, _set_stop_gradient))
+
+    def clear_gradient_(self):
+        lf = leaf_of(self)
+        if lf is not None:
+            lf.grad = None
+
+    setattr(cls, "clear_gradient", clear_gradient_)
+    setattr(cls, "clear_grad", clear_gradient_)
+    _installed = True
+
+
+# -- backward ----------------------------------------------------------------
+
+def _replay_vjp(node: Node, cots: tuple):
+    """Re-linearize one node and pull cotangents back to its tracked
+    inputs."""
+    tvals = [node.flat[i] for i in node.pos]
+
+    def g(*tv):
+        flat2 = list(node.flat)
+        for p, v in zip(node.pos, tv):
+            flat2[p] = v
+        args2, kwargs2 = jax.tree_util.tree_unflatten(node.treedef, flat2)
+        saved = _random.get_rng_state()
+        _random.set_rng_state(node.rng)
+        try:
+            res = node.fn(*args2, **kwargs2)
+        finally:
+            _random.set_rng_state(saved)
+        leaves = jax.tree_util.tree_leaves(res)
+        return tuple(leaves[i] for i in node.diff_idx)
+
+    _, vjp_fn = jax.vjp(g, *tvals)
+    return vjp_fn(cots)
+
+
+def _walk(seeds: Dict[int, Any]) -> Dict[int, Any]:
+    """Reverse-walk the tape from seed cotangents; returns id -> cotangent.
+    The append-order node list is already topologically sorted (reference
+    PrepareDeps/Execute does dependency counting; execution order suffices
+    here)."""
+    st = _state
+    cot = dict(seeds)
+    st.suspended += 1
+    try:
+        for node in reversed(st.nodes):
+            outs = node.live_outs()
+            if not any(o is not None and id(o) in cot for o in outs):
+                continue
+            cots = tuple(
+                cot[id(o)] if o is not None and id(o) in cot
+                else jnp.zeros(shape, dtype)
+                for o, (shape, dtype) in zip(outs, node.out_avals))
+            in_cots = _replay_vjp(node, cots)
+            for p, c in zip(node.pos, in_cots):
+                arr = node.flat[p]
+                prev = cot.get(id(arr))
+                cot[id(arr)] = c if prev is None else prev + c
+    finally:
+        st.suspended -= 1
+    return cot
+
+
+def backward(loss, grad_tensor=None, retain_graph=False) -> None:
+    """ref varbase_patch_methods.py:131 ``backward``: seed the walk from
+    ``loss`` and accumulate into every reachable leaf's ``.grad``."""
+    st = _state
+    if not st.on:
+        raise RuntimeError(
+            "gradient tape is not enabled; wrap the forward in "
+            "paddle_tpu.dygraph.guard() (or call "
+            "paddle_tpu.dygraph.enable_tape()) before loss.backward()")
+    if grad_tensor is None:
+        if getattr(loss, "size", 1) != 1:
+            raise ValueError(
+                "backward() on a non-scalar tensor requires grad_tensor "
+                "(reference: VarBase._run_backward scalar contract)")
+        grad_tensor = jnp.ones(loss.shape, loss.dtype)
+    cot = _walk({id(loss): jnp.asarray(grad_tensor, loss.dtype)})
+    for leaf in list(st.leaves.values()):
+        arr = leaf.array
+        if arr is None:
+            continue
+        c = cot.get(id(arr))
+        if c is not None:
+            leaf.grad = c if leaf.grad is None else leaf.grad + c
+    if not retain_graph:
+        st.nodes.clear()
+        st.tracked.clear()
+
+
+def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+                 allow_unused=False):
+    """ref paddle.grad / PartialGradEngine (partial_grad_engine.cc): grads of
+    ``outputs`` w.r.t. ``inputs`` without touching leaf ``.grad`` slots."""
+    st = _state
+    if not st.on:
+        raise RuntimeError("gradient tape is not enabled")
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [jnp.ones(o.shape, o.dtype) for o in outs]
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    seeds: Dict[int, Any] = {}
+    for o, g in zip(outs, grad_outputs):
+        seeds[id(o)] = jnp.asarray(g, o.dtype)
+    cot = _walk(seeds)
+    result = []
+    for x in ins:
+        value = x.value if hasattr(x, "value") else x  # Parameter or array
+        c = cot.get(id(value))
+        if c is None and not allow_unused:
+            raise ValueError(
+                "an input tensor is not reachable from outputs (pass "
+                "allow_unused=True to get None instead)")
+        result.append(c)
+    if not retain_graph:
+        st.nodes.clear()
+        st.tracked.clear()
+    return result
+
+
+def clear_graph() -> None:
+    _state.nodes.clear()
+    _state.tracked.clear()
+
+
+def graph_size() -> int:
+    return len(_state.nodes)

@@ -1,0 +1,76 @@
+"""Imperative (dygraph) mode: the reference's tape-autograd UX.
+
+Reference parity: ``python/paddle/fluid/dygraph/`` — ``guard`` (base.py),
+``to_variable``, ``no_grad``, and the tape backward contract
+(``varbase_patch_methods.py:131`` ``backward`` → ``BasicEngine``,
+basic_engine.cc:38/:124/:161).  TPU-native design: tensors stay raw jax
+arrays; ``guard()`` enables the delayed-replay tape in ``core/tape.py``,
+after which ``loss.backward()`` / ``param.grad`` / ``optimizer.minimize()``
+work exactly like the reference's dygraph book examples.  The functional
+``autograd.value_and_grad`` + jit path remains the performance path (the
+reference's dygraph had the same split: the tape for UX, static/d2s for
+speed).
+
+Typical loop (ref book test_mnist dygraph)::
+
+    with paddle_tpu.dygraph.guard():
+        model = MNIST()
+        opt = Adam(0.001, parameters=model.parameters())
+        for x, y in loader:
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core import tape as _tape
+from ..core.tape import (  # noqa: F401
+    backward,
+    clear_graph,
+    enabled,
+    graph_size,
+    partial_grad as grad,
+)
+from ..nn.layer.base import Layer, Parameter  # noqa: F401 (paddle.fluid.dygraph.Layer)
+
+no_grad = _tape.no_grad_ctx
+
+
+def enable_tape() -> None:
+    """Turn on eager gradient recording (idempotent)."""
+    _tape.enable()
+
+
+def disable_tape() -> None:
+    """Stop recording and release the graph (leaf grads survive)."""
+    _tape.disable()
+
+
+# paddle 2.0 aliases (paddle.enable_grad-era naming is guard-based here)
+enable_dygraph = enable_tape
+disable_dygraph = disable_tape
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """ref fluid.dygraph.guard (dygraph/base.py): imperative mode with tape
+    recording for the duration of the block."""
+    del place  # placement is jax's default-device concern
+    was_on = _tape.enabled()
+    _tape.enable()
+    try:
+        yield
+    finally:
+        if not was_on:
+            _tape.disable()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """ref fluid.dygraph.to_variable: numpy/scalar -> eager tensor."""
+    del name, zero_copy
+    from ..ops.creation import to_tensor
+
+    return to_tensor(value, dtype=dtype)

@@ -116,10 +116,12 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
         assert self._optimizer is not None, "call prepare(optimizer, loss) first"
+        from ..core import tape as _tape
+
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
         params = autograd.parameters_dict(self.network)
-        if self._opt_state is None:
+        if self._opt_state is None and not _tape.enabled():
             self._opt_state = self._optimizer.init(params)
 
         cbs = cb_mod.CallbackList(callbacks, model=self,
@@ -134,11 +136,17 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            from ..core import tape as _tape
             for step, batch in enumerate(loader):
                 inputs, labels = self._split_batch(batch)
-                rng = _random.next_key()
-                params, self._opt_state, loss, metric_outs = self._train_step(
-                    params, self._opt_state, rng, inputs, labels)
+                if _tape.enabled():
+                    loss, metric_outs = self._tape_fit_step(inputs, labels)
+                    params = autograd.parameters_dict(self.network)
+                else:
+                    rng = _random.next_key()
+                    params, self._opt_state, loss, metric_outs = \
+                        self._train_step(params, self._opt_state, rng, inputs,
+                                         labels)
                 logs = {"loss": float(loss), "step": step}
                 for m, mo in zip(self._metrics, metric_outs):
                     val = _metric_update(m, mo)
@@ -201,6 +209,10 @@ class Model:
         return outs
 
     def train_batch(self, inputs, labels=None):
+        from ..core import tape as _tape
+
+        if _tape.enabled():
+            return self._train_batch_tape(inputs, labels)
         params = autograd.parameters_dict(self.network)
         if self._opt_state is None:
             self._opt_state = self._optimizer.init(params)
@@ -209,6 +221,27 @@ class Model:
             params, self._opt_state, rng, _to_tuple(inputs), labels)
         autograd.load_parameters(self.network, params)
         return float(loss)
+
+    def _train_batch_tape(self, inputs, labels):
+        """Eager tape path (ref DynamicGraphAdapter.train_batch,
+        hapi/model.py:588: forward → loss.backward() → minimize →
+        clear_gradients), used when dygraph.guard() is active."""
+        loss, _ = self._tape_fit_step(inputs, labels)
+        return float(loss)
+
+    def _tape_fit_step(self, inputs, labels):
+        opt = self._optimizer
+        if opt._parameters is None:
+            opt._parameters = self.network.parameters()
+        outputs = _to_tuple(self.network(*_to_tuple(inputs)))
+        loss = self._loss(*outputs, *_to_tuple(labels))
+        loss.backward()
+        opt.minimize(loss)
+        self.network.clear_gradients()
+        labels0 = labels[0] if isinstance(labels, (list, tuple)) else labels
+        metric_outs = tuple(m.compute(outputs[0], labels0)
+                            for m in self._metrics)
+        return loss, metric_outs
 
     def eval_batch(self, inputs, labels=None):
         params = autograd.parameters_dict(self.network)
@@ -224,8 +257,13 @@ class Model:
         from ..utils import checkpoint
 
         checkpoint.save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None and self._opt_state is not None:
-            checkpoint.save({"opt": self._opt_state}, path + ".pdopt")
+        if training and self._optimizer is not None:
+            # tape-mode fit updates the optimizer's own bound state
+            # (optimizer._state); the jit path updates self._opt_state —
+            # persist whichever actually trained
+            opt_state = self._optimizer._state or self._opt_state
+            if opt_state is not None:
+                checkpoint.save({"opt": opt_state}, path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..utils import checkpoint
@@ -236,6 +274,8 @@ class Model:
             try:
                 opt = checkpoint.load(path + ".pdopt")
                 self._opt_state = opt["opt"]
+                if self._optimizer is not None and self._optimizer._state:
+                    self._optimizer._state = opt["opt"]
             except FileNotFoundError:
                 pass
 

@@ -73,3 +73,12 @@ from .rnn import birnn, rnn
 from ...ops.attention import flash_attention, scaled_dot_product_attention
 
 __all__ = [n for n in dir() if not n.startswith("_")]
+
+# Tape-aware wrappers: layer forwards resolve ops through this namespace
+# (``from .. import functional as F``), so rebinding here makes every layer
+# record backward nodes under dygraph.guard() (core/tape.py).
+import sys as _sys
+
+from ...core import tape as _tape
+
+_tape.wrap_namespace(_sys.modules[__name__], __all__)

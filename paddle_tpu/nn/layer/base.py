@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core import dtype as _dtype_mod
+from ...core import tape as _tape
 
 
 class Parameter:
@@ -29,11 +30,12 @@ class Parameter:
     the source of truth.
     """
 
-    __slots__ = ("value", "trainable", "name", "is_distributed",
+    __slots__ = ("_value", "_leaf", "trainable", "name", "is_distributed",
                  "sharding_axes", "initializer")
 
     def __init__(self, value, trainable: bool = True, name: str = "",
                  initializer=None):
+        self._leaf = None
         self.value = jnp.asarray(value)
         self.trainable = trainable
         self.name = name
@@ -45,6 +47,43 @@ class Parameter:
         # layer stacks (TransformerEncoder deep copies) re-draw fresh values
         # from the *configured* distribution rather than a hard-coded one.
         self.initializer = initializer
+
+    @property
+    def value(self):
+        """The parameter's array.  Under an active gradient tape
+        (dygraph.guard), reading the value registers it as a gradient leaf so
+        ``loss.backward()`` reaches it (ref VarBase: params always require
+        grad)."""
+        v = self._value
+        if _tape.enabled() and self.trainable and not isinstance(
+                v, jax.core.Tracer):
+            lf = self._leaf
+            if lf is None:
+                self._leaf = _tape.watch(v)
+            elif lf.array is not v:
+                _tape.rebind_leaf(lf, v)
+        return v
+
+    @value.setter
+    def value(self, v):
+        self._value = v
+        lf = self._leaf
+        if lf is not None and not isinstance(v, jax.core.Tracer):
+            _tape.rebind_leaf(lf, v)
+
+    @property
+    def grad(self):
+        """Accumulated tape gradient (ref VarBase.grad); None before
+        backward()."""
+        lf = self._leaf
+        return None if lf is None else lf.grad
+
+    def clear_grad(self):
+        lf = self._leaf
+        if lf is not None:
+            lf.grad = None
+
+    clear_gradient = clear_grad
 
     @property
     def shape(self):
@@ -197,6 +236,11 @@ class Layer:
             fn(layer)
         return self
 
+    def clear_gradients(self):
+        """ref dygraph Layer.clear_gradients: drop accumulated tape grads."""
+        for p in self.parameters():
+            p.clear_grad()
+
     # -- modes ---------------------------------------------------------------
     def train(self):
         for layer in self.sublayers(include_self=True):
@@ -280,6 +324,15 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *args, **kwargs):
+        # Tape mode: record the WHOLE outermost layer call as one node whose
+        # backward replays it functionally (core/tape.py record_layer) — this
+        # covers any forward implementation (raw jnp included) and makes
+        # backward cost one extra model forward, not one per op.
+        if _tape.recording():
+            return _tape.record_layer(self, args, kwargs)
+        return self._raw_call(*args, **kwargs)
+
+    def _raw_call(self, *args, **kwargs):
         for hook in self._forward_pre_hooks.values():
             result = hook(self, args)
             if result is not None:

@@ -15,15 +15,21 @@ def _default_float():
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """Create a tensor from python/numpy data (ref: paddle.to_tensor).
 
-    ``place``/``stop_gradient`` are accepted for API parity; placement is
-    governed by jax's default device, and gradients are functional (jax.grad)
-    rather than tape-attached, so ``stop_gradient`` has no effect here.
+    ``place`` is accepted for API parity; placement is governed by jax's
+    default device.  ``stop_gradient=False`` registers the tensor as a
+    gradient-tape leaf, so under ``dygraph.guard()`` its ``.grad`` is
+    populated by ``loss.backward()`` (ref VarBase stop_gradient).
     """
-    del place, stop_gradient
+    del place
     dtype = _dtype_mod.convert_dtype(dtype)
     arr = jnp.asarray(data, dtype=dtype)
     if dtype is None and arr.dtype == jnp.float64 and _default_float() != jnp.float64:
         arr = arr.astype(_default_float())
+    if not stop_gradient:
+        from ..core import tape as _tape
+
+        _tape.ensure_methods()
+        _tape.watch(arr)
     return arr
 
 

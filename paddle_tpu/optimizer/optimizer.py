@@ -119,12 +119,17 @@ class Optimizer:
 
     def step(self, grads=None):
         """Apply ``grads`` (dict keyed like enumerate order, list, or pytree
-        matching the parameter list) to the bound parameters in place."""
+        matching the parameter list) to the bound parameters in place.
+
+        With ``grads=None`` (paddle 2.0 dygraph style), gradients are pulled
+        from the parameters' tape ``.grad`` slots — populated by
+        ``loss.backward()`` under ``dygraph.guard()`` (ref
+        optimizer.step after VarBase._run_backward); parameters the loss
+        never reached are skipped, like the reference's grad-less params.
+        """
         params = self._param_list()
         if grads is None:
-            raise ValueError(
-                "step() needs explicit grads: this framework has no global "
-                "tape; compute grads via paddle_tpu.autograd.value_and_grad")
+            return self._step_from_tape(params)
         if isinstance(grads, dict):
             grads = list(grads.values())
         values = [p.value for p in params]
@@ -135,8 +140,43 @@ class Optimizer:
             p.value = v
         self._step_count += 1
 
-    def clear_grad(self):
-        """API parity no-op (grads are not stored on parameters)."""
+    def _step_from_tape(self, params):
+        pairs = [(i, p.grad) for i, p in enumerate(params)
+                 if getattr(p, "trainable", True) and p.grad is not None]
+        if not pairs:
+            raise ValueError(
+                "no parameter has a tape gradient; call loss.backward() "
+                "inside dygraph.guard() first (or pass grads explicitly)")
+        values = [p.value for p in params]
+        if self._state is None:
+            self._state = self.init(values)
+        idx = [i for i, _ in pairs]
+        sub_state = {"per_param": [self._state["per_param"][i] for i in idx],
+                     "step": self._state["step"]}
+        new_vals, new_state = self.update([g for _, g in pairs], sub_state,
+                                          [values[i] for i in idx])
+        for slot, i in enumerate(idx):
+            params[i].value = new_vals[slot]
+            self._state["per_param"][i] = new_state["per_param"][slot]
+        self._state["step"] = new_state["step"]
+        self._step_count += 1
 
-    def minimize(self, loss_and_grads):
-        raise NotImplementedError("use step(grads) or the functional API")
+    def clear_grad(self):
+        """Drop the bound parameters' accumulated tape grads (ref
+        optimizer.clear_grad)."""
+        if self._parameters:
+            for p in self._parameters:
+                if hasattr(p, "clear_grad"):
+                    p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """ref dygraph Optimizer.minimize: apply the gradients accumulated by
+        ``loss.backward()`` (the book-example ``loss.backward();
+        opt.minimize(loss)`` contract).  Returns ([], []) for API parity with
+        the static (optimize_ops, params_grads) signature."""
+        del loss, startup_program, parameters, no_grad_set
+        self.step(None)
+        return [], []
