@@ -47,16 +47,20 @@ from .conv import (
 from .norm import batch_norm, group_norm, instance_norm, layer_norm, normalize, rms_norm
 from .pooling import (
     adaptive_avg_pool2d,
+    adaptive_avg_pool3d,
     adaptive_max_pool2d,
     avg_pool1d,
     avg_pool2d,
+    avg_pool3d,
     max_pool1d,
     max_pool2d,
+    max_pool3d,
 )
 from .loss import (
     binary_cross_entropy,
     binary_cross_entropy_with_logits,
     cross_entropy,
+    ctc_loss,
     hinge_loss,
     kl_div,
     l1_loss,

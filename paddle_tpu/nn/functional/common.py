@@ -60,12 +60,71 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     return jnp.pad(x, cfg, mode=jmode)
 
 
+def _axis_coords(out_n, in_n, align_corners, clip=True):
+    if align_corners and out_n > 1:
+        return jnp.linspace(0, in_n - 1, out_n)
+    cs = (jnp.arange(out_n) + 0.5) * in_n / out_n - 0.5
+    # bicubic keeps raw (possibly negative) coords: the kernel weights come
+    # from the unclipped fraction, only tap *indices* clamp to the edge
+    return jnp.clip(cs, 0, in_n - 1) if clip else cs
+
+
+def _cubic_weights(t, a=-0.75):
+    """Keys cubic-convolution weights for the 4 taps around t (ref
+    bicubic_interp_v2_op.h cubic_interp1d)."""
+    d = t - jnp.floor(t)
+    x1, x0, xm1, xm2 = 1 + d, d, 1 - d, 2 - d
+    w0 = a * x1 ** 3 - 5 * a * x1 ** 2 + 8 * a * x1 - 4 * a
+    w1 = (a + 2) * x0 ** 3 - (a + 3) * x0 ** 2 + 1
+    w2 = (a + 2) * xm1 ** 3 - (a + 3) * xm1 ** 2 + 1
+    w3 = a * xm2 ** 3 - 5 * a * xm2 ** 2 + 8 * a * xm2 - 4 * a
+    return (w0, w1, w2, w3)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, data_format="NCHW"):
-    """ref: operators/interpolate_v2_op.cc (nearest/bilinear)."""
+    """ref: operators/interpolate_v2_op.cc (nearest/linear/bilinear/bicubic
+    on NCHW; trilinear on NCDHW)."""
+    if mode == "trilinear":
+        n, c, d, h, w = x.shape
+        if size is None:
+            sf = scale_factor if isinstance(scale_factor, (tuple, list)) \
+                else (scale_factor,) * 3
+            size = (int(d * sf[0]), int(h * sf[1]), int(w * sf[2]))
+        od, oh, ow = size
+        out = x
+        for axis, (o, i) in zip((2, 3, 4), ((od, d), (oh, h), (ow, w))):
+            cs = _axis_coords(o, i, align_corners)
+            c0 = jnp.floor(cs).astype(jnp.int32)
+            c1 = jnp.clip(c0 + 1, 0, i - 1)
+            frac = (cs - c0).reshape((1,) * axis + (-1,) +
+                                     (1,) * (4 - axis))
+            out = (jnp.take(out, c0, axis=axis) * (1 - frac) +
+                   jnp.take(out, c1, axis=axis) * frac)
+        return out.astype(x.dtype)
     if data_format == "NHWC":
         x = jnp.transpose(x, (0, 3, 1, 2))
     n, c, h, w = x.shape
+    if mode == "bicubic":
+        if size is None:
+            sf = scale_factor if isinstance(scale_factor, (tuple, list)) \
+                else (scale_factor, scale_factor)
+            size = (int(h * sf[0]), int(w * sf[1]))
+        oh, ow = size
+        out = x
+        for axis, (o, i) in zip((2, 3), ((oh, h), (ow, w))):
+            cs = _axis_coords(o, i, align_corners, clip=False)
+            base = jnp.floor(cs).astype(jnp.int32)
+            ws = _cubic_weights(cs)
+            acc = 0.0
+            for tap, wgt in zip((-1, 0, 1, 2), ws):
+                idx = jnp.clip(base + tap, 0, i - 1)
+                shape = (1,) * axis + (-1,) + (1,) * (3 - axis)
+                acc = acc + jnp.take(out, idx, axis=axis) * wgt.reshape(shape)
+            out = acc
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out.astype(x.dtype)
     if size is None:
         sf = scale_factor if isinstance(scale_factor, (tuple, list)) else (
             scale_factor, scale_factor)

@@ -121,3 +121,89 @@ def hinge_loss(input, label):
 
 def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
     return _reduce(jnp.clip(-label * (input - other) + margin, 0, None), reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths=None, label_lengths=None,
+             blank=0, reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Reference parity: ``warpctc_op.cc`` (dlopen'd warp-ctc kernels) /
+    ``paddle.nn.functional.ctc_loss``.  TPU-native design: the log-semiring
+    alpha recursion over the extended label sequence runs as one
+    ``lax.scan`` over time — static shapes, fully batched, differentiable by
+    jax AD through the scan (the reference ships hand-written CPU/GPU
+    gradient kernels; here the VJP of the scan IS the beta recursion).
+
+    Args:
+        log_probs: (T, B, C) raw logits (log_softmax is applied internally,
+            matching warpctc's contract).
+        labels: (B, L) int labels, padded arbitrarily past each row's
+            ``label_lengths``.
+        input_lengths: (B,) valid time steps per sample (default: T).
+        label_lengths: (B,) valid labels per sample (default: L).
+    """
+    import jax
+    from jax import lax
+
+    log_probs = jnp.asarray(log_probs)
+    T, B, C = log_probs.shape
+    labels = jnp.asarray(labels, jnp.int32)
+    L = labels.shape[1]
+    S = 2 * L + 1
+    if input_lengths is None:
+        input_lengths = jnp.full((B,), T, jnp.int32)
+    else:
+        input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((B,), L, jnp.int32)
+    else:
+        label_lengths = jnp.asarray(label_lengths, jnp.int32)
+
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    # extended sequence: blank, l1, blank, l2, ..., lL, blank  -> (B, S)
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx[None, :] < (2 * label_lengths[:, None] + 1)
+    # a diagonal skip s-2 -> s is allowed for non-blank ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]],
+                             axis=1)
+    can_skip = (s_idx[None, :] % 2 == 1) & (ext != ext_m2)
+
+    neg_inf = jnp.float32(-1e30)
+    alpha0 = jnp.where((s_idx[None, :] < 2) & valid_s, 0.0, neg_inf)
+    alpha0 = alpha0 + jnp.take_along_axis(lp[0], ext, axis=1)
+    alpha0 = jnp.where(valid_s, alpha0, neg_inf)
+
+    def step(alpha, t):
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        stacked = jnp.stack([alpha, shift1, shift2], axis=0)
+        merged = jax.nn.logsumexp(stacked, axis=0)
+        emit = jnp.take_along_axis(lp[t], ext, axis=1)
+        new = jnp.where(valid_s, merged + emit, neg_inf)
+        # past each sample's input length the recursion freezes
+        alive = (t < input_lengths)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last = 2 * label_lengths      # index of final blank
+    second = 2 * label_lengths - 1  # index of final label
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_second = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(alpha, jnp.maximum(second, 0)[:, None],
+                            axis=1)[:, 0],
+        neg_inf)
+    loss = -jax.nn.logsumexp(jnp.stack([a_last, a_second], 0), axis=0)
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        # paddle/torch contract: each sample's loss is divided by its label
+        # length before averaging
+        return jnp.mean(loss / jnp.maximum(
+            label_lengths.astype(loss.dtype), 1.0))
+    return _reduce(loss, reduction)

@@ -87,3 +87,41 @@ def adaptive_max_pool2d(x, output_size):
                         axis=(2, 3)) for j in range(ow)]
         out_rows.append(jnp.stack(cols, axis=-1))
     return jnp.stack(out_rows, axis=-2)
+
+
+def _pool3d(x, kernel, stride, padding, init, op):
+    kernel = _pair(kernel, 3)
+    stride = _pair(stride if stride is not None else kernel, 3)
+    pads = _pair(padding, 3)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return lax.reduce_window(x, init, op, window, strides, padding_cfg)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    """ref operators/pool_op.cc pool3d (max): NCDHW reduce_window."""
+    return _pool3d(x, kernel_size, stride, padding, -jnp.inf, lax.max)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    """ref pool3d (avg); ``exclusive`` divides by the non-pad window count."""
+    s = _pool3d(x, kernel_size, stride, padding, 0.0, lax.add)
+    if padding == 0 or (isinstance(padding, (list, tuple))
+                        and not any(padding)) or not exclusive:
+        kernel = _pair(kernel_size, 3)
+        return s / float(np.prod(kernel))
+    cnt = _pool3d(jnp.ones_like(x), kernel_size, stride, padding, 0.0,
+                  lax.add)
+    return s / cnt
+
+
+def adaptive_avg_pool3d(x, output_size):
+    od, oh, ow = _pair(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        return jnp.mean(
+            x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow),
+            axis=(3, 5, 7))
+    raise NotImplementedError(
+        "adaptive_avg_pool3d requires divisible spatial dims")

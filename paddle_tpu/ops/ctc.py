@@ -1,0 +1,110 @@
+"""Sequence-distance / CTC decode ops.
+
+Reference parity: ``edit_distance_op.cc`` (Levenshtein DP, CPU/GPU
+kernels) and ``fluid.layers.ctc_greedy_decoder`` (ctc_align_op.cu).
+TPU-native design: the Levenshtein recurrence runs as a ``lax.scan`` over
+hypothesis positions with the whole batch's DP row as carry (static
+shapes, no host sync); greedy CTC decode is a vectorized
+collapse-repeats + drop-blank with a stable left-pack computed by
+``cumsum`` — no dynamic shapes, the dense (padded) layout the rest of
+the rebuild uses for LoD-carrying ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["edit_distance", "ctc_greedy_decoder"]
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized: bool = True):
+    """Batched Levenshtein distance (ref edit_distance_op.cc).
+
+    Args:
+        hyps: (B, Lh) int tokens, padded past ``hyp_lengths``.
+        refs: (B, Lr) int tokens, padded past ``ref_lengths``.
+        normalized: divide by the reference length (ref attr).
+
+    Returns:
+        (distances (B, 1) float32, sequence_num (1,) int32) — the
+        reference op's (Out, SequenceNum) pair (int64 there; int32 here
+        because 32-bit jax truncates int64).
+    """
+    hyps = jnp.asarray(hyps, jnp.int32)
+    refs = jnp.asarray(refs, jnp.int32)
+    B, Lh = hyps.shape
+    Lr = refs.shape[1]
+    hyp_lengths = (jnp.full((B,), Lh, jnp.int32) if hyp_lengths is None
+                   else jnp.asarray(hyp_lengths, jnp.int32))
+    ref_lengths = (jnp.full((B,), Lr, jnp.int32) if ref_lengths is None
+                   else jnp.asarray(ref_lengths, jnp.int32))
+
+    j = jnp.arange(Lr + 1)
+    row0 = jnp.broadcast_to(j.astype(jnp.float32), (B, Lr + 1))
+
+    def step(row, i):
+        # row: DP row for hyp prefix length i; compute row for i+1
+        sub_cost = (hyps[:, i][:, None] != refs).astype(jnp.float32)
+        # new[0] = i+1
+        def inner(carry, jj):
+            new_prev = carry  # new[jj]
+            cand = jnp.minimum(
+                jnp.minimum(row[:, jj + 1] + 1.0,  # delete
+                            new_prev + 1.0),       # insert
+                row[:, jj] + sub_cost[:, jj])      # substitute
+            return cand, cand
+
+        first = jnp.full((B,), i + 1.0, jnp.float32)
+        _, rest = lax.scan(inner, first, jnp.arange(Lr))
+        new_row = jnp.concatenate([first[:, None], rest.T], axis=1)
+        # freeze rows past each sample's hypothesis length
+        alive = (i < hyp_lengths)[:, None]
+        return jnp.where(alive, new_row, row), None
+
+    row, _ = lax.scan(step, row0, jnp.arange(Lh))
+    d = jnp.take_along_axis(row, ref_lengths[:, None], axis=1)[:, 0]
+    # empty-reference convention (ref kernel): distance = hyp length
+    d = jnp.where(ref_lengths == 0, hyp_lengths.astype(jnp.float32), d)
+    if normalized:
+        denom = jnp.maximum(ref_lengths.astype(jnp.float32), 1.0)
+        d = jnp.where(ref_lengths == 0, jnp.where(hyp_lengths > 0, 1.0, 0.0),
+                      d / denom)
+    return d[:, None], jnp.asarray([B], jnp.int32)  # int64 truncates under 32-bit jax
+
+
+def ctc_greedy_decoder(probs, blank: int, input_lengths=None,
+                       padding_value: int = 0):
+    """Greedy (best-path) CTC decoding (ref fluid.layers.ctc_greedy_decoder
+    / ctc_align_op.cu): argmax per step, collapse repeats, drop blanks.
+
+    Args:
+        probs: (B, T, C) probabilities or logits.
+        blank: blank token index.
+        input_lengths: (B,) valid steps (default T).
+
+    Returns:
+        (decoded (B, T) int32 padded with ``padding_value``,
+         lengths (B,) int32).
+    """
+    probs = jnp.asarray(probs)
+    B, T, _ = probs.shape
+    if input_lengths is None:
+        input_lengths = jnp.full((B,), T, jnp.int32)
+    else:
+        input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    path = jnp.argmax(probs, axis=-1).astype(jnp.int32)          # (B, T)
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < input_lengths[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), path[:, :-1]],
+                           axis=1)
+    keep = valid & (path != blank) & (path != prev)
+    # stable left-pack of kept tokens: target position = cumsum(keep) - 1
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    tgt = jnp.where(keep, pos, T)  # dropped tokens scatter out of bounds
+    out = jnp.full((B, T), padding_value, jnp.int32).at[b_idx, tgt].set(
+        path, mode="drop")
+    return out, lengths

@@ -24,6 +24,7 @@ from jax import lax
 __all__ = [
     "iou_similarity", "box_coder", "box_clip", "anchor_generator",
     "prior_box", "yolo_box", "yolo_loss", "multiclass_nms", "roi_align",
+    "density_prior_box", "deformable_conv", "psroi_pool",
 ]
 
 
@@ -520,3 +521,190 @@ def roi_align(input, rois, output_size, spatial_scale: float = 1.0,
         return v.mean(axis=(2, 4))
 
     return jax.vmap(one_roi)(rois)
+
+
+def density_prior_box(feature_hw, image_hw, densities, fixed_sizes,
+                      fixed_ratios=(1.0,), clip: bool = False,
+                      steps=(0.0, 0.0), offset: float = 0.5,
+                      variances=(0.1, 0.1, 0.2, 0.2), flatten_to_2d=False):
+    """Density prior boxes (ref density_prior_box_op.cc / layers/detection.py
+    density_prior_box): per (density d, fixed_size s, ratio r), a d x d grid
+    of shifted centers inside each feature cell carrying an s*sqrt(r) x
+    s/sqrt(r) box.
+
+    Returns (boxes [H, W, P, 4] normalized xyxy, variances [...]) or the
+    flattened (N, 4) pair when ``flatten_to_2d``.
+    """
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    if len(densities) != len(fixed_sizes):
+        raise ValueError("densities must pair 1:1 with fixed_sizes")
+    ws, hs, sx, sy = [], [], [], []
+    for dens, size in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            shift = 1.0 / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    # center shift within the cell, in step units
+                    sx.append((dj + 0.5) * shift - 0.5)
+                    sy.append((di + 0.5) * shift - 0.5)
+                    ws.append(bw)
+                    hs.append(bh)
+    ws = jnp.asarray(ws, jnp.float32) / img_w
+    hs = jnp.asarray(hs, jnp.float32) / img_h
+    sx = jnp.asarray(sx, jnp.float32) * step_w / img_w
+    sy = jnp.asarray(sy, jnp.float32) * step_h / img_h
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w / img_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h / img_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxs = cxg[..., None] + sx
+    cys = cyg[..., None] + sy
+    boxes = jnp.stack([cxs - 0.5 * ws, cys - 0.5 * hs,
+                       cxs + 0.5 * ws, cys + 0.5 * hs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        return boxes.reshape(-1, 4), var.reshape(-1, 4)
+    return boxes, var
+
+
+def _bilinear_sample_nchw(x, ys, xs):
+    """Bilinear sample x (C, H, W) at float coords ys/xs (...,); zero
+    outside.  Gather-based — lowers to XLA gather, no host sync."""
+    C, H, W = x.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    out = 0.0
+    for dy, wgt_y in ((0, 1.0 - wy), (1, wy)):
+        for dx, wgt_x in ((0, 1.0 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = x[:, yc, xc]                      # (C, ...)
+            w = jnp.where(inb, wgt_y * wgt_x, 0.0)
+            out = out + v * w
+    return out
+
+
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, groups: int = 1, deformable_groups: int = 1,
+                    bias=None):
+    """Deformable convolution v2 (v1 when ``mask`` is None).
+
+    Reference parity: deformable_conv_op.cu / deformable_conv_v1_op.cu
+    (modulated_deformable_im2col CUDA kernels).  TPU-native design: the
+    offset-shifted bilinear sampling is a batched XLA gather building the
+    im2col tensor, then one big einsum hits the MXU — no scatter, no
+    dynamic shapes.
+
+    Shapes: x (N,C,H,W); offset (N, 2*dg*kh*kw, Ho, Wo);
+    mask (N, dg*kh*kw, Ho, Wo); weight (out_c, C/groups, kh, kw).
+    """
+    x = jnp.asarray(x)
+    offset = jnp.asarray(offset)
+    weight = jnp.asarray(weight)
+    N, C, H, W = x.shape
+    out_c, cpg, kh, kw = weight.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+    K = kh * kw
+
+    oy = (jnp.arange(Ho) * sh - ph).astype(jnp.float32)
+    ox = (jnp.arange(Wo) * sw - pw).astype(jnp.float32)
+    ky = (jnp.arange(kh) * dh).astype(jnp.float32)
+    kx = (jnp.arange(kw) * dw).astype(jnp.float32)
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # Ho,1,kh,1
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # 1,Wo,1,kw
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    off_y = jnp.moveaxis(off[:, :, :, 0], (2, 3, 4), (4, 2, 3))   # N,dg,Ho,Wo,K
+    off_x = jnp.moveaxis(off[:, :, :, 1], (2, 3, 4), (4, 2, 3))
+    ys = base_y[None, None] + off_y                               # N,dg,Ho,Wo,K
+    xs = base_x[None, None] + off_x
+    if mask is not None:
+        m = jnp.moveaxis(jnp.asarray(mask).reshape(N, dg, K, Ho, Wo),
+                         2, -1)                                   # N,dg,Ho,Wo,K
+    else:
+        m = jnp.ones((N, dg, Ho, Wo, K), x.dtype)
+
+    cols = jax.vmap(  # over batch
+        lambda xb, yb, xbx, mb: jnp.concatenate([
+            _bilinear_sample_nchw(
+                xb[g * (C // dg):(g + 1) * (C // dg)], yb[g], xbx[g]) * mb[g]
+            for g in range(dg)], axis=0)
+    )(x, ys, xs, m)                                # (N, C, Ho, Wo, K)
+    cols = jnp.moveaxis(cols, -1, 2)               # (N, C, K, Ho, Wo)
+    cols = cols.reshape(N, groups, C // groups, K, Ho, Wo)
+    wg = weight.reshape(groups, out_c // groups, cpg, K)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, out_c, Ho, Wo).astype(x.dtype)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1, 1, 1)
+    return out
+
+
+def psroi_pool(x, rois, roi_batch_id, output_channels: int,
+               pooled_height: int, pooled_width: int,
+               spatial_scale: float = 1.0):
+    """Position-sensitive ROI pooling (ref psroi_pool_op.cc): input channel
+    layout (N, out_c*ph*pw, H, W); bin (i, j) of output channel c averages
+    input channel c*ph*pw + i*pw + j over the bin's spatial extent."""
+    x = jnp.asarray(x)
+    rois = jnp.asarray(rois, jnp.float32)
+    roi_batch_id = jnp.asarray(roi_batch_id, jnp.int32)
+    N, C, H, W = x.shape
+    ph, pw = pooled_height, pooled_width
+    if C != output_channels * ph * pw:
+        raise ValueError(
+            f"psroi_pool: input channels {C} != out_c*ph*pw "
+            f"({output_channels}*{ph}*{pw})")
+
+    ii = jnp.arange(H, dtype=jnp.float32)[:, None]
+    jj = jnp.arange(W, dtype=jnp.float32)[None, :]
+
+    def one_roi(roi, bi):
+        # ref psroi_pool_op.h: round the RAW roi, +1 on the end coords,
+        # THEN apply spatial_scale (order matters for scale != 1)
+        x1 = jnp.round(roi[0]) * spatial_scale
+        y1 = jnp.round(roi[1]) * spatial_scale
+        x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        feat = x[bi].reshape(output_channels, ph, pw, H, W)
+        gy = jnp.arange(ph, dtype=jnp.float32)
+        gx = jnp.arange(pw, dtype=jnp.float32)
+        ys = y1 + gy[:, None] * bin_h          # (ph, 1) bin start
+        ye = y1 + (gy[:, None] + 1) * bin_h
+        xs = x1 + gx[None, :] * bin_w          # (1, pw)
+        xe = x1 + (gx[None, :] + 1) * bin_w
+        in_y = ((ii[None, None] >= jnp.floor(ys)[..., None, None]) &
+                (ii[None, None] < jnp.ceil(ye)[..., None, None]) &
+                (ii[None, None] >= 0) & (ii[None, None] <= H - 1))
+        in_x = ((jj[None, None] >= jnp.floor(xs)[..., None, None]) &
+                (jj[None, None] < jnp.ceil(xe)[..., None, None]) &
+                (jj[None, None] >= 0) & (jj[None, None] <= W - 1))
+        sel = (in_y & in_x).astype(x.dtype)    # (ph, pw, H, W)
+        cnt = jnp.maximum(jnp.sum(sel, axis=(-2, -1)), 1.0)
+        s = jnp.einsum("cpqhw,pqhw->cpq", feat, sel)
+        return s / cnt
+
+    return jax.vmap(one_roi)(rois, roi_batch_id)

@@ -1546,3 +1546,319 @@ def nce(input, label, num_total_classes, sample_ids, param_attr=None,
             {"Cost": [out.name]},
             {"num_total_classes": int(num_total_classes)})
     return out
+
+
+# -- CTC / sequence distance (ref fluid/layers/loss.py warpctc,
+#    fluid/layers/nn.py edit_distance, ctc_greedy_decoder) -------------------
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None) -> Variable:
+    """ref fluid/layers/loss.py warpctc -> warpctc_op.cc (padded mode:
+    input (T, B, C), label (B, L), lengths (B,))."""
+    if input_length is None or label_length is None:
+        raise ValueError("padded-mode warpctc needs input_length and "
+                         "label_length (LoD mode is descoped: README)")
+    B = input.shape[1]
+    loss = _out(input.dtype, (B, 1))
+    _append("warpctc",
+            {"Logits": [input.name], "Label": [label.name],
+             "LogitsLength": [input_length.name],
+             "LabelLength": [label_length.name]},
+            {"Loss": [loss.name]},
+            {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """ref fluid/layers/nn.py edit_distance -> edit_distance_op.cc.
+    Returns (distance (B,1), seq_num (1,))."""
+    B = input.shape[0]
+    dist = _out("float32", (B, 1))
+    num = _out("int32", (1,))
+    ins = {"Hyps": [input.name], "Refs": [label.name]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length.name]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length.name]
+    _append("edit_distance", ins,
+            {"Out": [dist.name], "SequenceNum": [num.name]},
+            {"normalized": normalized})
+    return dist, num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0):
+    """ref fluid/layers/nn.py ctc_greedy_decoder (padded mode) ->
+    ctc_align_op: input (B, T, C).  Returns (decoded (B,T), lengths (B,))."""
+    B, T = input.shape[0], input.shape[1]
+    out = _out("int32", (B, T))
+    lens = _out("int32", (B,))
+    ins = {"Input": [input.name]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length.name]
+    _append("ctc_align", ins,
+            {"Output": [out.name], "OutputLength": [lens.name]},
+            {"blank": blank, "padding_value": padding_value})
+    return out, lens
+
+
+# -- 3D conv/pool family (ref fluid/layers/nn.py conv3d/pool3d/...) ----------
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * 3
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None
+           ) -> Variable:
+    """ref fluid/layers/nn.py conv3d (NCDHW) -> conv3d op."""
+    ks = _triple(filter_size)
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    cin = input.shape[1]
+    w = create_parameter((num_filters, cin // groups) + ks, input.dtype,
+                         attr=param_attr)
+    spatial = tuple(
+        -1 if input.shape[2 + i] < 0 else
+        (input.shape[2 + i] + 2 * pd[i] - (dl[i] * (ks[i] - 1) + 1))
+        // st[i] + 1 for i in range(3))
+    out = _out(input.dtype, (input.shape[0], num_filters) + spatial)
+    ins = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), input.dtype, attr=bias_attr,
+                             default_initializer=I.Constant(0.0))
+        ins["Bias"] = [b.name]
+    _append("conv3d", ins, {"Output": [out.name]},
+            {"strides": list(st), "paddings": list(pd),
+             "dilations": list(dl), "groups": groups})
+    return _apply_act(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None) -> Variable:
+    """ref fluid/layers/nn.py conv3d_transpose -> conv3d_transpose op."""
+    ks = _triple(filter_size)
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    opd = _triple(output_padding)
+    cin = input.shape[1]
+    w = create_parameter((cin, num_filters // groups) + ks, input.dtype,
+                         attr=param_attr)
+    spatial = tuple(
+        -1 if input.shape[2 + i] < 0 else
+        (input.shape[2 + i] - 1) * st[i] - 2 * pd[i]
+        + dl[i] * (ks[i] - 1) + 1 + opd[i] for i in range(3))
+    out = _out(input.dtype, (input.shape[0], num_filters) + spatial)
+    ins = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), input.dtype, attr=bias_attr,
+                             default_initializer=I.Constant(0.0))
+        ins["Bias"] = [b.name]
+    _append("conv3d_transpose", ins, {"Output": [out.name]},
+            {"strides": list(st), "paddings": list(pd),
+             "dilations": list(dl), "groups": groups,
+             "output_padding": list(opd)})
+    return _apply_act(out, act)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, exclusive=True,
+           name=None) -> Variable:
+    """ref fluid/layers/nn.py pool3d -> pool3d op (NCDHW)."""
+    ks = _triple(pool_size)
+    st = _triple(pool_stride if pool_stride is not None else pool_size)
+    pd = _triple(pool_padding)
+    if global_pooling:
+        spatial = (1, 1, 1)
+    else:
+        spatial = tuple(
+            -1 if input.shape[2 + i] < 0 else
+            (input.shape[2 + i] + 2 * pd[i] - ks[i]) // st[i] + 1
+            for i in range(3))
+    out = _out(input.dtype, (input.shape[0], input.shape[1]) + spatial)
+    _append("pool3d", {"X": [input.name]}, {"Out": [out.name]},
+            {"ksize": list(ks), "strides": list(st), "paddings": list(pd),
+             "pooling_type": pool_type, "global_pooling": global_pooling,
+             "exclusive": exclusive})
+    return out
+
+
+# -- detection DSL (ref fluid/layers/detection.py) ---------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    """ref detection.py yolo_box -> yolo_box op.  Returns (boxes, scores)."""
+    n = x.shape[0]
+    an = len(anchors) // 2
+    hw = x.shape[2] * x.shape[3] if x.shape[2] > 0 and x.shape[3] > 0 else -1
+    cnt = an * hw if hw > 0 else -1
+    boxes = _out(x.dtype, (n, cnt, 4))
+    scores = _out(x.dtype, (n, cnt, class_num))
+    _append("yolo_box", {"X": [x.name], "ImgSize": [img_size.name]},
+            {"Boxes": [boxes.name], "Scores": [scores.name]},
+            {"anchors": list(anchors), "class_num": class_num,
+             "conf_thresh": conf_thresh,
+             "downsample_ratio": downsample_ratio, "clip_bbox": clip_bbox,
+             "scale_x_y": scale_x_y})
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None) -> Variable:
+    """ref detection.py yolov3_loss -> yolov3_loss op."""
+    loss = _out(x.dtype, (x.shape[0],))
+    ins = {"X": [x.name], "GTBox": [gt_box.name], "GTLabel": [gt_label.name]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score.name]
+    _append("yolov3_loss", ins, {"Loss": [loss.name]},
+            {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+             "class_num": class_num, "ignore_thresh": ignore_thresh,
+             "downsample_ratio": downsample_ratio,
+             "use_label_smooth": use_label_smooth, "scale_x_y": scale_x_y})
+    return loss
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
+                   keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None) -> Variable:
+    """ref detection.py multiclass_nms -> multiclass_nms op (dense padded
+    output, (N, keep, 6))."""
+    n = bboxes.shape[0]
+    keep = keep_top_k if keep_top_k > 0 else -1
+    out = _out(bboxes.dtype, (n, keep, 6))
+    idx = _out("int64", (n, keep))
+    num = _out("int32", (n,))
+    _append("multiclass_nms",
+            {"BBoxes": [bboxes.name], "Scores": [scores.name]},
+            {"Out": [out.name], "Index": [idx.name],
+             "NmsRoisNum": [num.name]},
+            {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+             "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+             "normalized": normalized,
+             "background_label": background_label})
+    return out
+
+
+def density_prior_box(input, image, densities, fixed_sizes,
+                      fixed_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """ref detection.py density_prior_box -> density_prior_box op."""
+    num = sum(d * d for d in densities for _ in fixed_ratios)
+    H, W = input.shape[2], input.shape[3]
+    shape = (-1, 4) if flatten_to_2d else (H, W, num, 4)
+    boxes = _out(input.dtype, shape)
+    variances = _out(input.dtype, shape)
+    _append("density_prior_box",
+            {"Input": [input.name], "Image": [image.name]},
+            {"Boxes": [boxes.name], "Variances": [variances.name]},
+            {"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+             "fixed_ratios": list(fixed_ratios), "variances": list(variance),
+             "clip": clip, "step_w": steps[0], "step_h": steps[1],
+             "offset": offset, "flatten_to_2d": flatten_to_2d})
+    return boxes, variances
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None) -> Variable:
+    """ref fluid/layers/nn.py deformable_conv -> deformable_conv(_v1) op."""
+    ks = _pair(filter_size)
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    cin = input.shape[1]
+    w = create_parameter((num_filters, cin // groups) + ks, input.dtype,
+                         attr=param_attr)
+    spatial = tuple(
+        -1 if input.shape[2 + i] < 0 else
+        (input.shape[2 + i] + 2 * pd[i] - (dl[i] * (ks[i] - 1) + 1))
+        // st[i] + 1 for i in range(2))
+    out = _out(input.dtype, (input.shape[0], num_filters) + spatial)
+    ins = {"Input": [input.name], "Offset": [offset.name],
+           "Filter": [w.name]}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = [mask.name]
+    _append(op_type, ins, {"Output": [out.name]},
+            {"strides": list(st), "paddings": list(pd),
+             "dilations": list(dl), "groups": groups,
+             "deformable_groups": deformable_groups,
+             "im2col_step": im2col_step})
+    return out
+
+
+def psroi_pool(input, rois, rois_batch_id, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None) -> Variable:
+    """ref detection.py psroi_pool -> psroi_pool op."""
+    out = _out(input.dtype,
+               (rois.shape[0], output_channels, pooled_height, pooled_width))
+    _append("psroi_pool",
+            {"X": [input.name], "ROIs": [rois.name],
+             "RoisBatchId": [rois_batch_id.name]},
+            {"Out": [out.name]},
+            {"output_channels": output_channels,
+             "pooled_height": pooled_height, "pooled_width": pooled_width,
+             "spatial_scale": spatial_scale})
+    return out
+
+
+# -- misc new statics --------------------------------------------------------
+
+def affine_channel(x, scale, bias, name=None) -> Variable:
+    """ref fluid/layers/nn.py affine_channel."""
+    out = _out(x.dtype, x.shape)
+    _append("affine_channel",
+            {"X": [x.name], "Scale": [scale.name], "Bias": [bias.name]},
+            {"Out": [out.name]}, {})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None
+           ) -> Variable:
+    """ref fluid/layers/nn.py unfold -> unfold op (im2col)."""
+    ks = _pair(kernel_sizes)
+    st, pd, dl = _pair(strides), _pair(paddings), _pair(dilations)
+    n, c, h, w = x.shape
+    lh = -1 if h < 0 else (h + 2 * pd[0] - (dl[0] * (ks[0] - 1) + 1)) \
+        // st[0] + 1
+    lw = -1 if w < 0 else (w + 2 * pd[1] - (dl[1] * (ks[1] - 1) + 1)) \
+        // st[1] + 1
+    L = -1 if (lh < 0 or lw < 0) else lh * lw
+    out = _out(x.dtype, (n, c * ks[0] * ks[1], L))
+    _append("unfold", {"X": [x.name]}, {"Y": [out.name]},
+            {"kernel_sizes": list(ks), "strides": list(st),
+             "paddings": list(pd), "dilations": list(dl)})
+    return out
+
+
+def maxout(x, groups, name=None) -> Variable:
+    """ref fluid/layers/nn.py maxout."""
+    out = _out(x.dtype,
+               (x.shape[0], x.shape[1] // groups) + tuple(x.shape[2:]))
+    _append("maxout", {"X": [x.name]}, {"Out": [out.name]},
+            {"groups": groups})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """ref fluid/layers/nn.py mean_iou.  Returns (mean_iou, out_wrong,
+    out_correct)."""
+    miou = _out("float32", ())
+    wrong = _out("float32", (num_classes,))
+    correct = _out("float32", (num_classes,))
+    _append("mean_iou",
+            {"Predictions": [input.name], "Labels": [label.name]},
+            {"OutMeanIou": [miou.name], "OutWrong": [wrong.name],
+             "OutCorrect": [correct.name]},
+            {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    """ref fluid/layers/tensor.py argsort.  Returns (sorted, indices)."""
+    out = _out(x.dtype, x.shape)
+    idx = _out("int64", x.shape)
+    _append("argsort", {"X": [x.name]},
+            {"Out": [out.name], "Indices": [idx.name]},
+            {"axis": axis, "descending": descending})
+    return out, idx

@@ -1049,3 +1049,6 @@ def _nce(ins, attrs, op):
                           _one(ins, "SampleIds"),
                           num_total_classes=attrs.get("num_total_classes"))
     return {"Cost": [cost]}
+
+
+from . import ops_tail  # noqa: E402,F401 — long-tail lowerings (registry side effects)

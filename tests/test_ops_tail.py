@@ -1,0 +1,1045 @@
+"""OpTest coverage for the static-op long tail (static/ops_tail.py).
+
+Mirrors the reference's per-op test files (unittests/test_warpctc_op.py,
+test_conv3d_op.py, test_pool3d_op.py, test_deformable_conv_op.py,
+test_bilinear_interp_op.py, test_adamax_op.py, ...): numpy/torch oracles
+for the new implementations, the independently-tested eager library as the
+oracle for delegation rules, and analytic-vs-numeric check_grad on the
+differentiable ops.
+"""
+import numpy as np
+import pytest
+
+from tests.op_test_base import OpTest
+
+RNG = np.random.default_rng(7)
+
+
+def _eager():
+    import paddle_tpu.ops as T
+
+    return T
+
+
+# -- CTC / distance ----------------------------------------------------------
+
+class TestWarpCTCOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        T_, B, C, L = 8, 3, 5, 3
+        logits = RNG.normal(0, 1, (T_, B, C)).astype("float32")
+        label = RNG.integers(1, C, (B, L)).astype("int32")
+        llen = np.array([8, 6, 8], np.int32)
+        lablen = np.array([3, 2, 3], np.int32)
+        expect = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), dim=-1),
+            torch.tensor(label.astype(np.int64)),
+            torch.tensor(llen.astype(np.int64)),
+            torch.tensor(lablen.astype(np.int64)),
+            blank=0, reduction="none").numpy().astype("float32")
+        self.op_type = "warpctc"
+        self.inputs = {"Logits": logits, "Label": label,
+                       "LogitsLength": llen, "LabelLength": lablen}
+        self.attrs = {"blank": 0}
+        self.outputs = {"Loss": expect[:, None]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=5e-2)
+
+
+class TestEditDistanceOp(OpTest):
+    def setup_method(self):
+        hyps = np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int32)
+        refs = np.array([[1, 3, 3], [6, 6, 6]], np.int32)
+        hlen = np.array([4, 3], np.int32)
+        rlen = np.array([3, 3], np.int32)
+        # lev(1234, 133)=2; lev(567, 666)=2
+        self.op_type = "edit_distance"
+        self.inputs = {"Hyps": hyps, "Refs": refs, "HypsLength": hlen,
+                       "RefsLength": rlen}
+        self.attrs = {"normalized": False}
+        self.outputs = {"Out": np.array([[2.0], [2.0]], np.float32),
+                        "SequenceNum": np.array([2], np.int64)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCTCAlignOp(OpTest):
+    def setup_method(self):
+        probs = np.zeros((1, 5, 4), np.float32)
+        for t, c in enumerate([2, 2, 0, 1, 1]):
+            probs[0, t, c] = 1.0
+        self.op_type = "ctc_align"
+        self.inputs = {"Input": probs,
+                       "InputLength": np.array([5], np.int32)}
+        self.attrs = {"blank": 0}
+        self.outputs = {"Output": np.array([[2, 1, 0, 0, 0]], np.int32),
+                        "OutputLength": np.array([2], np.int32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+# -- 3D conv/pool ------------------------------------------------------------
+
+class TestConv3DOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (2, 3, 5, 6, 7)).astype("float32")
+        w = RNG.normal(0, 1, (4, 3, 3, 3, 3)).astype("float32")
+        expect = torch.nn.functional.conv3d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+        self.op_type = "conv3d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                      "dilations": [1, 1, 1], "groups": 1}
+        self.outputs = {"Output": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestConv3DTransposeOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (1, 4, 3, 4, 5)).astype("float32")
+        w = RNG.normal(0, 1, (4, 3, 3, 3, 3)).astype("float32")
+        expect = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            output_padding=1).numpy()
+        self.op_type = "conv3d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                      "dilations": [1, 1, 1], "groups": 1,
+                      "output_padding": [1, 1, 1]}
+        self.outputs = {"Output": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestPool3DMaxOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        # well-separated values (no fd argmax flips) and a small tensor so
+        # the mean-loss probe differences stay above fp32 cancellation
+        x = (RNG.permutation(2 * 4 ** 3).reshape(1, 2, 4, 4, 4)
+             .astype("float32") * 0.1)
+        expect = torch.nn.functional.max_pool3d(
+            torch.tensor(x), 2, stride=2).numpy()
+        self.op_type = "pool3d"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0], "pooling_type": "max"}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestPool3DAvgOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (2, 3, 6, 6, 6)).astype("float32")
+        expect = torch.nn.functional.avg_pool3d(
+            torch.tensor(x), 2, stride=2).numpy()
+        self.op_type = "pool3d"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0], "pooling_type": "avg"}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDepthwiseConv2DOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (2, 4, 8, 8)).astype("float32")
+        w = RNG.normal(0, 1, (4, 1, 3, 3)).astype("float32")
+        expect = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), padding=1, groups=4).numpy()
+        self.op_type = "depthwise_conv2d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 4}
+        self.outputs = {"Output": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestUnfoldOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (2, 3, 6, 6)).astype("float32")
+        expect = torch.nn.functional.unfold(
+            torch.tensor(x), 3, padding=1, stride=2).numpy()
+        self.op_type = "unfold"
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_sizes": [3, 3], "strides": [2, 2],
+                      "paddings": [1, 1], "dilations": [1, 1]}
+        self.outputs = {"Y": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+
+class TestPad3DOp(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (1, 2, 3, 4, 5)).astype("float32")
+        expect = np.pad(x, [(0, 0), (0, 0), (1, 2), (0, 1), (2, 0)],
+                        constant_values=1.5)
+        self.op_type = "pad3d"
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [2, 0, 0, 1, 1, 2], "mode": "constant",
+                      "value": 1.5}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+# -- interpolate family ------------------------------------------------------
+
+class TestBilinearInterpV2Op(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (2, 3, 6, 6)).astype("float32")
+        expect = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(9, 4), mode="bilinear",
+            align_corners=False).numpy()
+        self.op_type = "bilinear_interp_v2"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 9, "out_w": 4, "align_corners": False}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestBicubicInterpV2Op(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (1, 2, 6, 6)).astype("float32")
+        expect = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(9, 5), mode="bicubic",
+            align_corners=True).numpy()
+        self.op_type = "bicubic_interp_v2"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 9, "out_w": 5, "align_corners": True}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestTrilinearInterpV2Op(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (1, 2, 4, 6, 6)).astype("float32")
+        expect = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(6, 9, 5), mode="trilinear",
+            align_corners=False).numpy()
+        self.op_type = "trilinear_interp_v2"
+        self.inputs = {"X": x}
+        self.attrs = {"out_d": 6, "out_h": 9, "out_w": 5,
+                      "align_corners": False}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+
+# -- detection ---------------------------------------------------------------
+
+class TestDeformableConvOp(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (1, 3, 6, 6)).astype("float32")
+        w = RNG.normal(0, 1, (4, 3, 3, 3)).astype("float32")
+        # keep sample points away from integer coords: bilinear sampling has
+        # gradient kinks there that break the finite-difference probe
+        offset = RNG.uniform(0.15, 0.35, (1, 18, 4, 4)).astype("float32")
+        mask = RNG.uniform(0, 1, (1, 9, 4, 4)).astype("float32")
+        from paddle_tpu.ops.vision import deformable_conv
+
+        expect = np.asarray(deformable_conv(x, offset, w, mask=mask))
+        self.op_type = "deformable_conv"
+        self.inputs = {"Input": x, "Offset": offset, "Filter": w,
+                       "Mask": mask}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1}
+        self.outputs = {"Output": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Offset"], "Output",
+                        max_relative_error=2e-2)
+
+
+class TestPSROIPoolOp(OpTest):
+    def setup_method(self):
+        x = np.zeros((1, 8, 8, 8), np.float32)
+        for c in range(8):
+            x[0, c] = c
+        self.op_type = "psroi_pool"
+        self.inputs = {"X": x,
+                       "ROIs": np.array([[0., 0., 7., 7.]], np.float32),
+                       "RoisBatchId": np.array([0], np.int32)}
+        self.attrs = {"output_channels": 2, "pooled_height": 2,
+                      "pooled_width": 2, "spatial_scale": 1.0}
+        self.outputs = {"Out": np.arange(8, dtype=np.float32).reshape(
+            1, 2, 2, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDensityPriorBoxOp(OpTest):
+    def setup_method(self):
+        from paddle_tpu.ops.vision import density_prior_box
+
+        x = np.zeros((1, 3, 4, 4), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        boxes, var = density_prior_box((4, 4), (32, 32), [2], [8.0], [1.0])
+        self.op_type = "density_prior_box"
+        self.inputs = {"Input": x, "Image": img}
+        self.attrs = {"densities": [2], "fixed_sizes": [8.0],
+                      "fixed_ratios": [1.0]}
+        self.outputs = {"Boxes": np.asarray(boxes),
+                        "Variances": np.asarray(var)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestYoloBoxOp(OpTest):
+    def setup_method(self):
+        from paddle_tpu.ops.vision import yolo_box
+
+        x = RNG.normal(0, 1, (1, 18, 4, 4)).astype("float32")
+        img = np.array([[128, 128]], np.int32)
+        boxes, scores = yolo_box(x, img, [10, 13, 16, 30], 4, 0.01, 32)
+        self.op_type = "yolo_box"
+        self.inputs = {"X": x, "ImgSize": img}
+        self.attrs = {"anchors": [10, 13, 16, 30], "class_num": 4,
+                      "conf_thresh": 0.01, "downsample_ratio": 32}
+        self.outputs = {"Boxes": np.asarray(boxes),
+                        "Scores": np.asarray(scores)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+
+# -- optimizer ops: one static step == one eager param_update ---------------
+
+def _opt_case(op_type, ins, attrs, outs):
+    class _T(OpTest):
+        def setup_method(self):
+            self.op_type = op_type
+            self.inputs = ins
+            self.attrs = attrs
+            self.outputs = outs
+
+        def test_output(self):
+            self.check_output(atol=1e-5, rtol=1e-5)
+
+    _T.__name__ = f"Test{op_type.title().replace('_', '')}Op"
+    return _T
+
+
+def _mk_adamax():
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer.optimizers import Adamax
+
+    p = RNG.normal(0, 1, (4, 3)).astype("float32")
+    g = RNG.normal(0, 1, (4, 3)).astype("float32")
+    m = np.zeros((4, 3), np.float32)
+    u = np.zeros((4, 3), np.float32)
+    opt = Adamax(0.1)
+    p_new, (m_new, u_new) = opt.param_update(
+        jnp.asarray(g), jnp.asarray(p), (jnp.asarray(m), jnp.asarray(u)),
+        jnp.float32(0.1), jnp.int32(1))
+    return _opt_case(
+        "adamax",
+        {"Param": p, "Grad": g, "Moment": m, "InfNorm": u,
+         "LearningRate": np.float32(0.1),
+         "Beta1Pow": np.float32(0.9)},
+        {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        {"ParamOut": np.asarray(p_new), "MomentOut": np.asarray(m_new),
+         "InfNormOut": np.asarray(u_new)})
+
+
+TestAdamaxOp = _mk_adamax()
+
+
+def _mk_adagrad():
+    p = RNG.normal(0, 1, (5,)).astype("float32")
+    g = RNG.normal(0, 1, (5,)).astype("float32")
+    acc = np.abs(RNG.normal(0, 1, (5,))).astype("float32")
+    acc_new = acc + g * g
+    p_new = p - 0.1 * g / (np.sqrt(acc_new) + 1e-6)
+    return _opt_case(
+        "adagrad",
+        {"Param": p, "Grad": g, "Moment": acc,
+         "LearningRate": np.float32(0.1)},
+        {"epsilon": 1e-6},
+        {"ParamOut": p_new, "MomentOut": acc_new})
+
+
+TestAdagradOp = _mk_adagrad()
+
+
+def _mk_rmsprop():
+    p = RNG.normal(0, 1, (5,)).astype("float32")
+    g = RNG.normal(0, 1, (5,)).astype("float32")
+    ms = np.abs(RNG.normal(0, 1, (5,))).astype("float32")
+    mom = np.zeros((5,), np.float32)
+    ms_new = 0.9 * ms + 0.1 * g * g
+    mom_new = 0.0 * mom + 0.1 * g / np.sqrt(ms_new + 1e-10)
+    p_new = p - mom_new
+    return _opt_case(
+        "rmsprop",
+        {"Param": p, "Grad": g, "MeanSquare": ms,
+         "MeanGrad": np.zeros((5,), np.float32), "Moment": mom,
+         "LearningRate": np.float32(0.1)},
+        {"decay": 0.9, "epsilon": 1e-10, "momentum": 0.0},
+        {"ParamOut": p_new, "MeanSquareOut": ms_new, "MomentOut": mom_new})
+
+
+TestRmspropOp = _mk_rmsprop()
+
+
+def _mk_ftrl():
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer.extras import Ftrl
+
+    p = RNG.normal(0, 1, (6,)).astype("float32")
+    g = RNG.normal(0, 1, (6,)).astype("float32")
+    sq = np.abs(RNG.normal(0, 1, (6,))).astype("float32")
+    lin = RNG.normal(0, 1, (6,)).astype("float32")
+    opt = Ftrl(0.05, l1=0.1, l2=0.01)
+    p_new, s_new = opt.param_update(
+        jnp.asarray(g), jnp.asarray(p),
+        {"squared": jnp.asarray(sq), "linear": jnp.asarray(lin)},
+        jnp.float32(0.05), jnp.int32(1))
+    return _opt_case(
+        "ftrl",
+        {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+         "LinearAccumulator": lin, "LearningRate": np.float32(0.05)},
+        {"l1": 0.1, "l2": 0.01, "lr_power": -0.5},
+        {"ParamOut": np.asarray(p_new),
+         "SquaredAccumOut": np.asarray(s_new["squared"]),
+         "LinearAccumOut": np.asarray(s_new["linear"])})
+
+
+TestFtrlOp = _mk_ftrl()
+
+
+def test_static_optimizer_classes_train():
+    """A LeNet-ish regression must train a step with every new static
+    optimizer class (ref fluid.optimizer surface)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+    from paddle_tpu.static import optimizer as opt_mod
+
+    for cls, kwargs in [
+            (opt_mod.AdamW, {}), (opt_mod.Adagrad, {}),
+            (opt_mod.Adadelta, {}), (opt_mod.RMSProp, {}),
+            (opt_mod.Lamb, {}), (opt_mod.Ftrl, {}),
+            (opt_mod.LarsMomentum, {}), (opt_mod.Dpsgd, {})]:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", (4, 8), append_batch_size=False)
+            y = static.data("y", (4, 1), append_batch_size=False)
+            pred = L.fc(x, 1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            cls(learning_rate=0.01, **kwargs).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": RNG.normal(0, 1, (4, 8)).astype("float32"),
+                "y": RNG.normal(0, 1, (4, 1)).astype("float32")}
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        l1, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(l0) and np.isfinite(l1), cls.__name__
+        if cls is not opt_mod.Dpsgd:  # dpsgd adds noise
+            assert l1 <= l0 + 1e-4, (cls.__name__, float(l0), float(l1))
+
+
+# -- beam search -------------------------------------------------------------
+
+class TestBeamSearchOp(OpTest):
+    def setup_method(self):
+        scores = np.array([[[0.1, 0.9, 0.3], [0.8, 0.2, 0.7]]], np.float32)
+        # flat: [0.1 0.9 0.3 | 0.8 0.2 0.7] -> top2 = 0.9 (beam0,v1),
+        # 0.8 (beam1,v0)
+        self.op_type = "beam_search"
+        self.inputs = {"Scores": scores}
+        self.attrs = {"beam_size": 2}
+        self.outputs = {"SelectedIds": np.array([[1, 0]], np.int64),
+                        "ParentIdx": np.array([[0, 1]], np.int64),
+                        "SelectedScores": np.array([[0.9, 0.8]],
+                                                   np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGatherTreeOp(OpTest):
+    def setup_method(self):
+        ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], np.int64)
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+        from paddle_tpu.nn.decode import gather_tree
+
+        self.op_type = "gather_tree"
+        self.inputs = {"Ids": ids, "Parents": parents}
+        self.outputs = {"Out": np.asarray(gather_tree(ids, parents))}
+
+    def test_output(self):
+        self.check_output()
+
+
+# -- quantization ops --------------------------------------------------------
+
+class TestFakeQuantizeDequantizeAbsMaxOp(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (4, 4)).astype("float32")
+        scale = np.abs(x).max()
+        q = np.round(x / scale * 127) / 127 * scale
+        self.op_type = "fake_quantize_dequantize_abs_max"
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": q.astype("float32"),
+                        "OutScale": np.array([scale], np.float32)}
+
+    def test_output(self):
+        self.check_output(atol=1e-6, rtol=1e-5)
+
+    def test_grad_is_straight_through(self):
+        """STE: analytic grad w.r.t. X is exactly identity/N (a numeric
+        probe would see round()'s staircase, so compare analytically)."""
+        import paddle_tpu.static as static
+
+        main, startup, _, _, grad_fetches = self._build(grad_of=("Out",
+                                                                 ["X"]))
+        exe = static.Executor()
+        exe.run(startup)
+        g, = exe.run(main, feed=self._feed(), fetch_list=grad_fetches)
+        np.testing.assert_allclose(
+            g, np.full_like(self.inputs["X"], 1.0 / self.inputs["X"].size),
+            rtol=1e-6)
+
+
+class TestFakeChannelWiseQuantizeDequantizeOp(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (3, 4)).astype("float32")
+        scale = np.maximum(np.abs(x).max(axis=1), 1e-8)
+        q = np.round(x / scale[:, None] * 127) / 127 * scale[:, None]
+        self.op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8, "quant_axis": 0}
+        self.outputs = {"Out": q.astype("float32"),
+                        "OutScale": scale.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-6, rtol=1e-5)
+
+
+# -- delegation tail: numeric spot checks through the static executor --------
+
+def _delegate_case(op_type, ins, attrs, outs, name=None, atol=1e-5):
+    class _T(OpTest):
+        def setup_method(self):
+            self.op_type = op_type
+            self.inputs = ins
+            self.attrs = attrs
+            self.outputs = outs
+
+        def test_output(self):
+            self.check_output(atol=atol, rtol=1e-5)
+
+    _T.__name__ = name or f"Test{op_type.title().replace('_', '')}Op"
+    return _T
+
+
+_x34 = RNG.normal(0, 1, (3, 4)).astype("float32")
+_y45 = RNG.normal(0, 1, (4, 5)).astype("float32")
+_b234 = RNG.normal(0, 1, (2, 3, 4)).astype("float32")
+_b245 = RNG.normal(0, 1, (2, 4, 5)).astype("float32")
+
+TestMatmulV2Op = _delegate_case(
+    "matmul_v2", {"X": _x34, "Y": _y45}, {}, {"Out": _x34 @ _y45})
+TestBmmOp = _delegate_case(
+    "bmm", {"X": _b234, "Y": _b245}, {}, {"Out": _b234 @ _b245})
+TestDotOp = _delegate_case(
+    "dot", {"X": _x34, "Y": _x34.copy()}, {},
+    {"Out": np.sum(_x34 * _x34, axis=-1)})
+TestCrossOp = _delegate_case(
+    "cross", {"X": np.eye(3, dtype=np.float32),
+              "Y": np.roll(np.eye(3, dtype=np.float32), 1, axis=1)},
+    {"dim": -1},
+    {"Out": np.cross(np.eye(3, dtype=np.float32),
+                     np.roll(np.eye(3, dtype=np.float32), 1, axis=1))})
+TestKronOp = _delegate_case(
+    "kron", {"X": _x34[:2, :2], "Y": _x34[:2, :2].copy()}, {},
+    {"Out": np.kron(_x34[:2, :2], _x34[:2, :2])})
+def _fix_addmm():
+    inp = RNG.normal(0, 1, (3, 5)).astype("float32")
+    return _delegate_case(
+        "addmm", {"Input": inp, "X": _x34, "Y": _y45},
+        {"Alpha": 2.0, "Beta": 0.5},
+        {"Out": 0.5 * inp + 2.0 * (_x34 @ _y45)})
+
+
+TestAddmmOp = _fix_addmm()
+
+TestTraceOp = _delegate_case(
+    "trace", {"Input": _x34}, {"offset": 1},
+    {"Out": np.trace(_x34, offset=1)})
+TestPNormOp = _delegate_case(
+    "p_norm", {"X": _x34}, {"porder": 2.0, "axis": 1},
+    {"Out": np.linalg.norm(_x34, axis=1)})
+TestFrobeniusNormOp = _delegate_case(
+    "frobenius_norm", {"X": _x34}, {"dim": [0, 1]},
+    {"Out": np.linalg.norm(_x34)})
+TestLogsumexpOp = _delegate_case(
+    "logsumexp", {"X": _x34}, {"axis": [1]},
+    {"Out": np.log(np.sum(np.exp(_x34), axis=1))})
+TestFlipOp = _delegate_case(
+    "flip", {"X": _x34}, {"axis": [0]}, {"Out": _x34[::-1]})
+TestRollOp = _delegate_case(
+    "roll", {"X": _x34}, {"shifts": [1], "axis": [0]},
+    {"Out": np.roll(_x34, 1, axis=0)})
+TestTrilTriuOp = _delegate_case(
+    "tril_triu", {"X": _x34}, {"lower": True, "diagonal": 0},
+    {"Out": np.tril(_x34)})
+TestIndexSelectOp = _delegate_case(
+    "index_select", {"X": _x34, "Index": np.array([2, 0], np.int32)},
+    {"dim": 0}, {"Out": _x34[[2, 0]]})
+TestIndexSampleOp = _delegate_case(
+    "index_sample",
+    {"X": _x34, "Index": np.array([[0, 1], [2, 3], [1, 0]], np.int32)},
+    {}, {"Out": np.take_along_axis(
+        _x34, np.array([[0, 1], [2, 3], [1, 0]]), axis=1)})
+TestUnbindOp = _delegate_case(
+    "unbind", {"X": _b234}, {"axis": 0},
+    {"Out": [_b234[0], _b234[1]]})
+TestUnstackOp = _delegate_case(
+    "unstack", {"X": _b234}, {"axis": 1},
+    {"Y": [_b234[:, 0], _b234[:, 1], _b234[:, 2]]})
+TestStridedSliceOp = _delegate_case(
+    "strided_slice", {"Input": _x34},
+    {"axes": [1], "starts": [3], "ends": [0], "strides": [-2]},
+    {"Out": _x34[:, 3:0:-2]})
+TestExpandOp = _delegate_case(
+    "expand", {"X": _x34}, {"expand_times": [2, 1]},
+    {"Out": np.tile(_x34, (2, 1))})
+TestExpandAsV2Op = _delegate_case(
+    "expand_as_v2", {"X": _x34[:1], "Y": _x34}, {},
+    {"Out": np.broadcast_to(_x34[:1], _x34.shape)})
+TestFlattenV1Op = _delegate_case(
+    "flatten", {"X": _b234}, {"axis": 2},
+    {"Out": _b234.reshape(6, 4)})
+TestSqueezeV1Op = _delegate_case(
+    "squeeze", {"X": _x34[:, None]}, {"axes": [1]}, {"Out": _x34})
+TestUnsqueezeV1Op = _delegate_case(
+    "unsqueeze", {"X": _x34}, {"axes": [1]}, {"Out": _x34[:, None]})
+TestArgsortOp = _delegate_case(
+    "argsort", {"X": _x34}, {"axis": 1, "descending": True},
+    {"Out": -np.sort(-_x34, axis=1),
+     "Indices": np.argsort(-_x34, axis=1)})
+TestTopKV2Op = _delegate_case(
+    "top_k_v2", {"X": _x34}, {"k": 2, "axis": 1},
+    {"Out": -np.sort(-_x34, axis=1)[:, :2],
+     "Indices": np.argsort(-_x34, axis=1)[:, :2]})
+TestLookupTableOp = _delegate_case(
+    "lookup_table",
+    {"W": _y45, "Ids": np.array([[0], [3], [1]], np.int64)}, {},
+    {"Out": _y45[[0, 3, 1]]})
+TestMeshgridOp = _delegate_case(
+    "meshgrid",
+    {"X": [np.arange(3, dtype=np.float32),
+           np.arange(2, dtype=np.float32)]}, {},
+    {"Out": [np.meshgrid(np.arange(3, dtype=np.float32),
+                         np.arange(2, dtype=np.float32),
+                         indexing="ij")[0],
+             np.meshgrid(np.arange(3, dtype=np.float32),
+                         np.arange(2, dtype=np.float32),
+                         indexing="ij")[1]]})
+TestInverseOp = _delegate_case(
+    "inverse", {"Input": (_x34[:3, :3] + 3 * np.eye(3, dtype=np.float32))},
+    {}, {"Output": np.linalg.inv(_x34[:3, :3]
+                                 + 3 * np.eye(3, dtype=np.float32))},
+    atol=1e-4)
+TestCholeskyOp = _delegate_case(
+    "cholesky",
+    {"X": (_x34[:3, :3] @ _x34[:3, :3].T
+           + 3 * np.eye(3, dtype=np.float32))},
+    {"upper": False},
+    {"Out": np.linalg.cholesky(_x34[:3, :3] @ _x34[:3, :3].T
+                               + 3 * np.eye(3, dtype=np.float32))},
+    atol=1e-4)
+TestFillAnyLikeOp = _delegate_case(
+    "fill_any_like", {"X": _x34}, {"value": 2.5},
+    {"Out": np.full_like(_x34, 2.5)})
+TestLinspaceOp = _delegate_case(
+    "linspace", {"Start": np.float32(0.0), "Stop": np.float32(1.0)},
+    {"dtype": "float32", "num": 5},
+    {"Out": np.linspace(0, 1, 5, dtype=np.float32)})
+TestOneHotV1Op = _delegate_case(
+    "one_hot", {"X": np.array([[1], [0], [2]], np.int64)}, {"depth": 4},
+    {"Out": np.eye(4, dtype=np.float32)[[1, 0, 2]]})
+TestShardIndexOp = _delegate_case(
+    "shard_index", {"X": np.array([[1], [5], [9]], np.int64)},
+    {"index_num": 10, "nshards": 2, "shard_id": 1, "ignore_value": -1},
+    {"Out": np.array([[-1], [0], [4]], np.int64)})
+TestPartialSumOp = _delegate_case(
+    "partial_sum", {"X": [_x34, _x34.copy()]},
+    {"start_index": 1, "length": 2},
+    {"Out": 2 * _x34[:, 1:3]})
+TestPartialConcatOp = _delegate_case(
+    "partial_concat", {"X": [_x34, _x34.copy()]},
+    {"start_index": 0, "length": 2},
+    {"Out": np.concatenate([_x34[:, :2], _x34[:, :2]], axis=1)})
+TestMinusOp = _delegate_case(
+    "minus", {"X": _x34, "Y": _x34 * 0.5}, {}, {"Out": _x34 * 0.5})
+TestMaxoutOp = _delegate_case(
+    "maxout", {"X": _b234[:, :, :, None] * np.ones((1, 1, 1, 2),
+                                                   np.float32)},
+    {"groups": 3},
+    {"Out": _b234.reshape(2, 1, 3, 4, 1).max(axis=2)
+     * np.ones((1, 1, 1, 2), np.float32)[:, :1]})
+
+
+def test_maxout_matches_reference_semantics():
+    """maxout splits channels into groups and maxes within each."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    x = RNG.normal(0, 1, (2, 6, 3, 3)).astype("float32")
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", x.shape, append_batch_size=False)
+        out = L.maxout(xv, groups=3)
+    exe = static.Executor()
+    exe.run(startup)
+    got, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    expect = x.reshape(2, 2, 3, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+class TestSmoothL1Op(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (4, 3)).astype("float32")
+        y = RNG.normal(0, 1, (4, 3)).astype("float32")
+        d = x - y
+        loss = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+        self.op_type = "smooth_l1"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"sigma": 1.0}
+        self.outputs = {"Out": loss.sum(axis=1, keepdims=True), "Diff": d}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBceLossOp(OpTest):
+    def setup_method(self):
+        x = RNG.uniform(0.05, 0.95, (4, 3)).astype("float32")
+        label = RNG.integers(0, 2, (4, 3)).astype("float32")
+        loss = -(label * np.log(x) + (1 - label) * np.log(1 - x))
+        self.op_type = "bce_loss"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestBprLossOp(OpTest):
+    def setup_method(self):
+        x = RNG.normal(0, 1, (3, 4)).astype("float32")
+        label = np.array([[1], [0], [3]], np.int64)
+        B, C = x.shape
+        expect = np.zeros((B, 1), np.float32)
+        for b in range(B):
+            pos = x[b, label[b, 0]]
+            s = 0.0
+            for c in range(C):
+                if c != label[b, 0]:
+                    s += np.log(1.0 / (1.0 + np.exp(-(pos - x[b, c]))))
+            expect[b, 0] = -s / (C - 1)
+        self.op_type = "bpr_loss"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMeanIouOp(OpTest):
+    def setup_method(self):
+        pred = np.array([0, 1, 1, 2], np.int32)
+        label = np.array([0, 1, 2, 2], np.int32)
+        # class0: i1/u1=1; class1: i1/u2; class2: i1/u2 -> mean=(1+.5+.5)/3
+        self.op_type = "mean_iou"
+        self.inputs = {"Predictions": pred, "Labels": label}
+        self.attrs = {"num_classes": 3}
+        self.outputs = {"OutMeanIou": np.float32(2.0 / 3.0)}
+
+    def test_output(self):
+        # only check the mean (wrong/correct layouts are auxiliary)
+        import paddle_tpu.static as static
+
+        main, startup, fetches, _, _ = self._build()
+        exe = static.Executor()
+        exe.run(startup)
+        got = exe.run(main, feed=self._feed(), fetch_list=fetches[:1])
+        np.testing.assert_allclose(got[0], 2.0 / 3.0, rtol=1e-6)
+
+
+class TestGruUnitOp(OpTest):
+    def setup_method(self):
+        B, D = 2, 3
+        gates_x = RNG.normal(0, 1, (B, 3 * D)).astype("float32")
+        h_prev = RNG.normal(0, 1, (B, D)).astype("float32")
+        w = RNG.normal(0, 1, (D, 3 * D)).astype("float32")
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        uh = h_prev @ w[:, :2 * D]
+        r = sig(gates_x[:, :D] + uh[:, :D])
+        z = sig(gates_x[:, D:2 * D] + uh[:, D:])
+        c = np.tanh(gates_x[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:])
+        h = z * h_prev + (1 - z) * c
+        self.op_type = "gru_unit"
+        self.inputs = {"Input": gates_x, "HiddenPrev": h_prev, "Weight": w}
+        self.outputs = {"Hidden": h.astype("float32")}
+
+    def test_output(self):
+        import paddle_tpu.static as static
+
+        main, startup, fetches, _, _ = self._build()
+        exe = static.Executor()
+        exe.run(startup)
+        got = exe.run(main, feed=self._feed(), fetch_list=fetches[:1])
+        np.testing.assert_allclose(got[0], self.outputs["Hidden"],
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestLstmUnitOp(OpTest):
+    def setup_method(self):
+        B, D = 2, 3
+        gates = RNG.normal(0, 1, (B, 4 * D)).astype("float32")
+        c_prev = RNG.normal(0, 1, (B, D)).astype("float32")
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        i = sig(gates[:, :D])
+        f = sig(gates[:, D:2 * D])
+        g = np.tanh(gates[:, 2 * D:3 * D])
+        o = sig(gates[:, 3 * D:])
+        c = f * c_prev + i * g
+        self.op_type = "lstm_unit"
+        self.inputs = {"X": gates, "C_prev": c_prev}
+        self.attrs = {"forget_bias": 0.0}
+        self.outputs = {"C": c.astype("float32"),
+                        "H": (o * np.tanh(c)).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+# -- DSL round-trips for the headline new layers -----------------------------
+
+def test_warpctc_dsl_trains():
+    """A toy CTC model must build, run, and produce finite grads through
+    the static pipeline (the reference's test_warpctc_op + book usage)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    T_, B, C, Lm = 6, 2, 5, 2
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        logits = static.data("logits", (T_, B, C), append_batch_size=False)
+        logits.stop_gradient = False
+        label = static.data("label", (B, Lm), dtype="int32",
+                            append_batch_size=False)
+        llen = static.data("llen", (B,), dtype="int32",
+                           append_batch_size=False)
+        lablen = static.data("lablen", (B,), dtype="int32",
+                             append_batch_size=False)
+        loss_vec = L.warpctc(logits, label, input_length=llen,
+                             label_length=lablen)
+        loss = L.mean(loss_vec)
+        grads = static.gradients([loss], [logits])
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={
+        "logits": RNG.normal(0, 1, (T_, B, C)).astype("float32"),
+        "label": RNG.integers(1, C, (B, Lm)).astype("int32"),
+        "llen": np.full((B,), T_, np.int32),
+        "lablen": np.full((B,), Lm, np.int32),
+    }, fetch_list=[loss, grads[0]])
+    assert np.isfinite(out[0]) and np.isfinite(out[1]).all()
+    assert np.abs(out[1]).max() > 0
+
+
+def test_conv3d_pool3d_dsl_forward():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", (2, 3, 8, 8, 8), append_batch_size=False)
+        y = L.conv3d(x, 4, 3, padding=1, act="relu")
+        z = L.pool3d(y, 2, "max", 2)
+        w = L.conv3d_transpose(z, 2, 2, stride=2)
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={
+        "x": RNG.normal(0, 1, (2, 3, 8, 8, 8)).astype("float32")},
+        fetch_list=[w])
+    assert out.shape == (2, 2, 8, 8, 8)
+    assert np.isfinite(out).all()
+
+
+def test_edit_distance_and_decoder_dsl():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        hyp = static.data("hyp", (2, 4), dtype="int32",
+                          append_batch_size=False)
+        ref = static.data("ref", (2, 3), dtype="int32",
+                          append_batch_size=False)
+        dist, num = L.edit_distance(hyp, ref, normalized=False)
+        probs = static.data("probs", (2, 5, 4), append_batch_size=False)
+        decoded, dlen = L.ctc_greedy_decoder(probs, blank=0)
+    exe = static.Executor()
+    exe.run(startup)
+    probs_np = np.zeros((2, 5, 4), np.float32)
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        probs_np[0, t, c] = 1
+        probs_np[1, t, c] = 1
+    d, n, dec, dl = exe.run(main, feed={
+        "hyp": np.array([[1, 2, 3, 4], [1, 1, 1, 1]], np.int32),
+        "ref": np.array([[1, 2, 3], [2, 2, 2]], np.int32),
+        "probs": probs_np,
+    }, fetch_list=[dist, num, decoded, dlen])
+    assert d[0, 0] == 1.0 and d[1, 0] == 4.0  # lev: one insert; 3 sub+1 del
+    assert list(dec[0][:2]) == [1, 2] and dl[0] == 2
+
+
+class TestUnfoldAsymmetricPaddingOp(OpTest):
+    def setup_method(self):
+        import torch
+
+        x = RNG.normal(0, 1, (1, 2, 5, 5)).astype("float32")
+        # reference order (up, left, down, right) = (1, 2, 0, 3)
+        padded = torch.nn.functional.pad(torch.tensor(x), (2, 3, 1, 0))
+        expect = torch.nn.functional.unfold(padded, 3, stride=2).numpy()
+        self.op_type = "unfold"
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_sizes": [3, 3], "strides": [2, 2],
+                      "paddings": [1, 2, 0, 3], "dilations": [1, 1]}
+        self.outputs = {"Y": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-5)
+
+
+class TestMulticlassNMSOp(OpTest):
+    def setup_method(self):
+        from paddle_tpu.ops.vision import multiclass_nms
+
+        bboxes = np.abs(RNG.normal(0, 1, (2, 6, 4))).astype("float32")
+        bboxes[..., 2:] += bboxes[..., :2] + 0.5  # valid boxes
+        scores = RNG.uniform(0, 1, (2, 3, 6)).astype("float32")
+        dets, num = [], []
+        for b in range(2):
+            d, n = multiclass_nms(bboxes[b], scores[b],
+                                  score_threshold=0.1, nms_top_k=6,
+                                  keep_top_k=4, nms_threshold=0.4,
+                                  background_label=0)
+            dets.append(np.asarray(d))
+            num.append(int(n))
+        self.op_type = "multiclass_nms"
+        self.inputs = {"BBoxes": bboxes, "Scores": scores}
+        self.attrs = {"score_threshold": 0.1, "nms_top_k": 6,
+                      "keep_top_k": 4, "nms_threshold": 0.4,
+                      "background_label": 0}
+        self.outputs = {"Out": np.stack(dets),
+                        "NmsRoisNum": np.array(num, np.int32)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def test_ctc_loss_mean_divides_by_label_length():
+    import torch
+
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+
+    T_, B, C, L = 10, 3, 6, 4
+    logits = RNG.normal(0, 1, (T_, B, C)).astype("float32")
+    labels = RNG.integers(1, C, (B, L)).astype("int32")
+    llen = np.array([10, 8, 10], np.int32)
+    lablen = np.array([4, 2, 3], np.int32)
+    ours = float(F.ctc_loss(jnp.asarray(logits), labels, llen, lablen,
+                            reduction="mean"))
+    theirs = float(torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(llen.astype(np.int64)),
+        torch.tensor(lablen.astype(np.int64)), reduction="mean"))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+
+def test_psroi_pool_spatial_scale():
+    """scale != 1: bin extents must follow the reference's
+    round-then-scale order."""
+    from paddle_tpu.ops.vision import psroi_pool
+
+    x = np.zeros((1, 4, 8, 8), np.float32)
+    x[0, :, :4, :4] = 1.0  # top-left quadrant hot on every channel
+    # raw roi 0..13.6 -> rounds to 0..(14+1)=15, *0.5 -> 0..7.5 covers all
+    out = psroi_pool(x, np.array([[0., 0., 13.6, 13.6]], np.float32),
+                     np.array([0]), 1, 2, 2, spatial_scale=0.5)
+    out = np.asarray(out).reshape(2, 2)
+    # bins: y/x in [0, 3.75) then [3.75, 7.5): bin(0,0) mostly hot
+    assert out[0, 0] > 0.9
+    assert out[1, 1] < 0.1
