@@ -19,9 +19,21 @@ from .quant import (
     quant_int8,
 )
 
+from . import quant_static
+from .quant_static import (
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+)
+
 __all__ = [
     "ImperativeQuantAware", "PostTrainingQuantization", "QuantizedLinear",
     "QuantizedConv2D", "fake_quant_dequant_abs_max",
     "fake_channel_wise_quant_dequant_abs_max",
     "fake_quant_dequant_moving_average_abs_max", "quant_int8",
+    # static-graph passes (ref slim/quantization/quantization_pass.py);
+    # the STATIC PostTrainingQuantization (the reference's
+    # post_training_quantization.py contract) is quant_static.
+    # PostTrainingQuantization — the name here stays the imperative one
+    # for back-compat with round-3 users.
+    "QuantizationTransformPass", "QuantizationFreezePass", "quant_static",
 ]

@@ -1,0 +1,307 @@
+"""Static-graph quantization passes: QAT program rewrite + PTQ.
+
+Reference parity: ``fluid/contrib/slim/quantization/quantization_pass.py``
+(``QuantizationTransformPass`` inserting fake-quant/dequant around
+quantizable ops on the IrGraph, ``QuantizationFreezePass`` folding trained
+scales) and ``post_training_quantization.py`` (calibration over a saved
+model → fixed-scale rewrite).
+
+TPU-native design: passes rewrite the ``Program`` op list directly — there
+is no separate IrGraph, the Program IS the graph, and the whole-program
+jit recompiles on the next ``Executor.run`` (``Program._version`` bump).
+Fake-quant ops are the registered ``fake_quantize_*`` lowerings
+(static/ops_tail.py): pure elementwise rounding with straight-through
+gradients that XLA fuses into the neighboring matmul, so QAT costs almost
+nothing on the MXU.  Activation-scale state lives in persistable scope
+vars updated in place each step, exactly like optimizer slots.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..static.framework import Operator, Parameter, Program
+
+# op type -> (weight slot, activation slots) (ref
+# QuantizationTransformPass._quantizable_ops + op IO conventions)
+_QUANTIZABLE: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "conv2d": ("Filter", ("Input",)),
+    "depthwise_conv2d": ("Filter", ("Input",)),
+    "conv3d": ("Filter", ("Input",)),
+    "mul": ("Y", ("X",)),
+    "matmul": ("Y", ("X",)),
+    "matmul_v2": ("Y", ("X",)),
+}
+
+
+def _is_param(block, name: str) -> bool:
+    try:
+        return isinstance(block.var(name), Parameter)
+    except KeyError:
+        return False
+
+
+class QuantizationTransformPass:
+    """Insert trainable fake-quant-dequant ops (ref quantization_pass.py
+    ``QuantizationTransformPass.apply``): channel-wise abs-max on weights,
+    moving-average abs-max (persistable scale state) on activations."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 moving_rate: float = 0.9,
+                 quantizable_op_type: Sequence[str] = tuple(_QUANTIZABLE)):
+        del scope, place  # state lives in the program's scope vars
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self.op_types = set(quantizable_op_type)
+
+    def apply(self, program: Program,
+              startup_program: Optional[Program] = None) -> Program:
+        block = program.global_block()
+        quantized: Dict[str, str] = {}  # var name -> qdq output name
+        new_ops: List[Operator] = []
+        for op in block.ops:
+            if op.type in self.op_types and op.type in _QUANTIZABLE:
+                wslot, aslots = _QUANTIZABLE[op.type]
+                for slot in (wslot,) + tuple(aslots):
+                    for i, name in enumerate(op.inputs.get(slot, [])):
+                        qname = quantized.get(name)
+                        if qname is None:
+                            if _is_param(block, name):
+                                qname = self._insert_weight_quant(
+                                    block, new_ops, name)
+                            else:
+                                qname = self._insert_act_quant(
+                                    block, new_ops, name, program,
+                                    startup_program)
+                            quantized[name] = qname
+                        op.inputs[slot][i] = qname
+            new_ops.append(op)
+        block.ops = new_ops
+        program._version += 1
+        return program
+
+    def _insert_weight_quant(self, block, new_ops, name: str) -> str:
+        v = block.var(name)
+        out = block.create_var(name=f"{name}.quantized", shape=v.shape,
+                               dtype=v.dtype)
+        if self.weight_type == "channel_wise_abs_max":
+            n_scale = v.shape[0] if v.ndim else 1
+            scale = block.create_var(name=f"{name}.quant_scale",
+                                     shape=(n_scale,), dtype="float32")
+            new_ops.append(Operator(
+                block, "fake_channel_wise_quantize_dequantize_abs_max",
+                {"X": [name]}, {"Out": [out.name], "OutScale": [scale.name]},
+                {"bit_length": self.weight_bits, "quant_axis": 0}))
+        else:  # abs_max
+            scale = block.create_var(name=f"{name}.quant_scale", shape=(1,),
+                                     dtype="float32")
+            new_ops.append(Operator(
+                block, "fake_quantize_dequantize_abs_max",
+                {"X": [name]}, {"Out": [out.name], "OutScale": [scale.name]},
+                {"bit_length": self.weight_bits}))
+        return out.name
+
+    def _insert_act_quant(self, block, new_ops, name: str, program,
+                          startup_program) -> str:
+        v = block.var(name)
+        out = block.create_var(name=f"{name}.quantized", shape=v.shape,
+                               dtype=v.dtype)
+        state_name = f"{name}@quant_moving_scale"
+        state = block.create_var(name=state_name, shape=(1,),
+                                 dtype="float32", persistable=True)
+        if startup_program is not None:
+            sb = startup_program.global_block()
+            sb.create_var(name=state_name, shape=(1,), dtype="float32",
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [state_name]},
+                         attrs={"shape": (1,), "dtype": "float32",
+                                "value": 0.0})
+        # OutScale writes back to the state var: persistable in-place
+        # update across steps, the optimizer-slot pattern
+        new_ops.append(Operator(
+            block, "fake_quantize_dequantize_moving_average_abs_max",
+            {"X": [name], "InScale": [state_name]},
+            {"Out": [out.name], "OutScale": [state_name]},
+            {"bit_length": self.activation_bits,
+             "moving_rate": self.moving_rate}))
+        return out.name
+
+
+class QuantizationFreezePass:
+    """Fold trained quantization into the program (ref
+    quantization_pass.py ``QuantizationFreezePass``): weights become their
+    int8-simulated (quantize→dequantize) values with per-channel scales
+    recorded on the consumer op; activation moving-average quant ops become
+    fixed-scale quant-dequant using the calibrated scale."""
+
+    def __init__(self, scope, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8):
+        self.scope = scope
+        del place
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        qmax_w = float(2 ** (self.weight_bits - 1) - 1)
+        renames: Dict[str, str] = {}
+        scales: Dict[str, np.ndarray] = {}
+        kept: List[Operator] = []
+        for op in block.ops:
+            if op.type == "fake_channel_wise_quantize_dequantize_abs_max" \
+                    and _is_param(block, op.inputs["X"][0]):
+                wname = op.inputs["X"][0]
+                w = np.asarray(self.scope.find_var(wname))
+                red = tuple(range(1, w.ndim))
+                scale = np.maximum(np.abs(w).max(axis=red), 1e-8)
+                q = np.round(
+                    w / scale.reshape((-1,) + (1,) * (w.ndim - 1)) * qmax_w)
+                wq = q / qmax_w * scale.reshape(
+                    (-1,) + (1,) * (w.ndim - 1))
+                self.scope.set(wname, wq.astype(w.dtype))
+                renames[op.outputs["Out"][0]] = wname
+                scales[wname] = scale
+                continue  # drop the op: weight is already int8-simulated
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                state = np.asarray(
+                    self.scope.find_var(op.inputs["InScale"][0]))
+                op.type = "fake_quantize_dequantize_fixed_scale"
+                op.attrs = {"bit_length": self.activation_bits,
+                            "scale": float(state.reshape(-1)[0])}
+                op.inputs.pop("InScale", None)
+                op.outputs.pop("OutScale", None)
+            kept.append(op)
+        for op in kept:  # rewire consumers of dropped weight-qdq outputs
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [renames.get(n, n) for n in names]
+            wslot = _QUANTIZABLE.get(op.type, (None,))[0]
+            if wslot and op.inputs.get(wslot):
+                wname = op.inputs[wslot][0]
+                if wname in scales:
+                    op.attrs["weight_scale"] = scales[wname].tolist()
+                    op.attrs["weight_bits"] = self.weight_bits
+        block.ops = kept
+        program._version += 1
+        return program
+
+
+class PostTrainingQuantization:
+    """PTQ over a saved program package (ref
+    post_training_quantization.py): load ``static.save`` output, run
+    calibration batches collecting abs-max stats at every quantizable op's
+    activation inputs, then rewrite with fixed-scale quant-dequant and
+    int8-simulated weights.
+    """
+
+    def __init__(self, executor, model_prefix: Optional[str] = None,
+                 program: Optional[Program] = None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 batch_generator=None, batch_nums: Optional[int] = None,
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Sequence[str] = tuple(_QUANTIZABLE),
+                 scope=None):
+        from ..static import io as static_io
+        from ..static.executor import global_scope
+
+        self.exe = executor
+        self.scope = scope or global_scope()
+        if program is None:
+            if model_prefix is None:
+                raise ValueError("pass model_prefix or program")
+            program, feeds, _ = static_io.load(model_prefix, executor,
+                                               scope=self.scope)
+            if not feed_names:
+                feed_names = feeds
+        self.program = program
+        self.feed_names = list(feed_names or [])
+        self.batch_generator = batch_generator
+        self.batch_nums = batch_nums
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.op_types = set(quantizable_op_type)
+        self._act_scales: Dict[str, float] = {}
+
+    def _activation_vars(self) -> List[str]:
+        block = self.program.global_block()
+        names: List[str] = []
+        for op in block.ops:
+            if op.type in self.op_types and op.type in _QUANTIZABLE:
+                _, aslots = _QUANTIZABLE[op.type]
+                for slot in aslots:
+                    for n in op.inputs.get(slot, []):
+                        if not _is_param(block, n) and n not in names:
+                            names.append(n)
+        return names
+
+    def quantize(self) -> Program:
+        act_vars = self._activation_vars()
+        if self.batch_generator is not None and act_vars:
+            for bi, batch in enumerate(self.batch_generator()):
+                if self.batch_nums is not None and bi >= self.batch_nums:
+                    break
+                feed = (batch if isinstance(batch, dict)
+                        else dict(zip(self.feed_names, batch)))
+                outs = self.exe.run(self.program, feed=feed,
+                                    fetch_list=act_vars)
+                for name, arr in zip(act_vars, outs):
+                    cur = float(np.abs(np.asarray(arr)).max())
+                    self._act_scales[name] = max(
+                        self._act_scales.get(name, 0.0), cur)
+        block = self.program.global_block()
+        qmax_w = float(2 ** (self.weight_bits - 1) - 1)
+        new_ops: List[Operator] = []
+        quantized: Dict[str, str] = {}
+        done_weights = set()
+        for op in block.ops:
+            if op.type in self.op_types and op.type in _QUANTIZABLE:
+                wslot, aslots = _QUANTIZABLE[op.type]
+                # int8-simulate the weight in place (channel-wise)
+                for wname in op.inputs.get(wslot, []):
+                    if _is_param(block, wname) and wname not in done_weights:
+                        w = np.asarray(self.scope.find_var(wname))
+                        red = tuple(range(1, w.ndim))
+                        scale = np.maximum(np.abs(w).max(axis=red), 1e-8)
+                        rs = scale.reshape((-1,) + (1,) * (w.ndim - 1))
+                        self.scope.set(
+                            wname,
+                            (np.round(w / rs * qmax_w) / qmax_w * rs
+                             ).astype(w.dtype))
+                        op.attrs["weight_scale"] = scale.tolist()
+                        op.attrs["weight_bits"] = self.weight_bits
+                        done_weights.add(wname)
+                for slot in aslots:
+                    for i, name in enumerate(op.inputs.get(slot, [])):
+                        if _is_param(block, name):
+                            continue
+                        if name not in self._act_scales:
+                            continue  # never observed: leave float
+                        qname = quantized.get(name)
+                        if qname is None:
+                            v = block.var(name)
+                            out = block.create_var(
+                                name=f"{name}.quantized", shape=v.shape,
+                                dtype=v.dtype)
+                            new_ops.append(Operator(
+                                block, "fake_quantize_dequantize_fixed_scale",
+                                {"X": [name]}, {"Out": [out.name]},
+                                {"bit_length": self.activation_bits,
+                                 "scale": self._act_scales[name]}))
+                            qname = quantized[name] = out.name
+                        op.inputs[slot][i] = qname
+            new_ops.append(op)
+        block.ops = new_ops
+        self.program._version += 1
+        return self.program
+
+    def save_quantized_model(self, model_prefix: str) -> None:
+        from ..static import io as static_io
+
+        static_io.save(self.program, model_prefix, self.exe,
+                       scope=self.scope)
