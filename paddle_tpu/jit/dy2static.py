@@ -18,8 +18,20 @@ convert_* contract).  Supported subset (documented, checked):
     in BOTH branches (lax.cond needs matching output structures),
   * `while` whose carried names exist before the loop and keep
     shape/dtype (lax.while_loop shape-invariant carry),
-  * no `break`/`continue`/`return` inside converted bodies, no closures
-    over free variables being mutated.
+  * `break`/`continue` inside `while`/`for` bodies (ref
+    break_continue_transformer.py): rewritten into carried boolean flags
+    — the loop condition gains AND NOT(break_flag), and statements after
+    a potential break/continue are wrapped in guard `if`s, so a traced
+    break predicate lowers to lax control flow,
+  * `for i in range(...)` (ref loop_transformer.py for-range): lowered to
+    the `while` form — static bounds keep the plain Python loop (list
+    appends etc. still work), traced bounds or a traced break become
+    lax.while_loop,
+  * no `return`/`yield` inside converted bodies; no list append inside a
+    loop that actually lowers to lax.while_loop (a lax carry cannot grow
+    — use a preallocated buffer + indexed writes, the dense analogue of
+    the reference's LoDTensorArray); no closures over mutated free
+    variables.
 
 Functions using constructs outside the subset fall back to plain tracing
 (data-INdependent control flow still works there); a data-dependent
@@ -89,12 +101,50 @@ def convert_while(cond_fn: Callable, body_fn: Callable, carry: Tuple) -> Tuple:
             raise Unsupported(
                 "converted `while`: every carried variable must be bound "
                 "before the loop (lax.while_loop carry)")
+        # flags introduced by the break/continue rewrite start as python
+        # bools; canonicalize the carry so the while_loop typechecks
+        carry = tuple(jnp.asarray(c) if isinstance(c, (bool, int, float))
+                      else c for c in carry)
         return jax.lax.while_loop(
             lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
             lambda c: tuple(body_fn(*c)), tuple(carry))
-    while cond_fn(*carry):
+    while True:
+        if _is_traced(probe):
+            # the condition became traced mid-flight (e.g. a traced break
+            # flag joined it): continue as lax.while_loop from here
+            return convert_while(cond_fn, body_fn, carry)
+        if not probe:
+            return carry
         carry = tuple(body_fn(*carry))
-    return carry
+        probe = cond_fn(*carry)
+
+
+def _and_not(test, brk):
+    """cond AND NOT break_flag, python/tensor aware (break rewrite)."""
+    if _is_traced(test) or _is_traced(brk):
+        t = jnp.reshape(jnp.asarray(test), ()).astype(bool)
+        b = jnp.reshape(jnp.asarray(brk), ()).astype(bool)
+        return jnp.logical_and(t, jnp.logical_not(b))
+    return bool(test) and not bool(brk)
+
+
+def _not_skipping(brk, cnt):
+    """NOT (break_flag OR continue_flag) — the guard predicate wrapping
+    statements after a potential break/continue."""
+    if _is_traced(brk) or _is_traced(cnt):
+        b = jnp.reshape(jnp.asarray(brk), ()).astype(bool)
+        c = jnp.reshape(jnp.asarray(cnt), ()).astype(bool)
+        return jnp.logical_not(jnp.logical_or(b, c))
+    return not (bool(brk) or bool(cnt))
+
+
+def _range_cond(i, stop, step):
+    """for-range continuation predicate, sign-of-step aware."""
+    if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+        return jnp.where(jnp.asarray(step) > 0,
+                         jnp.asarray(i) < jnp.asarray(stop),
+                         jnp.asarray(i) > jnp.asarray(stop))
+    return i < stop if step > 0 else i > stop
 
 
 # ------------------------------------------------------------------ AST ----
@@ -123,16 +173,26 @@ def _assigned_names(nodes: Sequence[ast.stmt]) -> list:
 
 
 class _Checker(ast.NodeVisitor):
-    """Reject constructs the subset cannot express inside converted bodies."""
+    """Reject constructs the subset cannot express inside converted bodies:
+    return/yield ANYWHERE (a generated body_fn must return the carry
+    tuple — even inside a nested python-iterated `for` the return would
+    escape the carry), break/continue only OUTSIDE nested loops (a nested
+    loop owns its own, handled by its own conversion)."""
 
     def __init__(self):
         self.banned = None
+        self.saw_bc = False  # break/continue at the CURRENT loop level
+        self._loop_depth = 0
 
     def visit_Break(self, n):
-        self.banned = "break"
+        if self._loop_depth == 0:
+            self.banned = "break"
+            self.saw_bc = True
 
     def visit_Continue(self, n):
-        self.banned = "continue"
+        if self._loop_depth == 0:
+            self.banned = "continue"
+            self.saw_bc = True
 
     def visit_Return(self, n):
         self.banned = "return"
@@ -147,6 +207,61 @@ class _Checker(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
     visit_Lambda = visit_FunctionDef
+
+    def visit_While(self, n):
+        self._loop_depth += 1
+        self.generic_visit(n)
+        self._loop_depth -= 1
+
+    visit_For = visit_While
+
+
+def _contains_bc(node: ast.stmt) -> bool:
+    """Does this statement contain a break/continue belonging to the
+    CURRENT loop (not to a nested loop)?"""
+    c = _Checker()
+    c.visit(node)
+    return c.saw_bc
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+
+def _rewrite_break_continue(body, brk, cnt):
+    """ref break_continue_transformer.py: replace break/continue with flag
+    assignments and wrap the statements after a potential break/continue in
+    a guard `if not (brk or cnt)` — which the If conversion then lowers to
+    lax.cond when the flags are traced."""
+
+    def rewrite_stmt(s):
+        if isinstance(s, ast.Break):
+            return [ast.Assign(targets=[_name(brk, ast.Store)],
+                               value=ast.Constant(value=True))]
+        if isinstance(s, ast.Continue):
+            return [ast.Assign(targets=[_name(cnt, ast.Store)],
+                               value=ast.Constant(value=True))]
+        if isinstance(s, ast.If):
+            s = ast.If(test=s.test, body=rewrite_block(s.body),
+                       orelse=rewrite_block(s.orelse))
+        return [s]
+
+    def rewrite_block(stmts):
+        out = []
+        for i, s in enumerate(stmts):
+            had_bc = _contains_bc(s)
+            out.extend(rewrite_stmt(s))
+            if had_bc and i + 1 < len(stmts):
+                guard = ast.If(
+                    test=ast.Call(
+                        func=_name("__pdtpu_not_skipping"),
+                        args=[_name(brk), _name(cnt)], keywords=[]),
+                    body=rewrite_block(stmts[i + 1:]), orelse=[])
+                out.append(guard)
+                break
+        return out
+
+    return rewrite_block(body)
 
 
 def _check_body(nodes):
@@ -227,11 +342,32 @@ class _Transformer(ast.NodeTransformer):
         return [mk(tname, node.body), mk(fname, node.orelse), call] + cleanup
 
     # -- while ---------------------------------------------------------------
+    def _prepare_loop_flags(self, node):
+        """Rewrite break/continue in the RAW loop body into carried flags
+        (ref break_continue_transformer.py).  Returns prologue statements
+        binding the flags before the loop."""
+        if not any(_contains_bc(s) for s in node.body):
+            return []
+        brk, cnt = self._fresh("brk"), self._fresh("cnt")
+        node.body = (
+            [ast.Assign(targets=[_name(cnt, ast.Store)],
+                        value=ast.Constant(value=False))]
+            + _rewrite_break_continue(node.body, brk, cnt))
+        node.test = ast.Call(func=_name("__pdtpu_and_not"),
+                             args=[node.test, _name(brk)], keywords=[])
+        return [ast.Assign(targets=[_name(n, ast.Store)],
+                           value=ast.Constant(value=False))
+                for n in (brk, cnt)]
+
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
         if node.orelse:
             raise Unsupported("while/else is outside the dy2static subset")
+        prologue = self._prepare_loop_flags(node)
+        self.generic_visit(node)
         _check_body(node.body)
+        return prologue + self._convert_while_node(node)
+
+    def _convert_while_node(self, node: ast.While):
         carries = sorted(set(_assigned_names(node.body)))
         if not carries:
             raise Unsupported(
@@ -276,6 +412,62 @@ class _Transformer(ast.NodeTransformer):
                 keywords=[]))
         return [cond_fn, body_fn, call]
 
+    # -- for-range (ref loop_transformer.py for-range lowering) -------------
+    def visit_For(self, node: ast.For):
+        if node.orelse:
+            raise Unsupported("for/else is outside the dy2static subset")
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            # non-range iterables iterate in python (fine for concrete
+            # sequences under trace); just convert nested constructs
+            self.generic_visit(node)
+            return node
+        if not isinstance(node.target, ast.Name):
+            raise Unsupported(
+                "for-range target must be a plain name in the dy2static "
+                "subset")
+        i = node.target.id
+        a = it.args
+        if len(a) == 1:
+            start, stop, step = ast.Constant(value=0), a[0], \
+                ast.Constant(value=1)
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], ast.Constant(value=1)
+        elif len(a) == 3:
+            start, stop, step = a
+        else:
+            raise Unsupported("range() takes 1-3 arguments")
+        idx_n = self._fresh("idx")
+        stop_n, step_n = self._fresh("stop"), self._fresh("step")
+        setup = [
+            ast.Assign(targets=[_name(idx_n, ast.Store)], value=start),
+            ast.Assign(targets=[_name(stop_n, ast.Store)], value=stop),
+            ast.Assign(targets=[_name(step_n, ast.Store)], value=step),
+            # bind the loop var before the loop so it is a lax carry (its
+            # value after the loop — incl. python's "keeps the last/break
+            # value" semantics — comes from the body's `i = idx` assign)
+            ast.Assign(targets=[_name(i, ast.Store)], value=_name(idx_n)),
+        ]
+        test = ast.Call(func=_name("__pdtpu_range_cond"),
+                        args=[_name(idx_n), _name(stop_n), _name(step_n)],
+                        keywords=[])
+        # body: i = idx; <original body>; idx = idx + step — the hidden
+        # counter always advances (continue included) while `i` freezes at
+        # its last assigned iteration (python for semantics, break too)
+        body = [ast.Assign(targets=[_name(i, ast.Store)],
+                           value=_name(idx_n))] + list(node.body)
+        loop = ast.While(test=test, body=body, orelse=[])
+        prologue = self._prepare_loop_flags(loop)
+        loop.body.append(ast.Assign(
+            targets=[_name(idx_n, ast.Store)],
+            value=ast.BinOp(left=_name(idx_n), op=ast.Add(),
+                            right=_name(step_n))))
+        self.generic_visit(loop)
+        _check_body(loop.body)
+        converted = self._convert_while_node(loop)
+        return setup + prologue + converted
+
 
 def _maybe(frame_locals, name):
     return frame_locals.get(name, _UNDEF)
@@ -303,7 +495,8 @@ def ast_transform(fn: Callable) -> Callable:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise Unsupported("not a plain function definition")
-    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+               for n in ast.walk(fdef)):
         raise Unsupported("nothing to convert")
     fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
     new_tree = _Transformer().visit(tree)
@@ -314,6 +507,9 @@ def ast_transform(fn: Callable) -> Callable:
     glb["__pdtpu_convert_while"] = convert_while
     glb["__pdtpu_maybe"] = _maybe
     glb["__pdtpu_is_undef"] = _is_undef
+    glb["__pdtpu_and_not"] = _and_not
+    glb["__pdtpu_not_skipping"] = _not_skipping
+    glb["__pdtpu_range_cond"] = _range_cond
     loc: dict = {}
     exec(code, glb, loc)
     out = loc[fdef.name]

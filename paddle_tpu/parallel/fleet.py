@@ -18,9 +18,14 @@ composition inside one pjit'd train step:
   - gradient_merge → k-step gradient accumulation carried in opt state
   - localsgd       → k local steps then cross-dp param average
   - lars/lamb      → swap the inner optimizer rule
-  - dgc            → descoped: ICI makes dense allreduce cheaper than top-k
-                     sparsification + momentum correction (documented N/A)
-  - sharding       → ZeRO stage via parallel.sharding.zero_spec
+  - dgc            → top-k sparsify + momentum correction + error feedback
+                     per replica, pmean the sparse tensor over dp, apply as
+                     SGD (dgc_configs; ref dgc_op.cc +
+                     sparse_all_reduce_op_handle.cc).  Note: on ICI, dense
+                     allreduce is usually cheaper — DGC pays off over DCN.
+  - sharding       → ZeRO-1: optimizer state sharded over dp
+                     (HybridPretrainer constrains new opt state with
+                     parallel.sharding.zero_spec)
   - pipeline/tensor/sequence degrees → mesh axes (hybrid_configs)
 """
 from __future__ import annotations
@@ -85,6 +90,13 @@ class ShardingConfig:  # ZeRO; fleet "sharding" strategy
     stage: int = 1
 
 
+@dataclasses.dataclass
+class DGCConfig:  # proto :47 DGCConfig
+    rampup_begin_step: int = 0
+    sparsity: float = 0.999
+    momentum: float = 0.9
+
+
 class DistributedStrategy:
     """Typed strategy object (ref proto distributed_strategy.proto:94)."""
 
@@ -99,7 +111,8 @@ class DistributedStrategy:
         self.localsgd_configs = LocalSGDConfig()
         self.lars = False
         self.lamb = False
-        self.dgc = False  # accepted, documented no-op on TPU
+        self.dgc = False
+        self.dgc_configs = DGCConfig()
         self.sharding = False
         self.sharding_configs = ShardingConfig()
         self.pipeline = False
@@ -205,7 +218,7 @@ class DistributedOptimizer:
     optimizer.Optimizer, so train-step builders treat it identically."""
 
     def __init__(self, inner, strategy: DistributedStrategy):
-        from ..optimizer.optimizers import Lamb, LarsMomentum
+        from ..optimizer.optimizers import SGD, Lamb, LarsMomentum
         self.strategy = strategy
         # Pass the raw _lr through so an LRScheduler keeps scheduling (get_lr()
         # would freeze it at its current scalar value).
@@ -215,6 +228,17 @@ class DistributedOptimizer:
         elif strategy.lars and not isinstance(inner, LarsMomentum):
             inner = LarsMomentum(learning_rate=inner._lr,
                                  parameters=inner._parameters)
+        if strategy.dgc:
+            # DGC's momentum correction folds momentum into the compressed
+            # velocity (ref DGCMomentumOptimizer, fluid/optimizer.py:1176):
+            # the inner update must be plain SGD or momentum compounds.
+            # Pre-rampup momentum comes from the wrapper's velocity (the
+            # reference's momentum-SGD warmup), so nothing is lost here.
+            self._dgc_momentum = getattr(
+                inner, "momentum", strategy.dgc_configs.momentum)
+            if not isinstance(inner, SGD):
+                inner = SGD(learning_rate=inner._lr,
+                            parameters=inner._parameters)
         self.inner = inner
 
     # passthrough niceties
@@ -227,6 +251,11 @@ class DistributedOptimizer:
 
     def init(self, params) -> Dict[str, Any]:
         state = {"inner": self.inner.init(params)}
+        if self.strategy.dgc:
+            zeros = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tree)
+            state["dgc"] = {"velocity": zeros(params),
+                            "error": zeros(params)}
         gm = self.strategy.gradient_merge_configs
         if self.strategy.gradient_merge and gm.k_steps > 1:
             state["acc"] = jax.tree_util.tree_map(
@@ -260,6 +289,46 @@ class DistributedOptimizer:
             new_state["loss_scale"] = scale
             new_state["good_steps"] = jnp.where(
                 good >= ac.incr_every_n_steps, 0, good)
+
+        if cfg.dgc and "dgc" in state:
+            # ref dgc_op.cc + sparse_all_reduce_op_handle.cc: compress each
+            # replica's LOCAL gradient (momentum correction + error
+            # feedback + top-k), allreduce only the sparse tensor over the
+            # dp axis, and apply it as the update (inner is SGD; momentum
+            # already folded by the compression).
+            from ..optimizer.extras import dgc_compress
+
+            dc = cfg.dgc_configs
+            step = state["inner"].get("step", jnp.zeros((), jnp.int32)) \
+                if isinstance(state["inner"], dict) else jnp.zeros((), jnp.int32)
+            use_dgc = step >= dc.rampup_begin_step
+
+            mom = getattr(self, "_dgc_momentum", dc.momentum)
+
+            def one(g, v, e):
+                g32 = g.astype(jnp.float32)
+                s_, v_, e_ = dgc_compress(g32, v, e, dc.sparsity, mom)
+                # pre-rampup: plain momentum-SGD warmup using the same
+                # velocity slot (ref DGCMomentumOptimizer warmup dynamics)
+                v_warm = mom * v + g32
+                return (jnp.where(use_dgc, s_, v_warm),
+                        jnp.where(use_dgc, v_, v_warm),
+                        jnp.where(use_dgc, e_, e))
+
+            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            flat_v = treedef.flatten_up_to(state["dgc"]["velocity"])
+            flat_e = treedef.flatten_up_to(state["dgc"]["error"])
+            outs = [one(g, v, e) for g, v, e in zip(flat_g, flat_v, flat_e)]
+            sparse = [o[0] for o in outs]
+            if _coll.in_traced_context():
+                axis = _env.current_data_axis() or _mesh.DP_AXIS
+                sparse = [jax.lax.pmean(s, axis) for s in sparse]
+            grads = jax.tree_util.tree_unflatten(treedef, sparse)
+            new_state["dgc"] = {
+                "velocity": jax.tree_util.tree_unflatten(
+                    treedef, [o[1] for o in outs]),
+                "error": jax.tree_util.tree_unflatten(
+                    treedef, [o[2] for o in outs])}
 
         if cfg.gradient_merge and "acc" in state:
             k = cfg.gradient_merge_configs.k_steps

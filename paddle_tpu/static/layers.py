@@ -1726,12 +1726,10 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
     n = bboxes.shape[0]
     keep = keep_top_k if keep_top_k > 0 else -1
     out = _out(bboxes.dtype, (n, keep, 6))
-    idx = _out("int64", (n, keep))
     num = _out("int32", (n,))
     _append("multiclass_nms",
             {"BBoxes": [bboxes.name], "Scores": [scores.name]},
-            {"Out": [out.name], "Index": [idx.name],
-             "NmsRoisNum": [num.name]},
+            {"Out": [out.name], "NmsRoisNum": [num.name]},
             {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
              "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
              "normalized": normalized,

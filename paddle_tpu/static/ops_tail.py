@@ -827,11 +827,12 @@ def _register_delegates():
         lambda ins, attrs, op: {"Out": [T.scatter_nd_add(
             _one(ins, "X"), _one(ins, "Index"), _one(ins, "Updates"))]})
     register_op("shard_index")(
-        lambda ins, attrs, op: (lambda x, ns, ni: {"Out": [jnp.where(
-            x // (attrs["index_num"] // ns) == ni,
-            x % (attrs["index_num"] // ns),
-            attrs.get("ignore_value", -1))]})(
-            _one(ins, "X"), attrs["nshards"], attrs["shard_id"]))
+        lambda ins, attrs, op: (lambda x, sz, ni: {"Out": [jnp.where(
+            x // sz == ni, x % sz, attrs.get("ignore_value", -1))]})(
+            _one(ins, "X"),
+            # ref shard_index_op.h: shard_size = ceil(index_num / nshards)
+            -(-attrs["index_num"] // attrs["nshards"]),
+            attrs["shard_id"]))
     register_op("top_k_v2")(
         lambda ins, attrs, op: (lambda v, i: {"Out": [v], "Indices": [i]})(
             *T.topk(_one(ins, "X"), attrs.get("k", 1),
@@ -867,14 +868,20 @@ def _register_delegates():
             attrs.get("fp32_values") or attrs.get("int32_values"),
             _dtype_mod.convert_dtype(attrs.get("dtype", "float32"))
         ).reshape(tuple(attrs["shape"]))]})
+    def _partial_slice(xs, s, ln):
+        # ref partial_sum_op.cc / partial_concat_op.cc: length=-1 means
+        # "to the end of the row"
+        end = xs[0].shape[1] if ln in (-1, None) else s + ln
+        return [x[:, s:end] for x in xs]
+
     register_op("partial_sum")(
-        lambda ins, attrs, op: (lambda xs, s, ln: {"Out": [sum(
-            x[:, s:s + ln] for x in xs)]})(
-            ins["X"], attrs.get("start_index", 0), attrs["length"]))
+        lambda ins, attrs, op: {"Out": [sum(_partial_slice(
+            ins["X"], attrs.get("start_index", 0),
+            attrs.get("length", -1)))]})
     register_op("partial_concat")(
-        lambda ins, attrs, op: (lambda xs, s, ln: {"Out": [
-            jnp.concatenate([x[:, s:s + ln] for x in xs], axis=1)]})(
-            ins["X"], attrs.get("start_index", 0), attrs["length"]))
+        lambda ins, attrs, op: {"Out": [jnp.concatenate(_partial_slice(
+            ins["X"], attrs.get("start_index", 0),
+            attrs.get("length", -1)), axis=1)]})
     register_op("batch_fc")(
         lambda ins, attrs, op: {"Out": [jnp.einsum(
             "bsi,bio->bso", _one(ins, "Input"), _one(ins, "W"))
@@ -1250,3 +1257,15 @@ def _teacher_student_sigmoid_loss(ins, attrs, op):
     soft = jnp.maximum(z, 0.0) - z * label + jnp.log1p(jnp.exp(-jnp.abs(z)))
     loss = jnp.where((label > 0.0) & (label < 1.0), ce + soft, ce)
     return {"Y": [loss[:, None]]}
+
+
+@register_op("fake_quantize_dequantize_fixed_scale")
+def _fake_qdq_fixed_scale(ins, attrs, op):
+    """Frozen-scale quant-dequant (emitted by QuantizationFreezePass / PTQ;
+    the reference encodes the same thing as quantize+dequantize pairs with
+    scale attributes after its freeze pass)."""
+    x = _one(ins, "X")
+    qm = _qmax(attrs.get("bit_length", 8))
+    scale = attrs["scale"]
+    q = jnp.round(jnp.clip(x / scale, -1.0, 1.0) * qm) / qm * scale
+    return {"Out": [x + jax.lax.stop_gradient(q - x)]}

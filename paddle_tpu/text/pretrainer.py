@@ -99,6 +99,22 @@ class HybridPretrainer:
             if num_micro == 1:
                 num_micro = strategy.pipeline_configs.micro_batch
                 self.num_micro = num_micro
+        # fleet wiring: sequence_parallel asserts the mesh carries an sp
+        # axis (activations are then sp-sharded by _data_constraint); a
+        # silent True with no sp axis would be the no-op antipattern.
+        if strategy is not None and getattr(strategy, "sequence_parallel",
+                                            False):
+            if _mesh.SP_AXIS not in self.mesh.axis_names or \
+                    _mesh.mesh_axis_size(_mesh.SP_AXIS, self.mesh) <= 1:
+                raise ValueError(
+                    "DistributedStrategy.sequence_parallel=True but the "
+                    "mesh has no sp axis (>1); build the mesh with "
+                    "sp_degree > 1 (hybrid_configs)")
+        # fleet wiring: sharding (ZeRO-1) shards fp32 optimizer state over
+        # dp via with_sharding_constraint on the updated state
+        # (parallel/sharding.py zero_spec; ref proto sharding_configs).
+        self.zero_sharding = bool(strategy is not None
+                                  and getattr(strategy, "sharding", False))
         cfg = self.cfg
 
         self.embeddings = ErnieEmbeddings(cfg)
@@ -246,9 +262,28 @@ class HybridPretrainer:
 
             loss, grads = jax.value_and_grad(_loss)(params)
             new_params, new_state = optimizer.update(grads, opt_state, params)
+            new_state = self._zero_constrain(new_state)
             return new_params, new_state, loss
 
         return train_step
+
+    def _zero_constrain(self, opt_state):
+        """ZeRO-1 (fleet sharding strategy): constrain fp32 optimizer-state
+        leaves to be sharded over dp — GSPMD then stores each moment
+        1/dp-sized per device instead of replicated."""
+        if not self.zero_sharding or \
+                _mesh.mesh_axis_size(_mesh.DP_AXIS, self.mesh) <= 1:
+            return opt_state
+        from ..parallel.sharding import zero_spec
+
+        def constrain(s):
+            if not hasattr(s, "shape") or not s.shape:
+                return s
+            spec = zero_spec(s.shape, self.mesh, _mesh.DP_AXIS)
+            return lax.with_sharding_constraint(
+                s, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(constrain, opt_state)
 
     def _make_train_step_1f1b(self, optimizer, compute_dtype):
         """1F1B pipeline schedule (ref SectionWorker device_worker.h:415):
@@ -339,6 +374,7 @@ class HybridPretrainer:
                 lambda g, q: g.astype(q.dtype), grads, params,
                 is_leaf=lambda x: not isinstance(x, dict))
             new_params, new_state = optimizer.update(grads, opt_state, params)
+            new_state = self._zero_constrain(new_state)
             return new_params, new_state, loss
 
         return train_step

@@ -1043,3 +1043,21 @@ def test_psroi_pool_spatial_scale():
     # bins: y/x in [0, 3.75) then [3.75, 7.5): bin(0,0) mostly hot
     assert out[0, 0] > 0.9
     assert out[1, 1] < 0.1
+
+
+TestShardIndexCeilOp = _delegate_case(
+    "shard_index", {"X": np.array([[1], [5], [8]], np.int64)},
+    # index_num=9, nshards=2: shard_size = ceil(9/2) = 5 (reference
+    # shard_index_op.h), so 8 -> shard 1 local index 3
+    {"index_num": 9, "nshards": 2, "shard_id": 1, "ignore_value": -1},
+    {"Out": np.array([[-1], [0], [3]], np.int64)},
+    name="TestShardIndexCeilOp")
+TestPartialSumToEndOp = _delegate_case(
+    "partial_sum", {"X": [_x34, _x34.copy()]},
+    {"start_index": 1, "length": -1},  # ref default: to the end of the row
+    {"Out": 2 * _x34[:, 1:]}, name="TestPartialSumToEndOp")
+TestPartialConcatToEndOp = _delegate_case(
+    "partial_concat", {"X": [_x34, _x34.copy()]},
+    {"start_index": 1, "length": -1},
+    {"Out": np.concatenate([_x34[:, 1:], _x34[:, 1:]], axis=1)},
+    name="TestPartialConcatToEndOp")
