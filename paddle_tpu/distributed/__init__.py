@@ -7,9 +7,11 @@ from .env import ParallelEnv, get_rank, get_world_size
 from .ps import (
     AsyncCommunicator,
     GeoCommunicator,
+    HalfAsyncCommunicator,
     HeartBeatMonitor,
     LargeScaleEmbedding,
     SparseTable,
+    SyncCommunicator,
 )
 from .ps_server import PSServer, RemoteSparseTable
 

@@ -367,14 +367,114 @@ class GeoCommunicator:
             self._base[int(r)] = row.copy()
 
 
+class SyncCommunicator:
+    """Barrier-per-step synchronous PS (ref ``SyncCommunicator``
+    communicator.h:365 + the barrier counters of listen_and_serv_op.h:56):
+    every trainer pushes its gradients, then blocks on a step barrier —
+    pulls after the barrier see EVERY trainer's update, so the parameter
+    trajectory matches a single process applying the merged gradient (the
+    reference's TestDistBase correctness baseline).
+
+    ``barrier`` is any callable ``(name: str) -> None`` that blocks until
+    all ``num_workers`` arrive: a shared ``threading.Barrier`` wrapper for
+    in-process workers, or ``RemoteSparseTable.barrier`` across processes.
+    """
+
+    def __init__(self, table, worker_id: int, num_workers: int,
+                 lr: float = 0.1, barrier: Optional[Callable] = None):
+        self.table = table
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        self.lr = lr
+        if barrier is None:
+            remote = getattr(table, "barrier", None)
+            if remote is not None:
+                barrier = lambda name: remote(name, num_workers)  # noqa: E731
+            else:
+                raise ValueError(
+                    "sync PS needs a barrier: pass one, or use a table "
+                    "with a .barrier (RemoteSparseTable)")
+        self._barrier = barrier
+        self._step = 0
+
+    def pull(self, ids) -> np.ndarray:
+        """Pull, then rendezvous: no trainer may push step k+1 grads until
+        every trainer has read the step-k parameters (the reference's GET
+        barrier counter, listen_and_serv_op.h:56 — sync PS needs BOTH
+        barriers or a fast trainer's push races a slow trainer's read)."""
+        rows = self.table.pull(ids)
+        self._barrier(f"pull_{self._step}")
+        return rows
+
+    def push_and_sync(self, ids, grads) -> None:
+        """Push this trainer's gradient, then rendezvous (the SEND barrier
+        counter).  Per-trainer lr scaling is the caller's choice
+        (lr/num_workers reproduces the single-process merged-mean step for
+        linear rules like sgd)."""
+        self.table.push(ids, grads, self.lr)
+        self._step += 1
+        self._barrier(f"push_{self._step}")
+
+    def barrier(self) -> None:
+        self._step += 1
+        self._barrier(f"push_{self._step}")
+
+
+class HalfAsyncCommunicator(AsyncCommunicator):
+    """Bounded-staleness PS (ref ``HalfAsyncCommunicator``
+    communicator.h:326): pushes ride the async merge queue, but every
+    ``barrier_every`` steps the trainer drains its queue and rendezvous
+    with the other trainers — staleness is bounded by the window instead
+    of unbounded like pure async."""
+
+    def __init__(self, table, lr: float = 0.1, max_merge: int = 4,
+                 queue_size: int = 64, barrier_every: int = 4,
+                 worker_id: int = 0, num_workers: int = 1,
+                 barrier: Optional[Callable] = None):
+        super().__init__(table, lr=lr, max_merge=max_merge,
+                         queue_size=queue_size)
+        self.barrier_every = barrier_every
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        if barrier is None:
+            remote = getattr(table, "barrier", None)
+            if remote is not None:
+                barrier = lambda name: remote(name, num_workers)  # noqa: E731
+            elif num_workers == 1:
+                barrier = lambda name: None  # noqa: E731 — nothing to sync
+            else:
+                raise ValueError(
+                    "half-async PS with num_workers > 1 needs a barrier: "
+                    "pass one, or use a table with a .barrier "
+                    "(RemoteSparseTable) — a silent no-op would void the "
+                    "bounded-staleness contract")
+        self._barrier = barrier
+        self._step = 0
+        self._window = 0
+
+    def step_end(self) -> None:
+        """Call once per training step; at the window boundary the local
+        queue drains and all trainers rendezvous (BarrierTriggerDecrement
+        semantics of the reference's half-async path)."""
+        self._step += 1
+        if self._step % self.barrier_every == 0:
+            self.flush()
+            self._window += 1
+            self._barrier(f"window_{self._window}")
+
+
 class HeartBeatMonitor:
     """Tracks per-worker liveness (ref heart_beat_monitor.h: pserver thread
-    logging trainers whose last beat is stale)."""
+    logging trainers whose last beat is stale).  A worker beating again
+    after being reported dead is re-registered (``on_revive``) — the
+    rescue path a restarted worker takes."""
 
     def __init__(self, worker_num: int, timeout_s: float = 30.0,
-                 on_dead: Optional[Callable[[int], None]] = None):
+                 on_dead: Optional[Callable[[int], None]] = None,
+                 on_revive: Optional[Callable[[int], None]] = None):
         self.timeout_s = timeout_s
         self.on_dead = on_dead
+        self.on_revive = on_revive
         self._beats = {i: time.monotonic() for i in range(worker_num)}
         self._reported: set = set()
         self._lock = threading.Lock()
@@ -382,9 +482,16 @@ class HeartBeatMonitor:
         self._thread: Optional[threading.Thread] = None
 
     def beat(self, worker_id: int) -> None:
+        revived = False
         with self._lock:
+            if worker_id not in self._beats:
+                revived = True  # a brand-new/replacement worker id
+            elif worker_id in self._reported:
+                revived = True
             self._beats[worker_id] = time.monotonic()
             self._reported.discard(worker_id)
+        if revived and self.on_revive is not None:
+            self.on_revive(worker_id)
 
     def dead_workers(self) -> List[int]:
         now = time.monotonic()

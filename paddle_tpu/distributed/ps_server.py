@@ -39,6 +39,8 @@ _OP_NUM_ROWS = 4
 _OP_STATE = 5
 _OP_LOAD = 6
 _OP_SHUTDOWN = 7
+_OP_BARRIER = 8   # named rendezvous (ref listen_and_serv barrier counters)
+_OP_BEAT = 9      # trainer heartbeat (ref heart_beat_monitor.h)
 _OP_OK = 100
 _OP_ERR = 101
 
@@ -102,7 +104,8 @@ class PSServer:
     loop; one handler thread per connection ≈ its RPC thread pool)."""
 
     def __init__(self, table: SparseTable, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, barrier_timeout_s: float = 60.0,
+                 monitor=None):
         self.table = table
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -111,6 +114,19 @@ class PSServer:
         self.host, self.port = self._sock.getsockname()
         self._accept_thread: Optional[threading.Thread] = None
         self._running = False
+        self.barrier_timeout_s = barrier_timeout_s
+        self.monitor = monitor  # optional HeartBeatMonitor fed by _OP_BEAT
+        self._barriers: Dict[bytes, threading.Barrier] = {}
+        self._barrier_lock = threading.Lock()
+        self._open_conns: set = set()
+
+    def _get_barrier(self, name: bytes, n: int) -> threading.Barrier:
+        with self._barrier_lock:
+            b = self._barriers.get(name)
+            if b is None or b.parties != n:
+                b = threading.Barrier(n)
+                self._barriers[name] = b
+            return b
 
     @property
     def endpoint(self) -> str:
@@ -129,13 +145,22 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            # daemon handler threads exit with their connection; no registry
-            # (a long-lived pserver accepting per-epoch reconnects must not
-            # accumulate dead Thread objects)
+            # track live connection SOCKETS (not threads) so stop() can
+            # close them — otherwise established handler sockets keep the
+            # port busy and a same-port restart fails to bind
+            with self._barrier_lock:
+                self._open_conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
+        try:
+            self._serve_conn_inner(conn)
+        finally:
+            with self._barrier_lock:
+                self._open_conns.discard(conn)
+
+    def _serve_conn_inner(self, conn: socket.socket):
         with conn:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
@@ -166,6 +191,30 @@ class PSServer:
                         self.table.load_state_dict(
                             dict(zip(_STATE_KEYS, arrays)))
                         _send_msg(conn, _OP_OK, [])
+                    elif op == _OP_BARRIER:
+                        name = bytes(arrays[0]).decode()
+                        n = int(arrays[1][0])
+                        b = self._get_barrier(name.encode(), n)
+                        try:
+                            idx = b.wait(timeout=self.barrier_timeout_s)
+                            if idx == 0:
+                                # all parties released; step-named barriers
+                                # are never reused — drop the entry so a
+                                # long run doesn't leak one per step
+                                with self._barrier_lock:
+                                    self._barriers.pop(name.encode(), None)
+                        except threading.BrokenBarrierError:
+                            _send_msg(conn, _OP_ERR, [np.frombuffer(
+                                f"barrier {name!r} broken (a worker "
+                                "missed the rendezvous within "
+                                f"{self.barrier_timeout_s}s)".encode(),
+                                np.uint8)])
+                            continue
+                        _send_msg(conn, _OP_OK, [])
+                    elif op == _OP_BEAT:
+                        if self.monitor is not None:
+                            self.monitor.beat(int(arrays[0][0]))
+                        _send_msg(conn, _OP_OK, [])
                     elif op == _OP_SHUTDOWN:
                         _send_msg(conn, _OP_OK, [])
                         self.stop()
@@ -187,21 +236,66 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
+        with self._barrier_lock:
+            conns = list(self._open_conns)
+            self._open_conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class _Conn:
-    """One persistent client connection (lock-serialized request/response)."""
+    """One persistent client connection (lock-serialized request/response)
+    with reconnect-and-retry on transport failure (ref the brpc channel's
+    retry policy / communicator rescue paths): exponential backoff, then
+    the request is re-sent on a fresh socket.  Requests are at-least-once
+    — pull/num_rows/state are idempotent; a push/delta retried across a
+    failure that landed server-side can double-apply, the same
+    at-least-once contract the reference's resend path has."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, max_retries: int = 5,
+                 backoff_s: float = 0.2, timeout_s: float = 120.0):
         host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=60)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
         self.lock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+        self._connect()
 
-    def call(self, op: int, arrays: Sequence[np.ndarray]):
+    def _connect(self):
+        self.sock = socket.create_connection(self._addr,
+                                             timeout=self.timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def call(self, op: int, arrays: Sequence[np.ndarray],
+             retryable: bool = True):
+        import time as _time
+
         with self.lock:
-            _send_msg(self.sock, op, arrays)
-            rop, out = _recv_msg(self.sock)
+            delay = self.backoff_s
+            retries = self.max_retries if retryable else 0
+            for attempt in range(retries + 1):
+                try:
+                    if self.sock is None:
+                        self._connect()
+                    _send_msg(self.sock, op, arrays)
+                    rop, out = _recv_msg(self.sock)
+                    break
+                except (ConnectionError, OSError, socket.timeout):
+                    try:
+                        if self.sock is not None:
+                            self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = None
+                    if attempt == retries:
+                        raise
+                    _time.sleep(delay)
+                    delay = min(delay * 2, 5.0)
         if rop == _OP_ERR:
             raise RuntimeError(
                 "PS server error: " + bytes(out[0]).decode(errors="replace"))
@@ -209,9 +303,11 @@ class _Conn:
 
     def close(self):
         try:
-            self.sock.close()
+            if self.sock is not None:
+                self.sock.close()
         except OSError:
             pass
+        self.sock = None
 
 
 class RemoteSparseTable:
@@ -273,6 +369,23 @@ class RemoteSparseTable:
             m = srv == s
             self._conns[s].call(
                 _OP_LOAD, [np.asarray(state[k])[m] for k in _STATE_KEYS])
+
+    def barrier(self, name: str, num_workers: int) -> None:
+        """Named rendezvous on server 0 (ref listen_and_serv barrier
+        counters): blocks until ``num_workers`` clients arrive.
+
+        NOT retried on transport failure: a re-sent barrier request would
+        count the same worker twice and release the rendezvous early —
+        a dropped connection here must surface as an error instead."""
+        self._conns[0].call(_OP_BARRIER,
+                            [np.frombuffer(name.encode(), np.uint8),
+                             np.asarray([num_workers], np.int64)],
+                            retryable=False)
+
+    def beat(self, worker_id: int) -> None:
+        """Heartbeat to every server's monitor (ref HeartBeatMonitor)."""
+        for c in self._conns:
+            c.call(_OP_BEAT, [np.asarray([worker_id], np.int64)])
 
     def shutdown_servers(self) -> None:
         for c in self._conns:
