@@ -1,0 +1,175 @@
+"""Vision benchmarks: ResNet-50 (BASELINE config 2) and YOLOv3 (config 4,
+single-chip part) training throughput in images/sec/chip.
+
+Reference configs: PaddleClas ResNet-50 dygraph (224x224, momentum SGD) and
+PaddleDetection YOLOv3-DarkNet53 (416x416, yolo_loss over 3 heads).  No
+published in-tree reference numbers exist (BASELINE.md `"published": {}`);
+the first TPU measurement recorded here is the baseline.
+
+Usage: python bench_vision.py [resnet50|yolov3|all]
+Prints one JSON line per model (same schema as bench.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import autograd
+from paddle_tpu.autograd import parameters_dict
+from paddle_tpu.optimizer import Momentum
+import paddle_tpu.nn.functional as F
+
+# fwd FLOPs per image (2 x MACs, the convention behind the usual
+# "ResNet-50 = 4.1 GFLOPs @224", "YOLOv3 = 65.9 BFLOPs @416" numbers);
+# training ~= 3x forward (fwd + dW + dX)
+_FWD_FLOPS = {"resnet50": 4.09e9, "yolov3": 65.86e9}
+_PEAK = {"tpu": 197e12}  # v5e bf16 peak per chip
+
+# First recorded TPU measurements (r04, BENCH_VISION.json) are the
+# baselines; vs_baseline tracks progress against them (env-overridable,
+# the bench.py convention).
+_BASELINE_IPS = {
+    "resnet50": float(os.environ.get("BENCH_BASELINE_RESNET", "")
+                      or 2096.98),
+    "yolov3": float(os.environ.get("BENCH_BASELINE_YOLO", "") or 282.95),
+}
+
+
+def _cast_tree(p, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+
+def _bench_loop(step, params, opt_state, feed, warmup, iters, sync_every):
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, *feed)
+        float(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt_state, loss = step(params, opt_state, *feed)
+        if (i + 1) % sync_every == 0 or i == iters - 1:
+            float(loss)  # bounded dispatch depth over the axon tunnel
+    return time.perf_counter() - t0, float(loss)
+
+
+def bench_resnet50(on_tpu):
+    from paddle_tpu.vision import models as M
+
+    batch = int(os.environ.get("BENCH_RESNET_BATCH", "256" if on_tpu
+                               else "8"))
+    size = 224 if on_tpu else 64
+    warmup, iters = (3, int(os.environ.get("BENCH_ITERS", "30"))) \
+        if on_tpu else (1, 3)
+    model = M.resnet50(num_classes=1000)
+    model.train()
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    params = parameters_dict(model)
+    opt_state = opt.init(params)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def train_step(p, s, images, labels):
+        def loss_fn(p_):
+            logits = autograd.functional_call(
+                model, _cast_tree(p_, compute_dtype), (images,))
+            return jnp.mean(F.cross_entropy(logits.astype(jnp.float32),
+                                            labels))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.update(grads, s, p)
+        return p, s, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 3, size, size)),
+                         compute_dtype)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch, 1)), jnp.int32)
+    dt, loss = _bench_loop(step, params, opt_state, (images, labels),
+                           warmup, iters,
+                           int(os.environ.get("BENCH_SYNC_EVERY", "10")))
+    return dict(metric="resnet50_train_throughput", batch=batch,
+                imgs_per_sec=batch * iters / dt, iters=iters, loss=loss,
+                model="resnet50", size=size)
+
+
+def bench_yolov3(on_tpu):
+    from paddle_tpu.vision.models.yolov3 import yolov3_darknet53
+
+    batch = int(os.environ.get("BENCH_YOLO_BATCH", "32" if on_tpu else "2"))
+    size = 416 if on_tpu else 128
+    n_gt = 16
+    warmup, iters = (3, int(os.environ.get("BENCH_ITERS", "20"))) \
+        if on_tpu else (1, 2)
+    model = yolov3_darknet53(num_classes=80)
+    model.train()
+    opt = Momentum(learning_rate=1e-4, momentum=0.9)
+    params = parameters_dict(model)
+    opt_state = opt.init(params)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def train_step(p, s, images, gt_box, gt_label):
+        def loss_fn(p_):
+            heads = autograd.functional_call(
+                model, _cast_tree(p_, compute_dtype), (images,))
+            heads = [h.astype(jnp.float32) for h in heads]
+            return model.loss(heads, gt_box, gt_label)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.update(grads, s, p)
+        return p, s, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 3, size, size)),
+                         compute_dtype)
+    # normalized cx/cy/w/h gt boxes (the yolo_loss contract)
+    wh = rng.uniform(0.05, 0.4, (batch, n_gt, 2))
+    cxy = rng.uniform(0.2, 0.8, (batch, n_gt, 2))
+    gt_box = jnp.asarray(np.concatenate([cxy, wh], -1), jnp.float32)
+    gt_label = jnp.asarray(rng.integers(0, 80, (batch, n_gt)), jnp.int32)
+    dt, loss = _bench_loop(step, params, opt_state,
+                           (images, gt_box, gt_label), warmup, iters,
+                           int(os.environ.get("BENCH_SYNC_EVERY", "5")))
+    return dict(metric="yolov3_train_throughput", batch=batch,
+                imgs_per_sec=batch * iters / dt, iters=iters, loss=loss,
+                model="yolov3", size=size)
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    runs = {"resnet50": bench_resnet50, "yolov3": bench_yolov3}
+    if which != "all" and which not in runs:
+        sys.exit(f"usage: bench_vision.py [{'|'.join(runs)}|all] "
+                 f"(got {which!r})")
+    targets = list(runs) if which == "all" else [which]
+    for name in targets:
+        r = runs[name](on_tpu)
+        ips = r.pop("imgs_per_sec")
+        flops = 3 * _FWD_FLOPS[name] * (r["size"] / (224 if name ==
+                                        "resnet50" else 416)) ** 2
+        mfu = round(ips * flops / _PEAK[platform], 4) \
+            if platform in _PEAK else None
+        loss = r.pop("loss", None)
+        print(json.dumps({
+            "metric": r.pop("metric"),
+            "value": round(ips, 2),
+            "unit": "imgs/sec/chip",
+            "vs_baseline": round(ips / _BASELINE_IPS[name], 4),
+            "platform": platform,
+            "mfu_est": mfu,
+            **r,
+            "loss": round(loss, 4) if loss is not None and np.isfinite(loss)
+            else None,  # NaN would break the one-JSON-line contract
+        }))
+
+
+if __name__ == "__main__":
+    main()
