@@ -252,3 +252,29 @@ class TestAutogradBridge:
             params, state, loss = step(params, state, X, Y)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.1, losses[::20]
+
+
+def test_linear_transpose_dw_schedule_matches_default(monkeypatch):
+    """PDTPU_LINEAR_DW=transpose (the recorded dW-schedule experiment,
+    BASELINE.md r04) must be numerically identical to the default path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 6, 16)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+
+    def loss(x_, w_, b_):
+        return jnp.sum(F.linear(x_, w_, b_) ** 2)
+
+    monkeypatch.delenv("PDTPU_LINEAR_DW", raising=False)
+    ref = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+    monkeypatch.setenv("PDTPU_LINEAR_DW", "transpose")
+    alt = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+    for r, a in zip(ref, alt):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(a),
+                                   rtol=1e-5, atol=1e-5)
