@@ -1,6 +1,7 @@
 """paddle_tpu.text — NLP model zoo (ref: python/paddle/text/ + the
 PaddleNLP-era ERNIE family targeted by BASELINE.json)."""
-from .datasets import Imdb, Imikolov, UCIHousing
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,
+                       MovieReviews, UCIHousing, WMT14, WMT16)
 from .ernie import (
     BertConfig,
     BertForPretraining,

@@ -1,0 +1,130 @@
+"""Dataset zoo breadth (ref python/paddle/dataset/ + text/vision datasets:
+conll05, movielens, wmt14/16, sentiment, flowers, voc2012) — hermetic
+synthetic mode: shapes/dtypes/learnability contracts."""
+import numpy as np
+
+from paddle_tpu.text.datasets import (
+    Conll05st,
+    Movielens,
+    MovieReviews,
+    WMT14,
+    WMT16,
+)
+from paddle_tpu.vision.datasets import VOC2012, Flowers
+
+
+def test_conll05_nine_slot_contract():
+    ds = Conll05st(maxlen=32, synthetic_size=16)
+    item = ds[0]
+    assert len(item) == 9  # words, 5 ctx cols, pred, mark, labels
+    for arr in item:
+        assert arr.shape == (32,) and arr.dtype == np.int64
+    words, *ctx, pred, mark, labels = item
+    assert mark.sum() == 1  # exactly one predicate marker
+    assert labels.max() < Conll05st.N_LABELS
+    # train/test corpora differ
+    assert not np.array_equal(ds[0][0], Conll05st(maxlen=32, mode="test",
+                                                  synthetic_size=16)[0][0])
+
+
+def test_movielens_contract():
+    ds = Movielens(synthetic_size=64)
+    u, g, a, j, m, cats, title, rating = ds[0]
+    assert cats.shape == (3,) and title.shape == (ds.title_len,)
+    assert 1.0 <= float(rating) <= 5.0
+    assert int(a) < Movielens.N_AGES and int(j) < Movielens.N_JOBS
+    rs = {float(ds[i][-1]) for i in range(len(ds))}
+    assert len(rs) > 1  # ratings vary (learnable target)
+
+
+def test_wmt_pair_contract():
+    for cls in (WMT14, WMT16):
+        ds = cls(maxlen=16, synthetic_size=8)
+        src, trg_in, trg_next = ds[0]
+        assert src.shape == trg_in.shape == trg_next.shape == (16,)
+        assert trg_in[0] == cls.BOS
+        # teacher forcing: trg_next is trg_in shifted left
+        np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+
+
+def test_movie_reviews_matches_imdb_contract():
+    ds = MovieReviews(maxlen=64, synthetic_size=32)
+    doc, label = ds[0]
+    assert doc.shape == (64,) and label in (0, 1)
+
+
+def test_flowers_class_conditional_images():
+    ds = Flowers(size=32, synthetic_size=24)
+    img, label = ds[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert 0 <= int(label) < Flowers.NUM_CLASSES
+    # deterministic per index
+    np.testing.assert_array_equal(ds[3][0], ds[3][0])
+
+
+def test_voc2012_segmentation_contract():
+    ds = VOC2012(size=32, synthetic_size=8)
+    img, mask = ds[0]
+    assert img.shape == (3, 32, 32) and mask.shape == (32, 32)
+    assert mask.dtype == np.int64 and 0 <= mask.max() < VOC2012.NUM_CLASSES
+    assert (mask > 0).any()  # objects present
+
+
+def test_datasets_feed_dataloader():
+    from paddle_tpu.io import DataLoader
+
+    loader = DataLoader(Movielens(synthetic_size=32), batch_size=8)
+    batch = next(iter(loader))
+    assert batch[0].shape[0] == 8  # user ids batched
+    loader2 = DataLoader(Flowers(size=16, synthetic_size=16), batch_size=4)
+    imgs, labels = next(iter(loader2))
+    assert imgs.shape == (4, 3, 16, 16) and labels.shape == (4,)
+
+
+def test_conll05_file_mode_label_scheme_and_split(tmp_path):
+    """File mode: 'O' is the last label id (= pad fill), the final
+    sentence's predicate is found via B-V even without a trailing blank
+    line, and train/test are disjoint splits."""
+    lines = []
+    for i in range(10):
+        lines += [f"w{i}a B-A0", f"hit{i} B-V", f"w{i}b O", ""]
+    lines += ["last B-A0", "verb B-V", "tail O"]  # no trailing blank line
+    f = tmp_path / "conll.txt"
+    f.write_text("\n".join(lines))
+    tr = Conll05st(data_file=str(f), mode="train", maxlen=8)
+    te = Conll05st(data_file=str(f), mode="test", maxlen=8)
+    assert tr.label_dict["O"] == tr.n_labels - 1
+    assert len(tr) + len(te) == 11 and len(te) >= 1
+    # the no-blank-line final sentence marks its real predicate
+    all_sents = Conll05st._load_columns(str(f))
+    assert all_sents[-1]["pred"] == "verb" and all_sents[-1]["pred_pos"] == 1
+
+
+def test_movie_reviews_nltk_tar_layout(tmp_path):
+    import io
+    import tarfile
+
+    tar_p = tmp_path / "movie_reviews.tar"
+    with tarfile.open(tar_p, "w") as tf:
+        for i in range(10):
+            for pol in ("pos", "neg"):
+                data = (f"great movie {i}" if pol == "pos"
+                        else f"terrible film {i}").encode()
+                info = tarfile.TarInfo(f"movie_reviews/{pol}/cv{i}.txt")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    tr = MovieReviews(data_file=str(tar_p), mode="train", maxlen=16)
+    te = MovieReviews(data_file=str(tar_p), mode="test", maxlen=16)
+    assert len(tr) == 16 and len(te) == 4  # 80/20 of 20 members
+    assert set(np.asarray(tr.labels)) == {0, 1}
+
+
+def test_wmt_file_mode_train_test_disjoint(tmp_path):
+    f = tmp_path / "pairs.txt"
+    f.write_text("\n".join(f"{i} {i+1}\t{i+2} {i+3}" for i in range(10)))
+    tr = WMT14(data_file=str(f), mode="train", maxlen=8)
+    te = WMT14(data_file=str(f), mode="test", maxlen=8)
+    assert len(tr) == 8 and len(te) == 2
+    tr_srcs = {tuple(s[0].tolist()) for s in tr.samples}
+    te_srcs = {tuple(s[0].tolist()) for s in te.samples}
+    assert not (tr_srcs & te_srcs)
