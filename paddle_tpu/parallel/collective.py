@@ -141,6 +141,28 @@ def in_traced_context() -> bool:
         return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
 
 
+def bound_data_axis():
+    """The data-parallel mesh axis usable from the CURRENT trace, or None.
+
+    Inside shard_map (or any context that binds the axis name) this is the
+    scoped data axis (env._DataAxisScope) falling back to the mesh's dp
+    axis; under a plain jit / GSPMD trace or eager execution the name is
+    unbound and collectives must degrade to identities."""
+    from ..distributed import env as _env
+    from . import mesh as _mesh
+
+    if not in_traced_context():
+        return None
+    axis = _env.current_data_axis() or _mesh.DP_AXIS
+    try:
+        jax.lax.axis_index(axis)  # probe: is the name bound in this trace?
+    except Exception as e:  # noqa: BLE001 — jax version-dependent error type
+        if isinstance(e, NameError) or "unbound axis" in str(e):
+            return None
+        raise
+    return axis
+
+
 def _eager_axes(group: Group):
     """(mesh, group axes present in it, lax axis arg) — axes is None when the
     group is degenerate (absent axes / size 1) and the collective is a no-op."""

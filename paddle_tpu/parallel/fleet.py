@@ -320,8 +320,8 @@ class DistributedOptimizer:
             flat_e = treedef.flatten_up_to(state["dgc"]["error"])
             outs = [one(g, v, e) for g, v, e in zip(flat_g, flat_v, flat_e)]
             sparse = [o[0] for o in outs]
-            if _coll.in_traced_context():
-                axis = _env.current_data_axis() or _mesh.DP_AXIS
+            axis = _coll.bound_data_axis()
+            if axis is not None:
                 sparse = [jax.lax.pmean(s, axis) for s in sparse]
             grads = jax.tree_util.tree_unflatten(treedef, sparse)
             new_state["dgc"] = {

@@ -194,10 +194,10 @@ class Conll05st(Dataset):
                  maxlen: int = 64, synthetic_size: int = 256):
         self.maxlen = maxlen
         if data_file and os.path.exists(data_file):
-            sents = self._load_columns(data_file)
-            # deterministic 80/20 train/test split (UCIHousing policy)
-            sents = [s for i, s in enumerate(sents)
-                     if (i % 5 != 4) == (mode == "train")]
+            all_sents = self._load_columns(data_file)
+            # dictionaries come from the WHOLE corpus so train/test share
+            # id mappings and n_labels; only the samples split 80/20
+            sents = all_sents
             words = sorted({w for s in sents for w in s["words"]})
             self.word_dict = {w: i for i, w in enumerate(words)}
             preds = sorted({s["pred"] for s in sents})
@@ -212,7 +212,8 @@ class Conll05st(Dataset):
                 ([self.word_dict[w] for w in s["words"]],
                  self.predicate_dict[s["pred"]], s["pred_pos"],
                  [self.label_dict[l] for l in s["labels"]])
-                for s in sents]
+                for i, s in enumerate(all_sents)
+                if (i % 5 != 4) == (mode == "train")]
         else:
             rng = np.random.RandomState(4 if mode == "train" else 5)
             vocab, n_pred = 800, 60

@@ -127,6 +127,9 @@ class Flowers(Dataset):
                 for f in sorted(os.listdir(d)):
                     if f.endswith(".npy"):
                         self.items.append((os.path.join(d, f), int(label)))
+            # deterministic 80/20 train/test split (text datasets policy)
+            self.items = [x for i, x in enumerate(self.items)
+                          if (i % 5 != 4) == (mode == "train")]
             self._synth = None
         else:
             rng = np.random.RandomState(11 if mode == "train" else 12)
@@ -175,7 +178,8 @@ class VOC2012(Dataset):
             self.items = [(os.path.join(data_dir, f),
                            os.path.join(data_dir,
                                         f.replace(".img.npy", ".mask.npy")))
-                          for f in imgs]
+                          for i, f in enumerate(imgs)
+                          if (i % 5 != 4) == (mode == "train")]
             self._seed = None
         else:
             self._seed = 15 if mode == "train" else 16

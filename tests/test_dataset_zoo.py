@@ -128,3 +128,19 @@ def test_wmt_file_mode_train_test_disjoint(tmp_path):
     tr_srcs = {tuple(s[0].tolist()) for s in tr.samples}
     te_srcs = {tuple(s[0].tolist()) for s in te.samples}
     assert not (tr_srcs & te_srcs)
+
+
+def test_conll05_train_test_share_dictionaries(tmp_path):
+    """Train/test must share word/label id mappings and n_labels (dicts
+    built on the WHOLE corpus, only samples split)."""
+    lines = []
+    for i in range(10):
+        rare = "B-A4" if i == 4 else "B-A0"  # rare label in one sentence
+        lines += [f"w{i} {rare}", f"v{i} B-V", ""]
+    f = tmp_path / "conll.txt"
+    f.write_text("\n".join(lines))
+    tr = Conll05st(data_file=str(f), mode="train", maxlen=8)
+    te = Conll05st(data_file=str(f), mode="test", maxlen=8)
+    assert tr.label_dict == te.label_dict
+    assert tr.word_dict == te.word_dict
+    assert tr.n_labels == te.n_labels

@@ -1,0 +1,485 @@
+"""Batch-2 static op coverage: collectives, RNN monoliths, fusion ops,
+tensor-array/LoD control ops, PS data-plane ops, host-IO ops (see
+static/ops_tail2.py; per-op reference files cited there)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.static as static
+
+RNG = np.random.default_rng(21)
+
+
+def _run_single_op(op_type, inputs, attrs=None, out_slots=("Out",),
+                   n_out=None, list_in_slots=()):
+    """Build + run a one-op program through the real Executor."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        block = main.current_block()
+        in_names = {}
+        feed = {}
+        for slot, val in inputs.items():
+            vals = val if isinstance(val, list) else [val]
+            names = []
+            for i, arr in enumerate(vals):
+                name = f"{slot.lower()}_{i}"
+                block.create_var(name=name, shape=tuple(arr.shape),
+                                 dtype=str(arr.dtype), is_data=True)
+                names.append(name)
+                feed[name] = arr
+            in_names[slot] = names
+        out_names = {}
+        for slot in out_slots:
+            k = n_out.get(slot, 1) if n_out else 1
+            out_names[slot] = []
+            for i in range(k):
+                v = block.create_var(name=f"o_{slot.lower()}_{i}")
+                out_names[slot].append(v.name)
+        block.append_op(op_type, inputs=in_names, outputs=out_names,
+                       attrs=dict(attrs or {}))
+    exe = static.Executor()
+    exe.run(startup)
+    fetches = [n for slot in out_slots for n in out_names[slot]]
+    return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+# -- RNN monoliths -----------------------------------------------------------
+
+def _np_lstm(gates_x, wh, b, mask=None):
+    B, T, H4 = gates_x.shape
+    H = H4 // 4
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    hs, cs = [], []
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(T):
+        g = gates_x[:, t] + h @ wh + (b if b is not None else 0.0)
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c_new = sig(f) * c + sig(i) * np.tanh(gg)
+        h_new = sig(o) * np.tanh(c_new)
+        if mask is not None:
+            mt = mask[:, t][:, None]
+            h_new = h_new * mt + h * (1 - mt)
+            c_new = c_new * mt + c * (1 - mt)
+        h, c = h_new, c_new
+        hs.append(h)
+        cs.append(c)
+    return np.stack(hs, 1), np.stack(cs, 1)
+
+
+def test_lstm_op_matches_reference_recurrence():
+    B, T, H = 2, 5, 3
+    x = RNG.normal(0, 1, (B, T, 4 * H)).astype(np.float32)
+    w = RNG.normal(0, 0.5, (H, 4 * H)).astype(np.float32)
+    b = RNG.normal(0, 0.5, (4 * H,)).astype(np.float32)
+    mask = (np.arange(T)[None, :] < np.array([[5], [3]])).astype(np.float32)
+    hs, cs = _run_single_op("lstm", {"Input": x, "Weight": w, "Bias": b,
+                                     "Mask": mask},
+                            out_slots=("Hidden", "Cell"))
+    ref_h, ref_c = _np_lstm(x, w, b, mask)
+    np.testing.assert_allclose(hs, ref_h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cs, ref_c, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_op_matches_gru_unit_chain():
+    B, T, H = 2, 4, 3
+    x = RNG.normal(0, 1, (B, T, 3 * H)).astype(np.float32)
+    w = RNG.normal(0, 0.5, (H, 3 * H)).astype(np.float32)
+    (hs,) = _run_single_op("gru", {"Input": x, "Weight": w},
+                           out_slots=("Hidden",))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        uh = h @ w[:, :2 * H]
+        r = sig(x[:, t, :H] + uh[:, :H])
+        z = sig(x[:, t, H:2 * H] + uh[:, H:])
+        c = np.tanh(x[:, t, 2 * H:] + (r * h) @ w[:, 2 * H:])
+        h = z * h + (1 - z) * c
+        np.testing.assert_allclose(hs[:, t], h, rtol=1e-5, atol=1e-5)
+
+
+def test_lstmp_projects_recurrent_state():
+    B, T, H, P = 2, 4, 6, 3
+    x = RNG.normal(0, 1, (B, T, 4 * H)).astype(np.float32)
+    w = RNG.normal(0, 0.5, (P, 4 * H)).astype(np.float32)
+    proj = RNG.normal(0, 0.5, (H, P)).astype(np.float32)
+    pr, cell = _run_single_op(
+        "lstmp", {"Input": x, "Weight": w, "ProjWeight": proj},
+        out_slots=("Projection", "Cell"))
+    assert pr.shape == (B, T, P) and cell.shape == (B, T, H)
+    assert np.isfinite(pr).all()
+
+
+def test_cudnn_lstm_matches_lstm():
+    T, B, I, H = 5, 2, 4, 3
+    x = RNG.normal(0, 1, (T, B, I)).astype(np.float32)
+    wx = RNG.normal(0, 0.5, (I, 4 * H)).astype(np.float32)
+    wh = RNG.normal(0, 0.5, (H, 4 * H)).astype(np.float32)
+    b = RNG.normal(0, 0.5, (4 * H,)).astype(np.float32)
+    packed = np.concatenate([wx.reshape(-1), wh.reshape(-1), b])
+    out, last_h, last_c = _run_single_op(
+        "cudnn_lstm", {"Input": x, "W": packed},
+        attrs={"hidden_size": H}, out_slots=("Out", "LastH", "LastC"))
+    gates = np.einsum("tbi,ih->tbh", x, wx)
+    ref_h, _ = _np_lstm(np.swapaxes(gates, 0, 1), wh, b)
+    np.testing.assert_allclose(out, np.swapaxes(ref_h, 0, 1), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(last_h, ref_h[:, -1], rtol=1e-5, atol=1e-5)
+
+
+def test_fusion_lstm_and_embedding_fc_lstm():
+    B, T, M, H, V = 2, 4, 5, 3, 11
+    x = RNG.normal(0, 1, (B, T, M)).astype(np.float32)
+    wx = RNG.normal(0, 0.5, (M, 4 * H)).astype(np.float32)
+    wh = RNG.normal(0, 0.5, (H, 4 * H)).astype(np.float32)
+    b = RNG.normal(0, 0.5, (4 * H,)).astype(np.float32)
+    hs, _ = _run_single_op(
+        "fusion_lstm", {"X": x, "WeightX": wx, "WeightH": wh, "Bias": b},
+        out_slots=("Hidden", "Cell"))
+    ref_h, _ = _np_lstm(np.einsum("btm,mh->bth", x, wx), wh, b)
+    np.testing.assert_allclose(hs, ref_h, rtol=1e-5, atol=1e-5)
+
+    ids = RNG.integers(0, V, (B, T)).astype(np.int32)
+    emb = RNG.normal(0, 0.5, (V, 4 * H)).astype(np.float32)
+    hs2, _ = _run_single_op(
+        "fused_embedding_fc_lstm",
+        {"Ids": ids, "Embeddings": emb, "WeightH": wh, "Bias": b},
+        out_slots=("Hidden", "Cell"))
+    ref_h2, _ = _np_lstm(emb[ids], wh, b)
+    np.testing.assert_allclose(hs2, ref_h2, rtol=1e-5, atol=1e-5)
+
+
+# -- fusion ops --------------------------------------------------------------
+
+def test_fusion_repeated_fc_relu():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+    w1 = RNG.normal(0, 1, (4, 5)).astype(np.float32)
+    b1 = RNG.normal(0, 1, (5,)).astype(np.float32)
+    w2 = RNG.normal(0, 1, (5, 2)).astype(np.float32)
+    b2 = RNG.normal(0, 1, (2,)).astype(np.float32)
+    (out,) = _run_single_op("fusion_repeated_fc_relu",
+                            {"X": x, "W": [w1, w2], "Bias": [b1, b2]})
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+    y = RNG.normal(0, 1, (4, 5)).astype(np.float32)
+    (out,) = _run_single_op("fusion_squared_mat_sub", {"X": x, "Y": y},
+                            attrs={"scalar": 0.5})
+    ref = 0.5 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fusion_seqpool_concat_and_seqconv():
+    B, T, D = 2, 5, 3
+    x1 = RNG.normal(0, 1, (B, T, D)).astype(np.float32)
+    x2 = RNG.normal(0, 1, (B, T, D)).astype(np.float32)
+    lens = np.array([5, 3], np.int32)
+    (out,) = _run_single_op("fusion_seqpool_concat",
+                            {"X": [x1, x2], "Length": lens},
+                            attrs={"pooltype": "SUM"})
+    mask = (np.arange(T)[None, :, None] < lens[:, None, None])
+    ref = np.concatenate([(x1 * mask).sum(1), (x2 * mask).sum(1)], axis=-1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    w = RNG.normal(0, 1, (3 * D, 4)).astype(np.float32)
+    bias = RNG.normal(0, 1, (4,)).astype(np.float32)
+    (out2,) = _run_single_op(
+        "fusion_seqconv_eltadd_relu",
+        {"X": x1, "Length": lens, "Filter": w, "Bias": bias},
+        attrs={"contextLength": 3, "contextStart": -1})
+    assert out2.shape == (B, T, 4) and (out2 >= 0).all()
+
+
+def test_fsp_matrix():
+    x = RNG.normal(0, 1, (2, 3, 4, 4)).astype(np.float32)
+    y = RNG.normal(0, 1, (2, 5, 4, 4)).astype(np.float32)
+    (out,) = _run_single_op("fsp", {"X": x, "Y": y})
+    ref = np.einsum("bchw,bdhw->bcd", x, y) / 16.0
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# -- pooling tails -----------------------------------------------------------
+
+def test_max_pool3d_with_index():
+    import torch
+
+    x = RNG.normal(0, 1, (1, 2, 4, 4, 4)).astype(np.float32)
+    out, mask = _run_single_op("max_pool3d_with_index", {"X": x},
+                               attrs={"ksize": [2, 2, 2],
+                                      "strides": [2, 2, 2]},
+                               out_slots=("Out", "Mask"))
+    t_out, t_idx = torch.nn.functional.max_pool3d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(out, t_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask, t_idx.numpy())
+
+
+def test_unpool_roundtrip():
+    x = RNG.normal(0, 1, (1, 2, 4, 4)).astype(np.float32)
+    from paddle_tpu.ops.misc import max_pool2d_with_index
+
+    pooled, idx = max_pool2d_with_index(x, (2, 2), (2, 2))
+    (restored,) = _run_single_op(
+        "unpool", {"X": np.asarray(pooled), "Indices": np.asarray(idx)},
+        attrs={"output_size": [4, 4]})
+    # every pooled max lands back at its argmax position
+    flat = restored.reshape(1, 2, -1)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, np.asarray(idx).reshape(1, 2, -1), -1),
+        np.asarray(pooled).reshape(1, 2, -1), rtol=1e-6)
+    assert (restored != 0).sum() == pooled.size
+
+
+# -- tensor arrays + LoD control --------------------------------------------
+
+def test_tensor_array_write_read_stack():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        block = main.current_block()
+        x0 = block.create_var(name="x0", shape=(2, 3), dtype="float32",
+                              is_data=True)
+        x1 = block.create_var(name="x1", shape=(2, 3), dtype="float32",
+                              is_data=True)
+        # indices must be trace-time constants (fill_constant), not feeds:
+        # a fed index is a tracer and tensor arrays cannot be dynamic
+        for name, v in (("i0", 0), ("i1", 1)):
+            block.create_var(name=name)
+            block.append_op("fill_constant", outputs={"Out": [name]},
+                           attrs={"shape": (1,), "dtype": "int64",
+                                  "value": v})
+        block.create_var(name="arr0")
+        block.create_var(name="arr1")
+        block.create_var(name="stacked")
+        block.create_var(name="read_back")
+        block.append_op("write_to_array", {"X": ["x0"], "I": ["i0"]},
+                       {"Out": ["arr0"]})
+        block.append_op("write_to_array",
+                       {"X": ["x1"], "I": ["i1"], "Array": ["arr0"]},
+                       {"Out": ["arr1"]})
+        block.append_op("array_to_lod_tensor", {"X": ["arr1"]},
+                       {"Out": ["stacked"]})
+        block.append_op("read_from_array", {"X": ["arr1"], "I": ["i1"]},
+                       {"Out": ["read_back"]})
+    exe = static.Executor()
+    exe.run(startup)
+    a = RNG.normal(0, 1, (2, 3)).astype(np.float32)
+    b = RNG.normal(0, 1, (2, 3)).astype(np.float32)
+    stacked, read_back = exe.run(
+        main, feed={"x0": a, "x1": b},
+        fetch_list=["stacked", "read_back"])
+    np.testing.assert_allclose(stacked, np.stack([a, b]), rtol=1e-6)
+    np.testing.assert_allclose(read_back, b, rtol=1e-6)
+
+
+def test_merge_split_lod_tensor_mask_select():
+    x = RNG.normal(0, 1, (4, 3)).astype(np.float32)
+    mask = np.array([1, 0, 1, 0], np.int32)
+    t, f = _run_single_op("split_lod_tensor", {"X": x, "Mask": mask},
+                          out_slots=("OutTrue", "OutFalse"))
+    np.testing.assert_allclose(t[0], x[0], rtol=1e-6)
+    assert (t[1] == 0).all() and (f[1] == x[1]).all()
+    (merged,) = _run_single_op(
+        "merge_lod_tensor",
+        {"InTrue": t, "InFalse": f, "Mask": mask})
+    np.testing.assert_allclose(merged, x, rtol=1e-6)
+
+
+# -- collectives -------------------------------------------------------------
+
+def test_c_allreduce_and_allgather_under_shard_map():
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.static.registry import get_lowering
+
+    m = dist.init_parallel_env(dp=8)
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    xs = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x_local):
+        out = get_lowering("c_allreduce_sum")({"X": [x_local]}, {}, None)
+        gathered = get_lowering("c_allgather")({"X": [x_local]}, {}, None)
+        return out["Out"][0], gathered["Out"][0]
+
+    with m:
+        s, g = shard_map(f, mesh=m, in_specs=P("dp"),
+                         out_specs=(P("dp"), P("dp")))(xs)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.full((8, 1), 28.0), rtol=1e-6)
+    assert np.asarray(g).shape == (64, 1)  # each member holds the gather
+    mesh_mod.set_mesh(None)
+
+
+def test_comm_init_ops_are_identities():
+    (out,) = _run_single_op("c_gen_nccl_id",
+                            {"X": np.ones((2,), np.float32)})
+    np.testing.assert_allclose(out, np.ones(2), rtol=1e-6)
+
+
+def test_sync_batch_norm_single_device_degrades_to_bn():
+    x = RNG.normal(0, 1, (4, 3, 5, 5)).astype(np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    y, m2, v2 = _run_single_op(
+        "sync_batch_norm",
+        {"X": x, "Mean": mean, "Variance": var, "Scale": scale,
+         "Bias": bias},
+        out_slots=("Y", "MeanOut", "VarianceOut"))
+    mu = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m2, 0.9 * mean + 0.1 * mu, rtol=1e-4,
+                               atol=1e-4)
+    assert abs(float(y.mean())) < 1e-4  # normalized
+
+
+# -- PS data plane -----------------------------------------------------------
+
+def test_pull_push_sparse_through_executor():
+    from paddle_tpu.distributed.ps import SparseTable
+    from paddle_tpu.static import ops_tail2
+
+    table = SparseTable(dim=4, num_shards=2, optimizer="sgd", seed=9)
+    ops_tail2.register_ps_table("emb", table)
+    ids = np.array([[3, 5, 3]], np.int64)
+    (rows,) = _run_single_op(
+        "distributed_lookup_table", {"Ids": ids},
+        attrs={"table_name": "emb"})
+    np.testing.assert_allclose(rows.reshape(3, 4)[0],
+                               rows.reshape(3, 4)[2], rtol=1e-6)
+    before = table.pull(np.array([3]))
+    grads = np.ones((2, 4), np.float32)
+    _run_single_op("push_sparse",
+                   {"Ids": np.array([3, 5], np.int64), "Grads": grads},
+                   attrs={"table_name": "emb", "lr": 0.5})
+    after = table.pull(np.array([3]))
+    np.testing.assert_allclose(before - after, np.full((1, 4), 0.5),
+                               rtol=1e-5)
+
+
+def test_split_ids_and_selected_rows():
+    ids = np.array([0, 1, 2, 3, 4, 5], np.int64)
+    a, b = _run_single_op("split_ids", {"Ids": ids},
+                          n_out={"Out": 2}, out_slots=("Out",))
+    np.testing.assert_array_equal(a, [0, -1, 2, -1, 4, -1])
+    np.testing.assert_array_equal(b, [-1, 1, -1, 3, -1, 5])
+    x = RNG.normal(0, 1, (5, 2)).astype(np.float32)
+    r1, r2 = _run_single_op("split_selected_rows", {"X": x},
+                            attrs={"height_sections": [2, 3]},
+                            n_out={"Out": 2}, out_slots=("Out",))
+    np.testing.assert_allclose(r1, x[:2], rtol=1e-6)
+    np.testing.assert_allclose(r2, x[2:], rtol=1e-6)
+
+
+# -- host IO -----------------------------------------------------------------
+
+def test_save_load_ops_roundtrip(tmp_path):
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+    p = str(tmp_path / "var.npy")
+    _run_single_op("save", {"X": x}, attrs={"file_path": p}, out_slots=())
+    (back,) = _run_single_op("load", {}, attrs={"file_path": p})
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_print_op_passthrough(capfd):
+    x = np.asarray([1.5, 2.5], np.float32)
+    (out,) = _run_single_op("print", {"In": x},
+                            attrs={"message": "dbg: "})
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    assert "dbg:" in capfd.readouterr().out
+
+
+def test_py_func_op():
+    from paddle_tpu.static import ops_tail2
+
+    ops_tail2.register_py_func(7, lambda a: np.asarray(a) * 3.0)
+    x = RNG.normal(0, 1, (2, 2)).astype(np.float32)
+    (out,) = _run_single_op(
+        "py_func", {"X": x},
+        attrs={"forward_callable_id": 7, "out_shapes": [(2, 2)],
+               "out_dtypes": ["float32"]})
+    np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)
+
+
+def test_quantize_dequantize_requantize():
+    x = np.asarray([[0.5, -0.25, 1.0]], np.float32)
+    (q,) = _run_single_op("quantize", {"Input": x},
+                          attrs={"scale": 100.0}, out_slots=("Output",))
+    assert q.dtype == np.int8 and q[0, 2] == 100
+    (d,) = _run_single_op("dequantize", {"Input": q},
+                          attrs={"scale": 100.0}, out_slots=("Output",))
+    np.testing.assert_allclose(d, x, atol=0.01)
+    (r,) = _run_single_op("requantize", {"Input": q},
+                          attrs={"Scale_in": 100.0, "Scale_out": 50.0},
+                          out_slots=("Output",))
+    assert r[0, 2] == 50
+
+
+def test_cross_entropy2_and_sample_logits():
+    probs = np.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    label = np.asarray([[0], [1]], np.int64)
+    y, match, _ = _run_single_op("cross_entropy2",
+                                 {"X": probs, "Label": label},
+                                 out_slots=("Y", "MatchX", "XShape"))
+    np.testing.assert_allclose(y.reshape(-1),
+                               [-np.log(0.7), -np.log(0.8)], rtol=1e-5)
+    logits = RNG.normal(0, 1, (2, 10)).astype(np.float32)
+    out, samples, _ = _run_single_op(
+        "sample_logits", {"Logits": logits, "Labels": label},
+        attrs={"num_samples": 4},
+        out_slots=("SampledLogits", "Samples", "SampledLabels"))
+    assert out.shape == (2, 5) and samples.shape == (2, 5)
+    # column 0 is the true-label logit, uncorrected
+    np.testing.assert_allclose(out[:, 0],
+                               logits[[0, 1], label.reshape(-1)], rtol=1e-5)
+
+
+def test_split_ids_merge_ids_roundtrip():
+    """The split/merge pair must reassemble position-aligned rows (the
+    reference's shard routing; dense re-scope via -1 sentinels)."""
+    ids = np.array([0, 1, 2, 3, 4, 5], np.int64)
+    a, b = _run_single_op("split_ids", {"Ids": ids},
+                          n_out={"Out": 2}, out_slots=("Out",))
+    rows_a = np.where(a[:, None] >= 0,
+                      np.arange(6, dtype=np.float32)[:, None] * 10, 0)
+    rows_b = np.where(b[:, None] >= 0,
+                      np.arange(6, dtype=np.float32)[:, None] * 10, 0)
+    (merged,) = _run_single_op(
+        "merge_ids", {"Ids": [a, b], "X": [rows_a.astype(np.float32),
+                                           rows_b.astype(np.float32)]})
+    np.testing.assert_allclose(merged.reshape(-1),
+                               np.arange(6) * 10.0, rtol=1e-6)
+
+
+def test_save_load_extensionless_paths(tmp_path):
+    """Reference-style extensionless var paths must round-trip (np.save
+    appends .npy to str paths; the rule writes the exact path)."""
+    x = RNG.normal(0, 1, (2, 3)).astype(np.float32)
+    p = str(tmp_path / "fc_0.w_0")  # no extension, reference convention
+    _run_single_op("save", {"X": x}, attrs={"file_path": p}, out_slots=())
+    import os
+
+    assert os.path.exists(p) and not os.path.exists(p + ".npy")
+    (back,) = _run_single_op("load", {}, attrs={"file_path": p})
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+    y = RNG.normal(0, 1, (4,)).astype(np.float32)
+    pc = str(tmp_path / "combined_params")
+    _run_single_op("save_combine", {"X": [x, y]},
+                   attrs={"file_path": pc}, out_slots=())
+    assert os.path.exists(pc)
