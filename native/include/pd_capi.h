@@ -49,6 +49,14 @@ typedef struct PD_Predictor PD_Predictor;
 PD_Predictor* PD_PredictorCreate(const char* model_path,
                                  const char* python_exe);
 
+/* IN-PROCESS variant (the reference's AnalysisPredictor embedding,
+ * inference/capi/pd_predictor.cc): embeds CPython via dlopen'd libpython
+ * (override the library name with PD_LIBPYTHON) and executes the model in
+ * THIS process — no worker fork, no pipe.  When the library is loaded
+ * from a live Python process (e.g. via ctypes) the existing interpreter
+ * is reused.  Same wire semantics as PD_PredictorCreate. */
+PD_Predictor* PD_PredictorCreateInProcess(const char* model_path);
+
 /* Runs one feed->fetch round trip.  outputs/n_outputs are filled with
  * library-owned tensors (release with PD_TensorsFree).  Returns 0 on
  * success, nonzero on failure (PD_GetLastError describes it). */

@@ -3,6 +3,7 @@
 // (ref paddle/fluid/inference/capi/pd_predictor.cc).
 #include "pd_capi.h"
 
+#include <dlfcn.h>
 #include <signal.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -12,6 +13,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -61,12 +64,195 @@ bool ReadAll(int fd, void* buf, size_t len) {
   return true;
 }
 
+// -- embedded CPython (in-process transport) --------------------------------
+// libpython is dlopen'd on demand so the library keeps zero link-time
+// dependencies; only the stable C-API entry points below are used.
+struct PyApi {
+  int (*IsInitialized)();
+  void (*InitializeEx)(int);
+  int (*GILState_Ensure)();                       // PyGILState_STATE as int
+  void (*GILState_Release)(int);
+  void* (*Eval_SaveThread)();
+  void* (*Import_ImportModule)(const char*);
+  void* (*Unicode_FromString)(const char*);
+  void* (*Long_FromLong)(long);
+  long (*Long_AsLong)(void*);
+  void* (*Bytes_FromStringAndSize)(const char*, ssize_t);
+  int (*Bytes_AsStringAndSize)(void*, char**, ssize_t*);
+  void* (*Object_CallMethodObjArgs)(void*, void*, ...);
+  void (*Object_DecRef)(void*);  // Py_DecRef
+  void* (*Err_Occurred)();
+  void (*Err_Print)();
+  bool ok = false;
+};
+
+PyApi g_py;
+std::mutex g_py_mutex;
+
+void* PySym(void* lib, const char* name) {
+  void* s = dlsym(RTLD_DEFAULT, name);  // already-live interpreter first
+  if (!s && lib) s = dlsym(lib, name);
+  return s;
+}
+
+bool EnsurePython() {
+  std::lock_guard<std::mutex> lock(g_py_mutex);
+  if (g_py.ok) return true;
+  void* lib = nullptr;
+  if (!dlsym(RTLD_DEFAULT, "Py_IsInitialized")) {
+    const char* cand[] = {getenv("PD_LIBPYTHON"), "libpython3.12.so.1.0",
+                          "libpython3.12.so", "libpython3.11.so.1.0",
+                          "libpython3.11.so", "libpython3.10.so.1.0"};
+    for (const char* c : cand) {
+      if (!c) continue;  // PD_LIBPYTHON may be unset
+      lib = dlopen(c, RTLD_NOW | RTLD_GLOBAL);
+      if (lib) break;
+    }
+    if (!lib) {
+      SetError("libpython not found (set PD_LIBPYTHON)");
+      return false;
+    }
+  }
+#define PD_SYM(field, name)                                            \
+  g_py.field = reinterpret_cast<decltype(g_py.field)>(PySym(lib, name)); \
+  if (!g_py.field) { SetError("missing python symbol " name); return false; }
+  PD_SYM(IsInitialized, "Py_IsInitialized")
+  PD_SYM(InitializeEx, "Py_InitializeEx")
+  PD_SYM(GILState_Ensure, "PyGILState_Ensure")
+  PD_SYM(GILState_Release, "PyGILState_Release")
+  PD_SYM(Eval_SaveThread, "PyEval_SaveThread")
+  PD_SYM(Import_ImportModule, "PyImport_ImportModule")
+  PD_SYM(Unicode_FromString, "PyUnicode_FromString")
+  PD_SYM(Long_FromLong, "PyLong_FromLong")
+  PD_SYM(Long_AsLong, "PyLong_AsLong")
+  PD_SYM(Bytes_FromStringAndSize, "PyBytes_FromStringAndSize")
+  PD_SYM(Bytes_AsStringAndSize, "PyBytes_AsStringAndSize")
+  PD_SYM(Object_CallMethodObjArgs, "PyObject_CallMethodObjArgs")
+  PD_SYM(Object_DecRef, "Py_DecRef")
+  PD_SYM(Err_Occurred, "PyErr_Occurred")
+  PD_SYM(Err_Print, "PyErr_Print")
+#undef PD_SYM
+  if (!g_py.IsInitialized()) {
+    g_py.InitializeEx(0);
+    g_py.Eval_SaveThread();  // release the GIL: calls use GILState_Ensure
+  }
+  g_py.ok = true;
+  return true;
+}
+
+// Serialize a PDRQ request into a buffer (shared by both transports).
+std::string BuildRequest(const PD_Tensor* inputs, int n_inputs) {
+  std::string buf;
+  auto put = [&buf](const void* p, size_t n) {
+    buf.append(static_cast<const char*>(p), n);
+  };
+  put("PDRQ", 4);
+  int32_t n = n_inputs;
+  put(&n, 4);
+  for (int i = 0; i < n_inputs; ++i) {
+    const PD_Tensor& t = inputs[i];
+    int32_t name_len = static_cast<int32_t>(std::strlen(t.name));
+    put(&name_len, 4);
+    put(t.name, name_len);
+    int32_t dtype = t.dtype, ndim = t.ndim;
+    put(&dtype, 4);
+    put(&ndim, 4);
+    for (int d = 0; d < t.ndim; ++d) {
+      int64_t dim = t.shape[d];
+      put(&dim, 8);
+    }
+    put(t.data, Numel(t) * DtypeSize(t.dtype));
+  }
+  return buf;
+}
+
+// Parse a PDRS/PDER response through a read callback (fd or memory).
+using ReadFn = std::function<bool(void*, size_t)>;
+
+int ParseResponse(const ReadFn& rd, PD_Tensor** outputs, int* n_outputs) {
+  char magic[4];
+  if (!rd(magic, 4)) {
+    SetError("truncated response");
+    return -1;
+  }
+  if (std::memcmp(magic, "PDER", 4) == 0) {
+    int32_t len = 0;
+    if (!rd(&len, 4) || len < 0 || len > 65536) {
+      SetError("worker error (malformed error frame)");
+      return -2;
+    }
+    std::string msg(static_cast<size_t>(len), '\0');
+    if (!rd(msg.data(), msg.size())) msg = "(truncated error message)";
+    SetError("worker error: " + msg);
+    return -2;
+  }
+  if (std::memcmp(magic, "PDRS", 4) != 0) {
+    SetError("bad response magic");
+    return -1;
+  }
+  int32_t n_out = 0;
+  if (!rd(&n_out, 4)) {
+    SetError("truncated response");
+    return -1;
+  }
+  if (n_out < 0 || n_out > 4096) {
+    SetError("implausible output count (protocol desync?)");
+    return -1;
+  }
+  auto* outs = static_cast<PD_Tensor*>(std::calloc(n_out, sizeof(PD_Tensor)));
+  for (int i = 0; i < n_out; ++i) {
+    PD_Tensor& t = outs[i];
+    int32_t name_len = 0;
+    if (!rd(&name_len, 4) || name_len < 0 || name_len > 4096) {
+      SetError("bad tensor name length");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    std::string name(name_len, '\0');
+    if (!rd(name.data(), name_len)) {
+      SetError("truncated tensor name");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    std::snprintf(t.name, PD_MAX_NAME, "%s", name.c_str());
+    int32_t dtype = 0, ndim = 0;
+    if (!rd(&dtype, 4) || !rd(&ndim, 4) || DtypeSize(dtype) == 0 ||
+        ndim < 0 || ndim > PD_MAX_RANK) {
+      SetError("bad tensor header (dtype/ndim out of range for pd_capi)");
+      PD_TensorsFree(outs, i);
+      return -1;
+    }
+    t.dtype = dtype;
+    t.ndim = ndim;
+    for (int d = 0; d < ndim; ++d) {
+      int64_t dim = 0;
+      if (!rd(&dim, 8) || dim < 0) {
+        SetError("bad tensor dim");
+        PD_TensorsFree(outs, i);
+        return -1;
+      }
+      t.shape[d] = dim;
+    }
+    size_t bytes = static_cast<size_t>(Numel(t)) * DtypeSize(t.dtype);
+    t.data = std::malloc(bytes ? bytes : 1);
+    if (!rd(t.data, bytes)) {
+      SetError("truncated tensor payload");
+      PD_TensorsFree(outs, i + 1);
+      return -1;
+    }
+  }
+  *outputs = outs;
+  *n_outputs = n_out;
+  return 0;
+}
+
 }  // namespace
 
 struct PD_Predictor {
   pid_t pid = -1;
   int to_worker = -1;    // write end
   int from_worker = -1;  // read end
+  long inproc_handle = -1;  // >= 0: embedded-interpreter predictor
 };
 
 extern "C" {
@@ -125,104 +311,98 @@ PD_Predictor* PD_PredictorCreate(const char* model_path,
 
 int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
                     PD_Tensor** outputs, int* n_outputs) {
-  if (!pred || pred->pid < 0) {
+  if (!pred || (pred->pid < 0 && pred->inproc_handle < 0)) {
     SetError("invalid predictor");
     return -1;
   }
-  int fd = pred->to_worker;
-  if (!WriteAll(fd, "PDRQ", 4)) { SetError("write failed"); return -1; }
-  int32_t n = n_inputs;
-  WriteAll(fd, &n, 4);
-  for (int i = 0; i < n_inputs; ++i) {
-    const PD_Tensor& t = inputs[i];
-    int32_t name_len = static_cast<int32_t>(std::strlen(t.name));
-    WriteAll(fd, &name_len, 4);
-    WriteAll(fd, t.name, name_len);
-    int32_t dtype = t.dtype, ndim = t.ndim;
-    WriteAll(fd, &dtype, 4);
-    WriteAll(fd, &ndim, 4);
-    for (int d = 0; d < t.ndim; ++d) {
-      int64_t dim = t.shape[d];
-      WriteAll(fd, &dim, 8);
-    }
-    if (!WriteAll(fd, t.data, Numel(t) * DtypeSize(t.dtype))) {
-      SetError("tensor write failed");
+  std::string req = BuildRequest(inputs, n_inputs);
+
+  if (pred->inproc_handle >= 0) {
+    // embedded interpreter: one python call, parse the response bytes
+    if (!EnsurePython()) return -1;
+    int g = g_py.GILState_Ensure();
+    int rc = -1;
+    void* mod = g_py.Import_ImportModule("paddle_tpu.inference.capi_inproc");
+    if (!mod) {
+      if (g_py.Err_Occurred()) g_py.Err_Print();
+      SetError("cannot import paddle_tpu.inference.capi_inproc");
+      g_py.GILState_Release(g);
       return -1;
     }
+    void* name = g_py.Unicode_FromString("run");
+    void* h = g_py.Long_FromLong(pred->inproc_handle);
+    void* payload = g_py.Bytes_FromStringAndSize(
+        req.data(), static_cast<ssize_t>(req.size()));
+    void* res = g_py.Object_CallMethodObjArgs(mod, name, h, payload, nullptr);
+    char* out_p = nullptr;
+    ssize_t out_n = 0;
+    if (res && g_py.Bytes_AsStringAndSize(res, &out_p, &out_n) == 0) {
+      size_t off = 0;
+      ReadFn rd = [&](void* dst, size_t len) {
+        if (off + len > static_cast<size_t>(out_n)) return false;
+        std::memcpy(dst, out_p + off, len);
+        off += len;
+        return true;
+      };
+      rc = ParseResponse(rd, outputs, n_outputs);
+    } else {
+      if (g_py.Err_Occurred()) g_py.Err_Print();
+      SetError("in-process run call failed");
+    }
+    if (res) g_py.Object_DecRef(res);
+    g_py.Object_DecRef(payload);
+    g_py.Object_DecRef(h);
+    g_py.Object_DecRef(name);
+    g_py.Object_DecRef(mod);
+    g_py.GILState_Release(g);
+    return rc;
   }
-  char magic[4];
-  if (!ReadAll(pred->from_worker, magic, 4)) {
-    SetError("worker closed the pipe");
+
+  if (!WriteAll(pred->to_worker, req.data(), req.size())) {
+    SetError("write failed");
     return -1;
   }
-  if (std::memcmp(magic, "PDER", 4) == 0) {
-    int32_t len = 0;
-    ReadAll(pred->from_worker, &len, 4);
-    std::string msg(len, '\0');
-    ReadAll(pred->from_worker, msg.data(), len);
-    SetError("worker error: " + msg);
-    return -2;
+  int from = pred->from_worker;
+  ReadFn rd = [from](void* dst, size_t len) { return ReadAll(from, dst, len); };
+  return ParseResponse(rd, outputs, n_outputs);
+}
+
+PD_Predictor* PD_PredictorCreateInProcess(const char* model_path) {
+  if (model_path == nullptr) {
+    SetError("model_path is NULL");
+    return nullptr;
   }
-  if (std::memcmp(magic, "PDRS", 4) != 0) {
-    SetError("bad response magic");
-    return -1;
+  if (!EnsurePython()) return nullptr;
+  int g = g_py.GILState_Ensure();
+  void* mod = g_py.Import_ImportModule("paddle_tpu.inference.capi_inproc");
+  if (!mod) {
+    if (g_py.Err_Occurred()) g_py.Err_Print();
+    SetError("cannot import paddle_tpu.inference.capi_inproc "
+             "(is paddle_tpu on PYTHONPATH?)");
+    g_py.GILState_Release(g);
+    return nullptr;
   }
-  int32_t n_out = 0;
-  if (!ReadAll(pred->from_worker, &n_out, 4)) {
-    SetError("truncated response");
-    return -1;
+  void* name = g_py.Unicode_FromString("create");
+  void* path = g_py.Unicode_FromString(model_path);
+  void* res = g_py.Object_CallMethodObjArgs(mod, name, path, nullptr);
+  long handle = -1;
+  if (res) {
+    handle = g_py.Long_AsLong(res);
+    g_py.Object_DecRef(res);
+  } else if (g_py.Err_Occurred()) {
+    g_py.Err_Print();
   }
-  if (n_out < 0 || n_out > 4096) {
-    SetError("implausible output count (protocol desync?)");
-    return -1;
+  g_py.Object_DecRef(path);
+  g_py.Object_DecRef(name);
+  g_py.Object_DecRef(mod);
+  g_py.GILState_Release(g);
+  if (handle < 0) {
+    SetError("in-process predictor creation failed");
+    return nullptr;
   }
-  auto* outs = static_cast<PD_Tensor*>(std::calloc(n_out, sizeof(PD_Tensor)));
-  for (int i = 0; i < n_out; ++i) {
-    PD_Tensor& t = outs[i];
-    int32_t name_len = 0;
-    if (!ReadAll(pred->from_worker, &name_len, 4) || name_len < 0 ||
-        name_len > 4096) {
-      SetError("bad tensor name length");
-      PD_TensorsFree(outs, i);
-      return -1;
-    }
-    std::string name(name_len, '\0');
-    if (!ReadAll(pred->from_worker, name.data(), name_len)) {
-      SetError("truncated tensor name");
-      PD_TensorsFree(outs, i);
-      return -1;
-    }
-    std::snprintf(t.name, PD_MAX_NAME, "%s", name.c_str());
-    int32_t dtype = 0, ndim = 0;
-    if (!ReadAll(pred->from_worker, &dtype, 4) ||
-        !ReadAll(pred->from_worker, &ndim, 4) || DtypeSize(dtype) == 0 ||
-        ndim < 0 || ndim > PD_MAX_RANK) {
-      SetError("bad tensor header (dtype/ndim out of range for pd_capi)");
-      PD_TensorsFree(outs, i);
-      return -1;
-    }
-    t.dtype = dtype;
-    t.ndim = ndim;
-    for (int d = 0; d < ndim; ++d) {
-      int64_t dim = 0;
-      if (!ReadAll(pred->from_worker, &dim, 8) || dim < 0) {
-        SetError("bad tensor dim");
-        PD_TensorsFree(outs, i);
-        return -1;
-      }
-      t.shape[d] = dim;
-    }
-    size_t bytes = static_cast<size_t>(Numel(t)) * DtypeSize(t.dtype);
-    t.data = std::malloc(bytes ? bytes : 1);
-    if (!ReadAll(pred->from_worker, t.data, bytes)) {
-      SetError("truncated tensor payload");
-      PD_TensorsFree(outs, i + 1);
-      return -1;
-    }
-  }
-  *outputs = outs;
-  *n_outputs = n_out;
-  return 0;
+  auto* pred = new PD_Predictor;
+  pred->inproc_handle = handle;
+  return pred;
 }
 
 void PD_TensorsFree(PD_Tensor* tensors, int n) {
@@ -233,6 +413,20 @@ void PD_TensorsFree(PD_Tensor* tensors, int n) {
 
 void PD_PredictorDestroy(PD_Predictor* pred) {
   if (!pred) return;
+  if (pred->inproc_handle >= 0 && g_py.ok) {
+    int g = g_py.GILState_Ensure();
+    void* mod = g_py.Import_ImportModule("paddle_tpu.inference.capi_inproc");
+    if (mod) {
+      void* name = g_py.Unicode_FromString("destroy");
+      void* h = g_py.Long_FromLong(pred->inproc_handle);
+      void* res = g_py.Object_CallMethodObjArgs(mod, name, h, nullptr);
+      if (res) g_py.Object_DecRef(res);
+      g_py.Object_DecRef(h);
+      g_py.Object_DecRef(name);
+      g_py.Object_DecRef(mod);
+    }
+    g_py.GILState_Release(g);
+  }
   if (pred->to_worker >= 0) close(pred->to_worker);
   if (pred->from_worker >= 0) close(pred->from_worker);
   if (pred->pid > 0) {

@@ -65,6 +65,42 @@ def _write_tensor(f, name, arr):
     f.write(arr.tobytes())
 
 
+def handle_request(request_stream, exe, program, fetches, scope=None):
+    """Parse one PDRQ request from ``request_stream`` and return the
+    PDRS/PDER response bytes — the single protocol handler both
+    transports share (pipe worker below; in-process capi_inproc)."""
+    import io
+
+    import paddle_tpu.static as static
+
+    out = io.BytesIO()
+    try:
+        (n_in,) = struct.unpack("<i", _read_exact(request_stream, 4))
+        feed = {}
+        for _ in range(n_in):
+            name, arr = _read_tensor(request_stream)
+            feed[name] = arr
+        ctx = (static.scope_guard(scope) if scope is not None
+               else _nullcontext())
+        with ctx:
+            results = exe.run(program, feed=feed, fetch_list=list(fetches))
+        out.write(b"PDRS" + struct.pack("<i", len(results)))
+        for name, arr in zip(fetches, results):
+            _write_tensor(out, str(name), np.asarray(arr))
+    except Exception as e:  # noqa: BLE001 — report over the wire
+        msg = f"{type(e).__name__}: {e}".encode()
+        return b"PDER" + struct.pack("<i", len(msg)) + msg
+    return out.getvalue()
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
 def main():
     model_path = sys.argv[1]
     import jax
@@ -93,21 +129,8 @@ def main():
             break
         if magic != b"PDRQ":
             break
-        try:
-            (n_in,) = struct.unpack("<i", _read_exact(inp, 4))
-            feed = {}
-            for _ in range(n_in):
-                name, arr = _read_tensor(inp)
-                feed[name] = arr
-            results = exe.run(program, feed=feed, fetch_list=list(fetches))
-            out.write(b"PDRS" + struct.pack("<i", len(results)))
-            for name, arr in zip(fetches, results):
-                _write_tensor(out, str(name), np.asarray(arr))
-            out.flush()
-        except Exception as e:  # report and keep serving
-            msg = f"{type(e).__name__}: {e}".encode()
-            out.write(b"PDER" + struct.pack("<i", len(msg)) + msg)
-            out.flush()
+        out.write(handle_request(inp, exe, program, fetches))
+        out.flush()
 
 
 if __name__ == "__main__":
