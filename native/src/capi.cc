@@ -140,30 +140,29 @@ bool EnsurePython() {
   return true;
 }
 
-// Serialize a PDRQ request into a buffer (shared by both transports).
-std::string BuildRequest(const PD_Tensor* inputs, int n_inputs) {
-  std::string buf;
-  auto put = [&buf](const void* p, size_t n) {
-    buf.append(static_cast<const char*>(p), n);
-  };
-  put("PDRQ", 4);
+// Serialize a PDRQ request through a put callback: the pipe transport
+// streams straight to the fd (no payload copy), the in-process transport
+// collects into a buffer.
+using PutFn = std::function<bool(const void*, size_t)>;
+
+bool SerializeRequest(const PD_Tensor* inputs, int n_inputs,
+                      const PutFn& put) {
+  if (!put("PDRQ", 4)) return false;
   int32_t n = n_inputs;
-  put(&n, 4);
+  if (!put(&n, 4)) return false;
   for (int i = 0; i < n_inputs; ++i) {
     const PD_Tensor& t = inputs[i];
     int32_t name_len = static_cast<int32_t>(std::strlen(t.name));
-    put(&name_len, 4);
-    put(t.name, name_len);
+    if (!put(&name_len, 4) || !put(t.name, name_len)) return false;
     int32_t dtype = t.dtype, ndim = t.ndim;
-    put(&dtype, 4);
-    put(&ndim, 4);
+    if (!put(&dtype, 4) || !put(&ndim, 4)) return false;
     for (int d = 0; d < t.ndim; ++d) {
       int64_t dim = t.shape[d];
-      put(&dim, 8);
+      if (!put(&dim, 8)) return false;
     }
-    put(t.data, Numel(t) * DtypeSize(t.dtype));
+    if (!put(t.data, Numel(t) * DtypeSize(t.dtype))) return false;
   }
-  return buf;
+  return true;
 }
 
 // Parse a PDRS/PDER response through a read callback (fd or memory).
@@ -315,10 +314,14 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
     SetError("invalid predictor");
     return -1;
   }
-  std::string req = BuildRequest(inputs, n_inputs);
-
   if (pred->inproc_handle >= 0) {
     // embedded interpreter: one python call, parse the response bytes
+    std::string req;
+    SerializeRequest(inputs, n_inputs,
+                     [&req](const void* p, size_t len) {
+                       req.append(static_cast<const char*>(p), len);
+                       return true;
+                     });
     if (!EnsurePython()) return -1;
     int g = g_py.GILState_Ensure();
     int rc = -1;
@@ -358,7 +361,11 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
     return rc;
   }
 
-  if (!WriteAll(pred->to_worker, req.data(), req.size())) {
+  int to = pred->to_worker;
+  if (!SerializeRequest(inputs, n_inputs,
+                        [to](const void* p, size_t len) {
+                          return WriteAll(to, p, len);
+                        })) {
     SetError("write failed");
     return -1;
   }
@@ -425,6 +432,8 @@ void PD_PredictorDestroy(PD_Predictor* pred) {
       g_py.Object_DecRef(name);
       g_py.Object_DecRef(mod);
     }
+    // never leave a pending exception on the (possibly host-owned) thread
+    if (g_py.Err_Occurred()) g_py.Err_Print();
     g_py.GILState_Release(g);
   }
   if (pred->to_worker >= 0) close(pred->to_worker);

@@ -69,6 +69,7 @@ def handle_request(request_stream, exe, program, fetches, scope=None):
     """Parse one PDRQ request from ``request_stream`` and return the
     PDRS/PDER response bytes — the single protocol handler both
     transports share (pipe worker below; in-process capi_inproc)."""
+    import contextlib
     import io
 
     import paddle_tpu.static as static
@@ -81,7 +82,7 @@ def handle_request(request_stream, exe, program, fetches, scope=None):
             name, arr = _read_tensor(request_stream)
             feed[name] = arr
         ctx = (static.scope_guard(scope) if scope is not None
-               else _nullcontext())
+               else contextlib.nullcontext())
         with ctx:
             results = exe.run(program, feed=feed, fetch_list=list(fetches))
         out.write(b"PDRS" + struct.pack("<i", len(results)))
@@ -92,13 +93,6 @@ def handle_request(request_stream, exe, program, fetches, scope=None):
         return b"PDER" + struct.pack("<i", len(msg)) + msg
     return out.getvalue()
 
-
-class _nullcontext:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
 
 
 def main():

@@ -52,5 +52,6 @@ from .io import (
 )
 from . import nets
 from .registry import register_op, registered_ops
+from . import op_version
 
 data = layers.data

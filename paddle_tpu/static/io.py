@@ -74,8 +74,16 @@ def load_persistables(executor: Executor, dirname: str,
 
 
 def _program_to_json(program: Program) -> dict:
+    from . import op_version as _opv
+
     blk = program.global_block()
+    used = {op.type for op in blk.ops}
     return {
+        # ref op_version_registry.h: stamp versions of the op types this
+        # PROGRAM uses (stamping the whole registry would make packages
+        # reject on version bumps in ops they never touch)
+        "op_versions": {t: v for t, v in _opv.op_version_map().items()
+                        if t in used},
         "vars": [
             {"name": v.name, "shape": list(v.shape),
              "dtype": np.dtype(v.dtype).name, "persistable": v.persistable,
@@ -105,6 +113,13 @@ def _jsonable(attrs):
 
 
 def _program_from_json(d: dict) -> Program:
+    from ..core.errors import UnimplementedError
+    from . import op_version as _opv
+
+    saved_versions = d.get("op_versions", {})  # pre-registry packages: v0
+    problems = _opv.check_compatible(saved_versions)
+    if problems:
+        raise UnimplementedError("; ".join(problems))
     p = Program()
     b = p.global_block()
     for v in d["vars"]:
@@ -115,7 +130,10 @@ def _program_from_json(d: dict) -> Program:
             b.create_var(v["name"], v["shape"], v["dtype"],
                          persistable=v["persistable"], is_data=v["is_data"])
     for op in d["ops"]:
-        b.append_op(op["type"], op["inputs"], op["outputs"], op["attrs"])
+        ins, outs, attrs = _opv.apply_converters(
+            op["type"], int(saved_versions.get(op["type"], 0)),
+            op["inputs"], op["outputs"], op["attrs"])
+        b.append_op(op["type"], ins, outs, attrs)
     return p
 
 

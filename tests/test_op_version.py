@@ -1,0 +1,67 @@
+"""Op version registry + load-time migration (ref
+framework/op_version_registry.h + the op-version map saved programs
+carry)."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+from paddle_tpu.static import op_version
+
+
+def test_registry_and_version_map():
+    assert op_version.op_version("sequence_pad") >= 1
+    m = op_version.op_version_map()
+    assert m["sequence_pad"] == op_version.op_version("sequence_pad")
+    assert op_version.op_version("never_registered_op") == 0
+
+
+def test_save_stamps_versions_and_load_checks_forward_compat(tmp_path):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = L.data("x", [4])
+        y = L.fc(x, 2)
+    exe = static.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "pkg")
+    static.save(main, prefix, exe, fetches=[y])
+    with open(prefix + ".pdmodel") as f:
+        d = json.load(f)
+    assert "op_versions" in d["program"]
+    # simulate a FUTURE package: op saved at a version this runtime lacks
+    d["program"]["op_versions"]["mul"] = 99
+    with open(prefix + ".pdmodel", "w") as f:
+        json.dump(d, f)
+    from paddle_tpu.core.errors import UnimplementedError
+
+    with pytest.raises(UnimplementedError, match="version 99"):
+        static.load(prefix, exe)
+
+
+def test_converter_migrates_old_attr_at_load(tmp_path):
+    """A round-3-era package using sequence_pad's old 'max_len' attr loads
+    through the registered converter (renamed to 'maxlen')."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = L.data("x", [4])
+        y = L.fc(x, 2)
+    exe = static.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "old_pkg")
+    static.save(main, prefix, exe, fetches=[y])
+    with open(prefix + ".pdmodel") as f:
+        d = json.load(f)
+    # forge an old-version op desc: saved before checkpoint 1 existed
+    d["program"]["ops"].append(
+        {"type": "sequence_pad", "inputs": {}, "outputs": {},
+         "attrs": {"max_len": 7, "batch": 2, "pad_value": 0.0}})
+    d["program"]["op_versions"].pop("sequence_pad", None)  # v0 package
+    with open(prefix + ".pdmodel", "w") as f:
+        json.dump(d, f)
+    prog, _, _ = static.load(prefix, exe)
+    migrated = [op for op in prog.global_block().ops
+                if op.type == "sequence_pad"]
+    assert migrated and migrated[0].attrs["maxlen"] == 7
+    assert "max_len" not in migrated[0].attrs
