@@ -1,0 +1,340 @@
+"""Static-op long tail, batch 3: the hard contrib/detection stragglers.
+
+Reference parity targets: attention_lstm_op.cc (attention-pooled LSTM),
+prroi_pool_op.cc (PRECISE RoI pooling — exact integral of the bilinear
+surface, arXiv:1807.11590), tree_conv_op.cc + math/tree2col.h (TBCNN
+continuous-binary-tree convolution, arXiv:1409.5718), filter_by_instag_op.cc,
+pyramid_hash_op.cc (n-gram hash embedding), var_conv_2d_op.cc (variable-size
+conv over LoD images), bilateral_slice_op.cu (HDRnet grid slice+apply).
+
+TPU-native design: everything is dense/static-shaped.  PrRoI pooling uses
+the separable closed-form integral of the bilinear hat functions (no
+sampling approximation); tree_conv turns the reference's per-root DFS
+patches into max_depth adjacency-power matmuls (the eta weights depend
+only on (depth, child-index, sibling-count), so each depth level is one
+(N,N) @ (N, out) product); LoD-dependent ops take padded tensors + length
+vectors like every sequence op in this rebuild.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _one(ins, slot):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+@register_op("attention_lstm")
+def _attention_lstm(ins, attrs, op):
+    """ref attention_lstm_op.cc: per step, attention over the WHOLE input
+    sequence conditioned on the previous cell state pools x into one
+    lstm input; then a standard LSTM step.
+
+    Dense layout: X (B, T, M) + optional Mask (B, T); LSTMWeight
+    ((M+D), 4D); AttentionWeight ((M+D), 1)."""
+    x = _one(ins, "X")
+    mask = _one(ins, "Mask")
+    att_w = _one(ins, "AttentionWeight")      # (M+D, 1)
+    att_b = _one(ins, "AttentionBias")        # (1,)
+    att_scalar = _one(ins, "AttentionScalar")       # (1,)
+    att_scalar_b = _one(ins, "AttentionScalarBias")  # (1,)
+    lstm_w = _one(ins, "LSTMWeight")          # (M+D, 4D)
+    lstm_b = _one(ins, "LSTMBias")            # (4D,)
+    B, T, M = x.shape
+    D = lstm_w.shape[1] // 4
+    h0 = _one(ins, "H0")
+    c0 = _one(ins, "C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), x.dtype)
+    neg_inf = jnp.asarray(-1e30, x.dtype)
+    m = (mask if mask is not None else jnp.ones((B, T), x.dtype))
+
+    def step(carry, _):
+        h, c = carry
+        # attention: concat(x_s, c_prev) -> fc(+bias, relu) -> scalar fc
+        # (+bias, relu) -> softmax over s -> sum-pool x
+        cexp = jnp.broadcast_to(c[:, None, :], (B, T, D))
+        cat = jnp.concatenate([x, cexp], axis=-1)          # (B, T, M+D)
+        fc = jax.nn.relu(jnp.einsum("btk,ko->bto", cat, att_w)[..., 0]
+                         + (att_b[0] if att_b is not None else 0.0))
+        if att_scalar is not None:
+            fc = fc * att_scalar[0]
+            if att_scalar_b is not None:
+                fc = jax.nn.relu(fc + att_scalar_b[0])
+        fc = jnp.where(m > 0, fc, neg_inf)
+        attn = jax.nn.softmax(fc, axis=-1)                 # (B, T)
+        lstm_x = jnp.einsum("bt,btm->bm", attn, x)         # (B, M)
+        gates = jnp.concatenate([lstm_x, h], axis=-1) @ lstm_w + lstm_b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)]}
+
+
+def _hat_integral(a, b, i):
+    """∫_a^b max(0, 1-|x-i|) dx, closed form (PrRoI's bilinear weight)."""
+    # integrate the rising piece over [i-1, i] and the falling over [i, i+1]
+    lo1, hi1 = jnp.maximum(a, i - 1.0), jnp.minimum(b, i)
+    len1 = jnp.maximum(hi1 - lo1, 0.0)
+    # antiderivative of (x - (i-1)): 0.5*(x-(i-1))^2
+    rise = 0.5 * ((hi1 - (i - 1.0)) ** 2 - (lo1 - (i - 1.0)) ** 2)
+    rise = jnp.where(len1 > 0, rise, 0.0)
+    lo2, hi2 = jnp.maximum(a, i), jnp.minimum(b, i + 1.0)
+    len2 = jnp.maximum(hi2 - lo2, 0.0)
+    fall = 0.5 * (((i + 1.0) - lo2) ** 2 - ((i + 1.0) - hi2) ** 2)
+    fall = jnp.where(len2 > 0, fall, 0.0)
+    return rise + fall
+
+
+@register_op("prroi_pool")
+def _prroi_pool(ins, attrs, op):
+    """ref prroi_pool_op.h (PrRoI pooling, arXiv:1807.11590): the EXACT
+    integral of the bilinearly-interpolated feature surface over each
+    continuous bin, divided by bin area.  The 2-D integral separates into
+    per-axis hat-function integrals, so each bin is
+    sum_ij IntY(j)·IntX(i)·F[j,i] / area — closed form, no sampling."""
+    x = _one(ins, "X")                       # (N, C, H, W)
+    rois = _one(ins, "ROIs")                 # (R, 4) x1 y1 x2 y2
+    batch_ids = _one(ins, "BatchRoINums")
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    if batch_ids is None:
+        roi_batch = jnp.zeros((R,), jnp.int32)
+    else:
+        # reference contract: BatchRoINums is PER-IMAGE roi counts,
+        # shape (N,) — never per-ROI ids (shape-based guessing would
+        # misread counts when N == R)
+        reps = batch_ids.reshape(-1).astype(jnp.int32)
+        roi_batch = jnp.repeat(jnp.arange(N, dtype=jnp.int32), reps,
+                               total_repeat_length=R)
+    ii = jnp.arange(H, dtype=jnp.float32)
+    jj = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        bw = jnp.maximum((x2 - x1) / pw, 1e-9)
+        bh = jnp.maximum((y2 - y1) / ph, 1e-9)
+        gy = jnp.arange(ph, dtype=jnp.float32)
+        gx = jnp.arange(pw, dtype=jnp.float32)
+        ya, yb = y1 + gy * bh, y1 + (gy + 1) * bh          # (ph,)
+        xa, xb = x1 + gx * bw, x1 + (gx + 1) * bw          # (pw,)
+        wy = _hat_integral(ya[:, None], yb[:, None], ii[None, :])  # ph,H
+        wx = _hat_integral(xa[:, None], xb[:, None], jj[None, :])  # pw,W
+        feat = x[bi]                                        # (C, H, W)
+        pooled = jnp.einsum("ph,qw,chw->cpq", wy, wx, feat)
+        return pooled / (bw * bh)
+
+    return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32),
+                                      roi_batch)]}
+
+
+@register_op("tree_conv")
+def _tree_conv(ins, attrs, op):
+    """ref tree_conv_op.cc + math/tree2col.h (TBCNN): for each root, the
+    patch is its descendants within max_depth; each patch node contributes
+    eta_t/eta_l/eta_r-weighted projections (continuous binary tree).  The
+    eta weights depend only on (depth, child index, sibling count), so the
+    whole op is max_depth adjacency-power matmuls — no DFS at runtime.
+
+    Dense layout: NodesVector (B, N, F); EdgeSet (B, E, 2) parent->child
+    int pairs, -1-padded; Filter (F, 3, out, num_filters)."""
+    nodes = _one(ins, "NodesVector")
+    edges = _one(ins, "EdgeSet").astype(jnp.int32)
+    filt = _one(ins, "Filter")
+    max_depth = attrs.get("max_depth", 2)
+    B, N, Fdim = nodes.shape
+    out_size, n_filters = filt.shape[2], filt.shape[3]
+
+    def one_tree(x, es):
+        valid = (es[:, 0] >= 0) & (es[:, 1] >= 0)
+        parent = jnp.where(valid, es[:, 0], N)
+        child = jnp.where(valid, es[:, 1], N)
+        adj = jnp.zeros((N + 1, N + 1), jnp.float32).at[parent, child].set(
+            1.0)[:N, :N]
+        # index of edge among its parent's edges = rank of this edge within
+        # edges sharing the parent (edge order, like the reference's
+        # child-vector order)
+        same_parent = (parent[:, None] == parent[None, :]) & valid[None, :] \
+            & valid[:, None]
+        earlier = jnp.tril(jnp.ones_like(same_parent), k=-1)
+        rank = jnp.sum(same_parent & earlier.astype(bool), axis=1) + 1
+        pclen_edge = jnp.sum(same_parent, axis=1)
+        idx_node = jnp.zeros((N + 1,), jnp.float32).at[child].set(
+            rank.astype(jnp.float32))[:N]
+        pclen_node = jnp.ones((N + 1,), jnp.float32).at[child].set(
+            jnp.maximum(pclen_edge, 1).astype(jnp.float32))[:N]
+
+        fd = float(max_depth)
+        out = jnp.zeros((N, out_size, n_filters), jnp.float32)
+        reach = jnp.eye(N, dtype=jnp.float32)
+        for d in range(max_depth):
+            if d == 0:
+                idx_d = jnp.ones((N,), jnp.float32)
+                pclen_d = jnp.ones((N,), jnp.float32)
+            else:
+                idx_d, pclen_d = idx_node, pclen_node
+            eta_t = (fd - d) / fd
+            temp = jnp.where(pclen_d == 1, 0.5,
+                             (idx_d - 1.0) / jnp.maximum(pclen_d - 1.0, 1.0))
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            contrib = (
+                eta_t * jnp.einsum("nf,fok->nok", x, filt[:, 0])
+                + eta_l[:, None, None] * jnp.einsum("nf,fok->nok", x,
+                                                    filt[:, 1])
+                + eta_r[:, None, None] * jnp.einsum("nf,fok->nok", x,
+                                                    filt[:, 2]))
+            out = out + jnp.einsum("rv,vok->rok", reach, contrib)
+            reach = reach @ adj
+        return out
+
+    return {"Out": [jax.vmap(one_tree)(nodes.astype(jnp.float32), edges)]}
+
+
+@register_op("filter_by_instag")
+def _filter_by_instag(ins, attrs, op):
+    """ref filter_by_instag_op.cc: keep rows whose tag list intersects the
+    filter tags.  Dense re-scope: static shapes, so non-matching rows are
+    ZEROED (not removed); LossWeight carries the 0/1 keep mask the
+    reference uses to neutralize filtered rows in the loss; IndexMap is
+    the identity of kept positions."""
+    x = _one(ins, "Ins")          # (B, D)
+    tags = _one(ins, "Ins_tag")   # (B, Lt) padded with -1
+    ftags = _one(ins, "Filter_tag").reshape(-1)
+    keep = jnp.any(
+        (tags[:, :, None] == ftags[None, None, :]) & (tags[:, :, None] >= 0),
+        axis=(1, 2))
+    w = keep.astype(x.dtype)
+    out = x * w[:, None]
+    idx = jnp.arange(x.shape[0], dtype=jnp.int32)
+    return {"Out": [out], "LossWeight": [w[:, None]],
+            "IndexMap": [jnp.stack([idx, idx], axis=1)]}
+
+
+def _fnv_mix(h, v):
+    return (h ^ v) * jnp.uint32(16777619)
+
+
+@register_op("pyramid_hash")
+def _pyramid_hash(ins, attrs, op):
+    """ref pyramid_hash_op.cc: sum of hashed n-gram embeddings for window
+    sizes 2..pyramid_layer (the PYRAMID of a query's token ids).  Dense:
+    X (B, L) int ids padded with -1; W (space_len, num_emb); out = sum of
+    W[hash(ngram) % space_len] over all valid n-grams (FNV-style mix in
+    place of the reference's xxhash — deterministic, vectorized)."""
+    x = _one(ins, "X").astype(jnp.int32)     # (B, L)
+    w = _one(ins, "W")                        # (space_len, emb)
+    space_len = attrs.get("space_len", w.shape[0])
+    layers = attrs.get("pyramid_layer", 2)
+    B, L = x.shape
+    valid = x >= 0
+    out = jnp.zeros((B, w.shape[1]), w.dtype)
+    for win in range(2, layers + 1):
+        if win > L:
+            break
+        h = jnp.full((B, L - win + 1), 2166136261, jnp.uint32)
+        ok = jnp.ones((B, L - win + 1), bool)
+        for o in range(win):
+            seg = x[:, o:L - win + 1 + o]
+            h = _fnv_mix(h, seg.astype(jnp.uint32))
+            ok = ok & valid[:, o:L - win + 1 + o]
+        idx = (h % jnp.uint32(space_len)).astype(jnp.int32)
+        rows = jnp.take(w, idx, axis=0)                 # (B, P, emb)
+        out = out + jnp.sum(rows * ok[..., None], axis=1)
+    return {"Out": [out]}
+
+
+@register_op("var_conv_2d")
+def _var_conv_2d(ins, attrs, op):
+    """ref var_conv_2d_op.cc: conv over per-sample variable-size images.
+    Dense re-scope: X (B, C, Hmax, Wmax) + ROW/COLUMN (B,) valid sizes;
+    out-of-extent positions are zeroed before AND after the conv (the
+    reference computes only within each sample's extent)."""
+    from ..nn import functional as F
+
+    x = _one(ins, "X")
+    rows = _one(ins, "ROW").reshape(-1)
+    cols = _one(ins, "COLUMN").reshape(-1)
+    w = _one(ins, "W")     # (out_c, in_c, kh, kw)
+    sh, sw = attrs.get("StrideH", 1), attrs.get("StrideW", 1)
+    B, C, H, Wd = x.shape
+    hh = jnp.arange(H)[None, :, None]
+    ww = jnp.arange(Wd)[None, None, :]
+    in_mask = ((hh < rows[:, None, None]) & (ww < cols[:, None, None]))
+    xm = x * in_mask[:, None].astype(x.dtype)
+    out = F.conv2d(xm, w, stride=(sh, sw),
+                   padding=(w.shape[2] // 2, w.shape[3] // 2))
+    Ho, Wo = out.shape[2], out.shape[3]
+    out_rows = (rows + sh - 1) // sh
+    out_cols = (cols + sw - 1) // sw
+    oh = jnp.arange(Ho)[None, :, None]
+    ow = jnp.arange(Wo)[None, None, :]
+    out_mask = ((oh < out_rows[:, None, None]) &
+                (ow < out_cols[:, None, None]))
+    return {"Out": [out * out_mask[:, None].astype(out.dtype)]}
+
+
+@register_op("bilateral_slice")
+def _bilateral_slice(ins, attrs, op):
+    """ref bilateral_slice_op.cu (HDRnet): trilinearly sample the bilateral
+    grid at (x, y, guide(x, y)) per pixel; with has_offset the sampled
+    coefficients apply as a per-pixel affine transform of the input."""
+    x = _one(ins, "X")          # (N, C_in, H, W)
+    grid = _one(ins, "Grid")    # (N, C_g, D, Hg, Wg)
+    guide = _one(ins, "Guide")  # (N, H, W) in [0, 1]
+    has_offset = attrs.get("has_offset", False)
+    N, Cin, H, W = x.shape
+    _, Cg, Dg, Hg, Wg = grid.shape
+
+    gy = (jnp.arange(H, dtype=jnp.float32) + 0.5) * Hg / H - 0.5
+    gx = (jnp.arange(W, dtype=jnp.float32) + 0.5) * Wg / W - 0.5
+
+    def tri_sample(g, gd):
+        """g (Cg, Dg, Hg, Wg), gd (H, W) depth coord -> (Cg, H, W)."""
+        gz = gd * Dg - 0.5
+        z0 = jnp.clip(jnp.floor(gz), 0, Dg - 1).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(gy), 0, Hg - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(gx), 0, Wg - 1).astype(jnp.int32)
+        z1 = jnp.clip(z0 + 1, 0, Dg - 1)
+        y1 = jnp.clip(y0 + 1, 0, Hg - 1)
+        x1 = jnp.clip(x0 + 1, 0, Wg - 1)
+        wz = jnp.clip(gz - z0, 0.0, 1.0)                      # (H, W)
+        wy = jnp.clip(gy - y0, 0.0, 1.0)[:, None]             # (H, 1)
+        wx = jnp.clip(gx - x0, 0.0, 1.0)[None, :]             # (1, W)
+        out = 0.0
+        for zi, wz_ in ((z0, 1 - wz), (z1, wz)):
+            for yi, wy_ in ((y0, 1 - wy), (y1, wy)):
+                for xi, wx_ in ((x0, 1 - wx), (x1, wx)):
+                    v = g[:, zi, yi[:, None], xi[None, :]]    # (Cg, H, W)
+                    out = out + v * (wz_ * wy_ * wx_)[None]
+        return out
+
+    coeffs = jax.vmap(tri_sample)(grid.astype(jnp.float32),
+                                  guide.astype(jnp.float32))  # (N,Cg,H,W)
+    # ref bilateral_slice_op.cu: the sampled coefficients always APPLY to
+    # X — has_offset only adds the bias column (Cg = C_out*(C_in+1) with
+    # offset, C_out*C_in without)
+    if has_offset:
+        Cout = Cg // (Cin + 1)
+        co = coeffs.reshape(N, Cout, Cin + 1, H, W)
+        out = jnp.einsum("ncihw,nihw->nchw", co[:, :, :Cin],
+                         x.astype(jnp.float32)) + co[:, :, Cin]
+    else:
+        Cout = Cg // Cin
+        co = coeffs.reshape(N, Cout, Cin, H, W)
+        out = jnp.einsum("ncihw,nihw->nchw", co, x.astype(jnp.float32))
+    return {"Out": [out.astype(x.dtype)]}

@@ -1,0 +1,211 @@
+"""Batch-3 static ops: attention_lstm, PrRoI pooling (exact integral),
+tree_conv (TBCNN), filter_by_instag, pyramid_hash, var_conv_2d,
+bilateral_slice (see static/ops_tail3.py for per-op reference files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(33)
+
+
+def test_attention_lstm_shapes_and_attention_effect():
+    B, T, M, D = 2, 5, 4, 3
+    x = RNG.normal(0, 1, (B, T, M)).astype(np.float32)
+    att_w = RNG.normal(0, 1, (M + D, 1)).astype(np.float32)
+    lstm_w = RNG.normal(0, 0.3, (M + D, 4 * D)).astype(np.float32)
+    lstm_b = np.zeros((4 * D,), np.float32)
+    hs, cs = _run_single_op(
+        "attention_lstm",
+        {"X": x, "AttentionWeight": att_w, "LSTMWeight": lstm_w,
+         "LSTMBias": lstm_b},
+        out_slots=("Hidden", "Cell"))
+    assert hs.shape == (B, T, D) and cs.shape == (B, T, D)
+    assert np.isfinite(hs).all()
+    # masking out later timesteps changes the pooled input -> different h
+    mask = np.ones((B, T), np.float32)
+    mask[:, 3:] = 0
+    hs2, _ = _run_single_op(
+        "attention_lstm",
+        {"X": x, "Mask": mask, "AttentionWeight": att_w,
+         "LSTMWeight": lstm_w, "LSTMBias": lstm_b},
+        out_slots=("Hidden", "Cell"))
+    assert not np.allclose(hs, hs2)
+
+
+def _prroi_reference(feat, x1, y1, x2, y2, ph, pw):
+    """Dense numeric integration oracle (fine sampling)."""
+    S = 64
+    out = np.zeros((feat.shape[0], ph, pw), np.float64)
+    H, W = feat.shape[1:]
+
+    def bilinear(c, y, x):
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        v = 0.0
+        for yy, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+            for xx, wx in ((x0, 1 - (x - x0)), (x0 + 1, x - x0)):
+                if 0 <= yy < H and 0 <= xx < W:
+                    v += feat[c, yy, xx] * wy * wx
+        return v
+
+    bh, bw = (y2 - y1) / ph, (x2 - x1) / pw
+    for c in range(feat.shape[0]):
+        for i in range(ph):
+            for j in range(pw):
+                acc = 0.0
+                for sy in range(S):
+                    for sx in range(S):
+                        y = y1 + (i + (sy + 0.5) / S) * bh
+                        x = x1 + (j + (sx + 0.5) / S) * bw
+                        acc += bilinear(c, y, x)
+                out[c, i, j] = acc / (S * S)
+    return out
+
+
+def test_prroi_pool_matches_numeric_integral():
+    feat = RNG.normal(0, 1, (1, 2, 6, 6)).astype(np.float32)
+    rois = np.array([[0.7, 1.1, 4.3, 4.9]], np.float32)
+    (out,) = _run_single_op(
+        "prroi_pool", {"X": feat, "ROIs": rois},
+        attrs={"spatial_scale": 1.0, "pooled_height": 2,
+               "pooled_width": 2})
+    ref = _prroi_reference(feat[0], 0.7, 1.1, 4.3, 4.9, 2, 2)
+    np.testing.assert_allclose(out[0], ref, rtol=2e-3, atol=2e-3)
+
+
+def test_tree_conv_matches_dfs_reference():
+    """Oracle: the reference's DFS patch + eta weights, in python."""
+    N, F, OUT, K, depth = 5, 3, 2, 2, 2
+    x = RNG.normal(0, 1, (1, N, F)).astype(np.float32)
+    # tree: 0 -> 1,2 ; 1 -> 3,4
+    edges = np.full((1, 6, 2), -1, np.int64)
+    edges[0, :4] = [[0, 1], [0, 2], [1, 3], [1, 4]]
+    filt = RNG.normal(0, 1, (F, 3, OUT, K)).astype(np.float32)
+    (out,) = _run_single_op(
+        "tree_conv", {"NodesVector": x, "EdgeSet": edges, "Filter": filt},
+        attrs={"max_depth": depth})
+
+    children = {0: [1, 2], 1: [3, 4], 2: [], 3: [], 4: []}
+
+    def eta(depth_, idx, pclen, fd=float(depth)):
+        et = (fd - depth_) / fd
+        temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+        el = (1 - et) * temp
+        er = (1 - et) * (1 - el)
+        return et, el, er
+
+    ref = np.zeros((N, OUT, K))
+    for root in range(N):
+        patch = [(root, 1, 1, 0)]
+        if depth > 1:
+            ch = children[root]
+            for i, v in enumerate(ch):
+                patch.append((v, i + 1, len(ch), 1))
+        for node, idx, pclen, d in patch:
+            et, el, er = eta(d, idx, pclen)
+            ref[root] += (et * np.einsum("f,fok->ok", x[0, node], filt[:, 0])
+                          + el * np.einsum("f,fok->ok", x[0, node],
+                                           filt[:, 1])
+                          + er * np.einsum("f,fok->ok", x[0, node],
+                                           filt[:, 2]))
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_filter_by_instag_mask_semantics():
+    x = RNG.normal(0, 1, (4, 3)).astype(np.float32)
+    tags = np.array([[1, 2], [3, -1], [2, 5], [7, -1]], np.int64)
+    ftags = np.array([2, 9], np.int64)
+    out, w, idx = _run_single_op(
+        "filter_by_instag", {"Ins": x, "Ins_tag": tags,
+                             "Filter_tag": ftags},
+        out_slots=("Out", "LossWeight", "IndexMap"))
+    np.testing.assert_allclose(w.reshape(-1), [1, 0, 1, 0])
+    np.testing.assert_allclose(out[0], x[0], rtol=1e-6)
+    assert (out[1] == 0).all() and (out[3] == 0).all()
+
+
+def test_pyramid_hash_ngram_embedding():
+    x = np.array([[3, 5, 9, -1]], np.int64)
+    w = RNG.normal(0, 1, (32, 4)).astype(np.float32)
+    (out,) = _run_single_op(
+        "pyramid_hash", {"X": x, "W": w},
+        attrs={"space_len": 32, "pyramid_layer": 3, "num_emb": 4})
+    assert out.shape == (1, 4) and np.isfinite(out).all()
+    # valid n-grams: (3,5), (5,9), (3,5,9) -> sum of 3 hashed rows; the
+    # padded tail contributes nothing
+    x2 = np.array([[3, 5, 9, 11]], np.int64)
+    (out2,) = _run_single_op(
+        "pyramid_hash", {"X": x2, "W": w},
+        attrs={"space_len": 32, "pyramid_layer": 3, "num_emb": 4})
+    assert not np.allclose(out, out2)  # extra grams change the sum
+    # deterministic
+    (out3,) = _run_single_op(
+        "pyramid_hash", {"X": x, "W": w},
+        attrs={"space_len": 32, "pyramid_layer": 3, "num_emb": 4})
+    np.testing.assert_allclose(out, out3, rtol=1e-6)
+
+
+def test_var_conv_2d_masks_extents():
+    x = RNG.normal(0, 1, (2, 1, 6, 6)).astype(np.float32)
+    w = RNG.normal(0, 1, (2, 1, 3, 3)).astype(np.float32)
+    rows = np.array([6, 3], np.int64)
+    cols = np.array([6, 4], np.int64)
+    (out,) = _run_single_op(
+        "var_conv_2d", {"X": x, "ROW": rows, "COLUMN": cols, "W": w},
+        attrs={"StrideH": 1, "StrideW": 1, "KernelH": 3, "KernelW": 3})
+    assert out.shape[2:] == (6, 6)
+    # sample 1's output beyond (3, 4) extent is zeroed
+    assert (out[1, :, 3:, :] == 0).all() and (out[1, :, :, 4:] == 0).all()
+    assert not (out[0] == 0).all()
+
+
+def test_bilateral_slice_constant_grid():
+    """A grid constant along depth/space must sample to that constant, and
+    has_offset applies the affine coefficients."""
+    N, Cin, H, W = 1, 2, 4, 4
+    Cout = 2
+    Cg = Cout * (Cin + 1)
+    grid = np.zeros((N, Cg, 3, 2, 2), np.float32)
+    co = RNG.normal(0, 1, (Cg,)).astype(np.float32)
+    grid[0] = co[:, None, None, None]
+    guide = RNG.uniform(0, 1, (N, H, W)).astype(np.float32)
+    x = RNG.normal(0, 1, (N, Cin, H, W)).astype(np.float32)
+    (out,) = _run_single_op(
+        "bilateral_slice", {"X": x, "Grid": grid, "Guide": guide},
+        attrs={"has_offset": True})
+    comat = co.reshape(Cout, Cin + 1)
+    ref = np.einsum("ci,ihw->chw", comat[:, :Cin], x[0]) + \
+        comat[:, Cin][:, None, None]
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_bilateral_slice_no_offset_applies_coeffs():
+    N, Cin, H, W = 1, 2, 4, 4
+    Cout = 2
+    Cg = Cout * Cin  # no bias column
+    grid = np.zeros((N, Cg, 3, 2, 2), np.float32)
+    co = RNG.normal(0, 1, (Cg,)).astype(np.float32)
+    grid[0] = co[:, None, None, None]
+    guide = RNG.uniform(0, 1, (N, H, W)).astype(np.float32)
+    x = RNG.normal(0, 1, (N, Cin, H, W)).astype(np.float32)
+    (out,) = _run_single_op(
+        "bilateral_slice", {"X": x, "Grid": grid, "Guide": guide},
+        attrs={"has_offset": False})
+    comat = co.reshape(Cout, Cin)
+    ref = np.einsum("ci,ihw->chw", comat, x[0])
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_prroi_batch_roi_nums_are_per_image_counts():
+    """N == R must not confuse counts for per-ROI ids (the exact ambiguity
+    the reference's per-image-counts contract forbids)."""
+    feat = RNG.normal(0, 1, (2, 1, 4, 4)).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0], [0.0, 0.0, 3.0, 3.0]],
+                    np.float32)
+    counts = np.array([2, 0], np.int64)  # both rois belong to image 0
+    (out,) = _run_single_op(
+        "prroi_pool", {"X": feat, "ROIs": rois, "BatchRoINums": counts},
+        attrs={"spatial_scale": 1.0, "pooled_height": 1,
+               "pooled_width": 1})
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-6)  # same image
