@@ -61,6 +61,30 @@ from .layer.norm import (
     RMSNorm,
     SyncBatchNorm,
 )
+from .layer.extras import (
+    AdaptiveAvgPool3D,
+    AlphaDropout,
+    AvgPool3D,
+    Bilinear,
+    BilinearTensorProduct,
+    Conv3DTranspose,
+    CosineSimilarity,
+    CTCLoss,
+    Dropout3D,
+    Identity,
+    InstanceNorm1D,
+    InstanceNorm3D,
+    LocalResponseNorm,
+    MaxPool3D,
+    Pad1D,
+    Pad3D,
+    PairwiseDistance,
+    PixelShuffle,
+    RowConv,
+    SpectralNorm,
+    Unfold,
+    ZeroPad2D,
+)
 from .layer.moe import MoEFFN
 from .layer.rnn import (
     GRU,

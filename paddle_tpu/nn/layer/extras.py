@@ -1,0 +1,335 @@
+"""Layer-class tail completing the paddle.nn surface.
+
+Reference parity: python/paddle/nn/layer/ classes absent from the other
+layer modules — CTCLoss (loss.py), Bilinear/BilinearTensorProduct
+(common.py + bilinear_tensor_product_op.cc), CosineSimilarity,
+PairwiseDistance (distance.py), AlphaDropout, Dropout3D (common.py),
+Pad1D/Pad3D/ZeroPad2D (padding classes), PixelShuffle (vision.py),
+SpectralNorm, LocalResponseNorm (norm.py), RowConv (rnn-era conv),
+Conv3DTranspose, the 3D pooling classes, and Identity.  All thin Layer
+wrappers over the functional/ops library — one numeric implementation
+per op, layer classes are organization (SURVEY §1 L4 design stance).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as _dtype_mod
+from .. import functional as F
+from .. import initializer as init
+from .base import Layer, Parameter
+from .conv import _ConvNd
+from .norm import InstanceNorm2D
+
+__all__ = [
+    "Identity", "CTCLoss", "Bilinear", "BilinearTensorProduct",
+    "CosineSimilarity", "PairwiseDistance", "AlphaDropout", "Dropout3D",
+    "Pad1D", "Pad3D", "ZeroPad2D", "PixelShuffle", "SpectralNorm",
+    "LocalResponseNorm", "RowConv", "Conv3DTranspose", "MaxPool3D",
+    "AvgPool3D", "AdaptiveAvgPool3D", "InstanceNorm1D", "InstanceNorm3D",
+    "Unfold",
+]
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class CTCLoss(Layer):
+    """ref paddle.nn.CTCLoss -> functional ctc_loss (warpctc_op.cc)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths=None,
+                label_lengths=None):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class Bilinear(Layer):
+    """ref paddle.nn.Bilinear / bilinear_tensor_product_op.cc:
+    out_k = x1 @ W_k @ x2 + b_k."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        dtype = _dtype_mod.get_default_dtype()
+        w_init = getattr(weight_attr, "initializer", None) or \
+            init.XavierUniform()
+        self.weight = Parameter(
+            w_init((out_features, in1_features, in2_features), dtype),
+            initializer=w_init)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = getattr(bias_attr, "initializer", None) or \
+                init.Constant(0.0)
+            self.bias = Parameter(b_init((out_features,), dtype),
+                                  initializer=b_init)
+
+    def forward(self, x1, x2):
+        out = jnp.einsum("bi,kij,bj->bk", x1, self.weight.value, x2)
+        if self.bias is not None:
+            out = out + self.bias.value
+        return out
+
+
+class BilinearTensorProduct(Bilinear):
+    """fluid-era alias (fluid/dygraph/nn.py BilinearTensorProduct)."""
+
+
+class CosineSimilarity(Layer):
+    """ref paddle.nn.CosineSimilarity (distance.py)."""
+
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    """ref paddle.nn.PairwiseDistance: p-norm of x - y (+eps)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        d = x - y + self.epsilon
+        return jnp.linalg.norm(d, ord=self.p, axis=-1,
+                               keepdims=self.keepdim)
+
+
+class AlphaDropout(Layer):
+    """ref paddle.nn.AlphaDropout (SELU-preserving dropout): keeps
+    self-normalizing mean/variance by dropping to alpha' with an affine
+    correction."""
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from ...core import random as _random
+
+        if not self.training or self.p == 0.0:
+            return x
+        alpha_p = -self._ALPHA * self._SCALE
+        keep = 1.0 - self.p
+        a = (keep + alpha_p ** 2 * keep * self.p) ** -0.5
+        b = -a * alpha_p * self.p
+        mask = jax.random.bernoulli(_random.next_key(), keep, x.shape)
+        return a * jnp.where(mask, x, alpha_p) + b
+
+
+class Dropout3D(Layer):
+    """Channel-wise dropout for NCDHW (ref paddle.nn.Dropout3D) —
+    F.dropout2d's channel mask is rank-generic, so it serves 5-D too."""
+
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__()
+        if data_format != "NCDHW":
+            raise ValueError(
+                "Dropout3D supports NCDHW only (channels-first channel "
+                "mask); permute NDHWC input first")
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        self.padding = list(padding) if isinstance(padding, (list, tuple)) \
+            else [padding] * self._n_pad
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value,
+                     data_format=self.data_format)
+
+
+class Pad1D(_PadNd):
+    _n_pad = 2
+
+
+class Pad3D(_PadNd):
+    _n_pad = 6
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(_PadNd):
+    _n_pad = 4
+
+    def __init__(self, padding, data_format="NCHW"):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class PixelShuffle(Layer):
+    """ref paddle.nn.PixelShuffle -> ops.pixel_shuffle."""
+
+    def __init__(self, upscale_factor):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.pixel_shuffle(x, self.upscale_factor)
+
+
+class SpectralNorm(Layer):
+    """ref paddle.nn.SpectralNorm (spectral_norm_op.cc): power-iteration
+    normalized weight; the u vector persists as a buffer."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        self.register_buffer("_u", jnp.ones((h,),
+                                            _dtype_mod.get_default_dtype()),
+                             persistable=True)
+
+    def forward(self, weight):
+        from ...ops import misc as M
+
+        out, u = M.spectral_norm(weight, self._buffers["_u"].value,
+                                 power_iters=self.power_iters,
+                                 epsilon=self.eps, dim=self.dim)
+        if not isinstance(u, jax.core.Tracer):
+            self._buffers["_u"].value = u
+        return out
+
+
+class LocalResponseNorm(Layer):
+    """ref paddle.nn.LocalResponseNorm -> ops.lrn (lrn_op.cc)."""
+
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.lrn(x, n=self.size, alpha=self.alpha, beta=self.beta,
+                       k=self.k)
+
+
+class RowConv(Layer):
+    """ref fluid RowConv (row_conv_op.cc): lookahead convolution."""
+
+    def __init__(self, num_channels, future_context_size,
+                 param_attr=None):
+        super().__init__()
+        dtype = _dtype_mod.get_default_dtype()
+        w_init = getattr(param_attr, "initializer", None) or \
+            init.XavierUniform()
+        self.weight = Parameter(
+            w_init((future_context_size + 1, num_channels), dtype),
+            initializer=w_init)
+
+    def forward(self, x, lengths=None):
+        from ... import ops
+
+        # lengths mask padded frames so lookahead cannot leak across
+        # sequence boundaries (ops.row_conv contract)
+        return ops.row_conv(x, self.weight.value, lengths=lengths)
+
+
+class Conv3DTranspose(_ConvNd):
+    """ref paddle.nn.Conv3DTranspose -> F.conv3d_transpose (shares
+    _ConvNd's initialization defaults with the other conv layers)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, weight_attr,
+                         ndim=3, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x):
+        return F.conv3d_transpose(
+            x, self.weight.value,
+            None if self.bias is None else self.bias.value,
+            stride=self.stride, padding=self.padding,
+            output_padding=self.output_padding, dilation=self.dilation,
+            groups=self.groups)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.exclusive = padding, exclusive
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """ref paddle.nn.InstanceNorm1D — F.instance_norm is rank-generic, so
+    the 1D/3D classes share InstanceNorm2D's implementation."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """ref paddle.nn.InstanceNorm3D (see InstanceNorm1D)."""
+
+
+class Unfold(Layer):
+    """ref paddle.nn.Unfold (im2col as a layer, unfold_op.cc)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.unfold(x, self.kernel_sizes, self.strides,
+                          self.paddings, self.dilations)

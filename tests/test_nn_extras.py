@@ -1,0 +1,120 @@
+"""Layer-class tail (nn/layer/extras.py) — shapes + numeric contracts
+against torch/numpy oracles where available."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pd
+import paddle_tpu.nn as nn
+
+RNG = np.random.default_rng(44)
+
+
+def test_identity_and_ctc_loss_layer():
+    x = jnp.asarray(RNG.normal(0, 1, (3, 4)), jnp.float32)
+    assert (nn.Identity()(x) == x).all()
+    import torch
+
+    T, B, C, L = 8, 2, 5, 3
+    logits = RNG.normal(0, 1, (T, B, C)).astype(np.float32)
+    labels = RNG.integers(1, C, (B, L)).astype(np.int32)
+    loss = nn.CTCLoss(blank=0, reduction="sum")(
+        jnp.asarray(logits), labels,
+        np.full((B,), T, np.int32), np.full((B,), L, np.int32))
+    ref = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.full((B,), T, dtype=torch.long),
+        torch.full((B,), L, dtype=torch.long), blank=0, reduction="sum")
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
+
+
+def test_bilinear_matches_torch():
+    import torch
+
+    x1 = RNG.normal(0, 1, (4, 3)).astype(np.float32)
+    x2 = RNG.normal(0, 1, (4, 5)).astype(np.float32)
+    layer = nn.Bilinear(3, 5, 2)
+    tl = torch.nn.Bilinear(3, 5, 2)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(np.asarray(layer.weight.value)))
+        tl.bias.copy_(torch.tensor(np.asarray(layer.bias.value)))
+    ours = np.asarray(layer(jnp.asarray(x1), jnp.asarray(x2)))
+    theirs = tl(torch.tensor(x1), torch.tensor(x2)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_similarity_and_pairwise_distance():
+    import torch
+
+    a = RNG.normal(0, 1, (4, 6)).astype(np.float32)
+    b = RNG.normal(0, 1, (4, 6)).astype(np.float32)
+    ours = np.asarray(nn.CosineSimilarity(axis=1)(jnp.asarray(a),
+                                                  jnp.asarray(b)))
+    theirs = torch.nn.functional.cosine_similarity(
+        torch.tensor(a), torch.tensor(b), dim=1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+    d_ours = np.asarray(nn.PairwiseDistance()(jnp.asarray(a),
+                                              jnp.asarray(b)))
+    d_theirs = torch.nn.functional.pairwise_distance(
+        torch.tensor(a), torch.tensor(b)).numpy()
+    np.testing.assert_allclose(d_ours, d_theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_alpha_dropout_preserves_moments():
+    pd.seed(5)
+    layer = nn.AlphaDropout(p=0.3)
+    layer.train()
+    x = jnp.asarray(RNG.normal(0, 1, (200_0,)), jnp.float32)
+    y = np.asarray(layer(x))
+    assert abs(y.mean()) < 0.1 and abs(y.std() - 1.0) < 0.15
+    layer.eval()
+    assert (np.asarray(layer(x)) == np.asarray(x)).all()
+
+
+def test_pads_and_pixel_shuffle_and_pool3d():
+    x3 = jnp.asarray(RNG.normal(0, 1, (1, 2, 3, 4, 5)), jnp.float32)
+    out = nn.Pad3D([1, 1, 0, 0, 2, 0])(x3)
+    assert out.shape == (1, 2, 5, 4, 7)
+    x2 = jnp.asarray(RNG.normal(0, 1, (1, 2, 3, 3)), jnp.float32)
+    assert nn.ZeroPad2D([1, 1, 1, 1])(x2).shape == (1, 2, 5, 5)
+    ps = nn.PixelShuffle(2)(jnp.asarray(RNG.normal(0, 1, (1, 8, 3, 3)),
+                                        jnp.float32))
+    assert ps.shape == (1, 2, 6, 6)
+    p3 = nn.MaxPool3D(2, 2)(jnp.asarray(RNG.normal(0, 1, (1, 2, 4, 4, 4)),
+                                        jnp.float32))
+    assert p3.shape == (1, 2, 2, 2, 2)
+    a3 = nn.AdaptiveAvgPool3D(2)(jnp.asarray(
+        RNG.normal(0, 1, (1, 2, 4, 4, 4)), jnp.float32))
+    assert a3.shape == (1, 2, 2, 2, 2)
+
+
+def test_conv3d_transpose_layer_roundtrip():
+    layer = nn.Conv3DTranspose(3, 4, 3, stride=2, padding=1,
+                               output_padding=1)
+    x = jnp.asarray(RNG.normal(0, 1, (1, 3, 4, 4, 4)), jnp.float32)
+    out = layer(x)
+    assert out.shape == (1, 4, 8, 8, 8)
+
+
+def test_spectral_norm_and_lrn_and_unfold():
+    w = jnp.asarray(RNG.normal(0, 1, (6, 5)), jnp.float32)
+    sn = nn.SpectralNorm((6, 5), power_iters=20)
+    wn = sn(w)
+    top = np.linalg.svd(np.asarray(wn), compute_uv=False)[0]
+    np.testing.assert_allclose(top, 1.0, rtol=1e-3)
+    x = jnp.asarray(RNG.normal(0, 1, (1, 4, 5, 5)), jnp.float32)
+    assert nn.LocalResponseNorm(3)(x).shape == x.shape
+    u = nn.Unfold([2, 2], strides=2)(jnp.asarray(
+        RNG.normal(0, 1, (1, 3, 4, 4)), jnp.float32))
+    assert u.shape == (1, 12, 4)
+
+
+def test_instance_norm_1d_3d():
+    x1 = jnp.asarray(RNG.normal(3, 2, (2, 4, 9)), jnp.float32)
+    y1 = np.asarray(nn.InstanceNorm1D(4)(x1))
+    np.testing.assert_allclose(y1.mean(axis=2), 0.0, atol=1e-5)
+    x3 = jnp.asarray(RNG.normal(3, 2, (2, 4, 3, 3, 3)), jnp.float32)
+    y3 = np.asarray(nn.InstanceNorm3D(4)(x3))
+    np.testing.assert_allclose(y3.mean(axis=(2, 3, 4)), 0.0, atol=1e-5)
