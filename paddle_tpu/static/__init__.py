@@ -10,6 +10,7 @@ static.layers, append_backward via an optimizer, train with Executor.run —
 tests/test_static.py demonstrates exactly this.
 """
 from . import layers, optimizer
+from . import layers_tail  # noqa: F401 — fluid.layers DSL tail (attaches to layers)
 from . import control_flow
 from .backward import append_backward, gradients
 from .control_flow import (

@@ -167,6 +167,8 @@ def _reduce(fn):
         dim = attrs.get("dim", None)
         if attrs.get("reduce_all", False) or dim is None:
             dim = tuple(range(x.ndim))
+        elif isinstance(dim, int):
+            dim = (dim,)
         return {"Out": [fn(x, axis=tuple(dim),
                            keepdims=attrs.get("keep_dim", False))]}
 

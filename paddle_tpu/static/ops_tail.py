@@ -212,7 +212,7 @@ def _spectral_norm(ins, attrs, op):
 
     out, _ = M.spectral_norm(_one(ins, "Weight"), _one(ins, "U"),
                              power_iters=attrs.get("power_iters", 1),
-                             eps=attrs.get("eps", 1e-12),
+                             epsilon=attrs.get("eps", 1e-12),
                              dim=attrs.get("dim", 0))
     return {"Out": [out]}
 
@@ -1257,6 +1257,32 @@ def _teacher_student_sigmoid_loss(ins, attrs, op):
     soft = jnp.maximum(z, 0.0) - z * label + jnp.log1p(jnp.exp(-jnp.abs(z)))
     loss = jnp.where((label > 0.0) & (label < 1.0), ce + soft, ce)
     return {"Y": [loss[:, None]]}
+
+
+@register_op("reduce_all")
+def _reduce_all(ins, attrs, op):
+    x = _one(ins, "X")
+    dim = attrs.get("dim")
+    axis = tuple(range(x.ndim)) if attrs.get("reduce_all") or dim is None \
+        else ((dim,) if isinstance(dim, int) else tuple(dim))
+    return {"Out": [jnp.all(x, axis=axis,
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("reduce_any")
+def _reduce_any(ins, attrs, op):
+    x = _one(ins, "X")
+    dim = attrs.get("dim")
+    axis = tuple(range(x.ndim)) if attrs.get("reduce_all") or dim is None \
+        else ((dim,) if isinstance(dim, int) else tuple(dim))
+    return {"Out": [jnp.any(x, axis=axis,
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("diag")
+def _diag(ins, attrs, op):
+    """ref diag_op.cc: vector -> diagonal matrix."""
+    return {"Out": [jnp.diagflat(_one(ins, "Diagonal"))]}
 
 
 @register_op("fake_quantize_dequantize_fixed_scale")

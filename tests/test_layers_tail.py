@@ -1,0 +1,226 @@
+"""fluid.layers DSL tail (static/layers_tail.py): wrappers build, run
+through the real Executor, and match numpy semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers as L
+
+RNG = np.random.default_rng(55)
+
+
+def _run(build, feed=None):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        fetches = build()
+    exe = static.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed or {}, fetch_list=list(fetches))
+
+
+def test_creation_and_logicals():
+    def build():
+        o = L.ones((2, 3))
+        z = L.zeros_like(o)
+        e = L.eye(3)
+        a = L.logical_and(L.ones((2,), "bool"), L.ones((2,), "bool"))
+        n = L.logical_not(L.zeros((2,), "bool"))
+        return o, z, e, a, n
+
+    o, z, e, a, n = _run(build)
+    np.testing.assert_allclose(o, np.ones((2, 3)))
+    np.testing.assert_allclose(z, np.zeros((2, 3)))
+    np.testing.assert_allclose(e, np.eye(3))
+    assert a.all() and n.all()
+
+
+def test_reductions_and_sum():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+
+    def build():
+        xv = static.data("x", (3, 4), append_batch_size=False)
+        return (L.reduce_max(xv, dim=1), L.reduce_min(xv),
+                L.reduce_prod(xv, dim=0),
+                L.sum([xv, xv]), L.rank(xv), L.size(xv))
+
+    mx, mn, pr, s2, r, sz = _run(build, {"x": x})
+    np.testing.assert_allclose(mx, x.max(1), rtol=1e-6)
+    np.testing.assert_allclose(mn, x.min(), rtol=1e-6)
+    np.testing.assert_allclose(pr, x.prod(0), rtol=1e-5)
+    np.testing.assert_allclose(s2, 2 * x, rtol=1e-6)
+    assert int(r[0]) == 2 and int(sz) == 12
+
+
+def test_manipulation_tail():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+
+    def build():
+        xv = static.data("x", (3, 4), append_batch_size=False)
+        rev = L.reverse(xv, 0)
+        ub = L.unbind(xv, 0)
+        ss = L.strided_slice(xv, [1], [3], [0], [-2])
+        tgt = static.data("t", (3, 4), append_batch_size=False)
+        ea = L.expand_as(L.slice(xv, [0], [0], [1]), tgt)
+        return (rev, ub[0], ss, ea)
+
+    rev, u0, ss, ea = _run(build, {"x": x, "t": x})
+    np.testing.assert_allclose(rev, x[::-1], rtol=1e-6)
+    np.testing.assert_allclose(u0, x[0], rtol=1e-6)
+    np.testing.assert_allclose(ss, x[:, 3:0:-2], rtol=1e-6)
+    np.testing.assert_allclose(ea, np.broadcast_to(x[:1], x.shape),
+                               rtol=1e-6)
+
+
+def test_mul_and_losses():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+    y = RNG.normal(0, 1, (4, 2)).astype(np.float32)
+
+    def build():
+        xv = static.data("x", (3, 4), append_batch_size=False)
+        yv = static.data("y", (4, 2), append_batch_size=False)
+        m = L.mul(xv, yv)
+        lab = static.data("lab", (3, 1), dtype="int64",
+                          append_batch_size=False)
+        b = L.bpr_loss(xv, lab)
+        probs = L.softmax(xv)
+        ce2 = L.cross_entropy2(probs, lab)
+        return m, b, ce2
+
+    m, b, ce2 = _run(build, {
+        "x": x, "y": y,
+        "lab": RNG.integers(0, 4, (3, 1)).astype(np.int64)})
+    np.testing.assert_allclose(m, x @ y, rtol=1e-5)
+    assert b.shape == (3, 1) and ce2.shape == (3, 1)
+
+
+def test_dice_and_npair_compositions():
+    p = RNG.uniform(0.1, 0.9, (4, 5)).astype(np.float32)
+    lab = RNG.integers(0, 2, (4, 5)).astype(np.float32)
+
+    def build():
+        pv = static.data("p", (4, 5), append_batch_size=False)
+        lv = static.data("l", (4, 5), append_batch_size=False)
+        d = L.dice_loss(pv, lv)
+        a = static.data("a", (4, 5), append_batch_size=False)
+        labels = static.data("lab", (4, 1), dtype="int64",
+                             append_batch_size=False)
+        n = L.npair_loss(a, pv, labels)
+        return d, n
+
+    d, n = _run(build, {"p": p, "l": lab, "a": p,
+                        "lab": np.arange(4)[:, None].astype(np.int64)})
+    expect = 1 - 2 * (p * lab).sum() / (p.sum() + lab.sum() + 1e-5)
+    np.testing.assert_allclose(float(d), expect, rtol=1e-4)
+    assert np.isfinite(n)
+
+
+def test_random_and_position_encoding():
+    def build():
+        g = L.gaussian_random((64, 64), std=2.0)
+        u = L.uniform_random((64,), min=0.0, max=1.0)
+        x = static.data("x", (2, 6, 8), append_batch_size=False)
+        pe = L.add_position_encoding(x)
+        return g, u, pe
+
+    g, u, pe = _run(build, {"x": np.zeros((2, 6, 8), np.float32)})
+    assert 1.5 < g.std() < 2.5
+    assert 0 <= u.min() and u.max() <= 1
+    # zeros input -> output IS the sincos table; row 0 = sin(0),cos(0)...
+    np.testing.assert_allclose(pe[0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(pe[0, 0, 1], 1.0, atol=1e-6)
+
+
+def test_spectral_norm_and_save_combine(tmp_path):
+    w = RNG.normal(0, 1, (6, 5)).astype(np.float32)
+
+    def build():
+        wv = static.data("w", (6, 5), append_batch_size=False)
+        return (L.spectral_norm(wv, power_iters=30),)
+
+    (out,) = _run(build, {"w": w})
+    top = np.linalg.svd(out, compute_uv=False)[0]
+    np.testing.assert_allclose(top, 1.0, rtol=1e-3)
+
+    # save_combine writes, load_combine round-trips
+    path = str(tmp_path / "combined")
+
+    def build_save():
+        a = static.data("a", (2, 2), append_batch_size=False)
+        b = static.data("b", (3,), append_batch_size=False)
+        L.save_combine([a, b], path)
+        return (a,)
+
+    a = RNG.normal(0, 1, (2, 2)).astype(np.float32)
+    b = RNG.normal(0, 1, (3,)).astype(np.float32)
+    _run(build_save, {"a": a, "b": b})
+    import os
+
+    assert os.path.exists(path)
+
+    def build_load():
+        block = static.default_main_program().current_block()
+        # npz keys are the SAVE-time var names
+        oa = block.create_var(name="a")
+        ob = block.create_var(name="b")
+        L.load_combine([oa, ob], path)
+        return oa, ob
+
+    ra, rb = _run(build_load)
+    np.testing.assert_allclose(ra, a, rtol=1e-6)
+    np.testing.assert_allclose(rb, b, rtol=1e-6)
+
+
+def test_reduce_any_all_diag_and_has_inf():
+    x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+    x[1, 2] = np.inf
+
+    def build():
+        xv = static.data("x", (3, 4), append_batch_size=False)
+        hi = L.has_inf(xv)
+        hn = L.has_nan(xv)
+        d = static.data("d", (3,), append_batch_size=False)
+        dg = L.diag(d)
+        b = static.data("b", (2, 2), dtype="bool", append_batch_size=False)
+        return hi, hn, dg, L.reduce_all(b), L.reduce_any(b, dim=1)
+
+    hi, hn, dg, ra, ry = _run(build, {
+        "x": x, "d": np.arange(3, dtype=np.float32),
+        "b": np.array([[True, False], [True, True]])})
+    assert bool(hi) and not bool(hn)
+    np.testing.assert_allclose(dg, np.diag(np.arange(3)), rtol=1e-6)
+    assert not bool(ra)
+    np.testing.assert_array_equal(ry, [True, True])
+
+
+def test_position_encoding_odd_dim():
+    def build():
+        x = static.data("x", (1, 4, 5), append_batch_size=False)
+        return (L.add_position_encoding(x),)
+
+    (pe,) = _run(build, {"x": np.zeros((1, 4, 5), np.float32)})
+    assert pe.shape == (1, 4, 5) and np.isfinite(pe).all()
+
+
+def test_sampled_softmax_and_filter_instag():
+    logits = RNG.normal(0, 1, (4, 50)).astype(np.float32)
+
+    def build():
+        lv = static.data("lg", (4, 50), append_batch_size=False)
+        lab = static.data("lab", (4, 1), dtype="int64",
+                          append_batch_size=False)
+        loss = L.sampled_softmax_with_cross_entropy(lv, lab, num_samples=8)
+        ins = static.data("ins", (4, 3), append_batch_size=False)
+        tag = static.data("tag", (4, 2), dtype="int64",
+                          append_batch_size=False)
+        ft = static.data("ft", (1,), dtype="int64",
+                         append_batch_size=False)
+        fo, fw = L.filter_by_instag(ins, tag, ft)
+        return loss, fo, fw
+
+    loss, fo, fw = _run(build, {
+        "lg": logits, "lab": RNG.integers(0, 50, (4, 1)).astype(np.int64),
+        "ins": RNG.normal(0, 1, (4, 3)).astype(np.float32),
+        "tag": np.array([[1, 2], [3, 4], [2, 9], [5, 6]], np.int64),
+        "ft": np.array([2], np.int64)})
+    assert loss.shape == (4, 1) and np.isfinite(loss).all()
+    np.testing.assert_allclose(fw.reshape(-1), [1, 0, 1, 0])
