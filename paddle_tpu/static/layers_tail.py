@@ -241,8 +241,16 @@ def strided_slice(input, axes, starts, ends, strides, name=None):
     shape = list(input.shape)
     for ax, s, e, st in zip(axes, starts, ends, strides):
         if shape[ax] >= 0:
-            shape[ax] = max(0, -(-(min(e, shape[ax]) - s) // st)) \
-                if st > 0 else max(0, -(-(s - max(e, -1)) // -st))
+            n = shape[ax]
+            # normalize negative indices the way the slice executes
+            s_ = s + n if s < 0 else s
+            e_ = e + n if e < 0 else e
+            if st > 0:
+                shape[ax] = max(0, -(-(min(e_, n) - min(max(s_, 0), n))
+                                     // st))
+            else:
+                shape[ax] = max(0, -(-(min(s_, n - 1) - max(e_, -1))
+                                     // -st))
     out = _out(input.dtype, tuple(shape))
     _append("strided_slice", {"Input": [input.name]}, {"Out": [out.name]},
             {"axes": list(axes), "starts": list(starts),
@@ -337,9 +345,10 @@ def sums(input, out=None):
 
 @_export
 def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
-    m = int(np.prod([s for s in x.shape[:x_num_col_dims]]))
-    n = int(np.prod([s for s in y.shape[y_num_col_dims:]]))
-    out = _out(x.dtype, (m if m >= 0 else -1, n))
+    # the runtime rule reshapes back to x.shape[:xd] + y.shape[yd:]
+    out = _out(x.dtype,
+               tuple(x.shape[:x_num_col_dims]) + tuple(
+                   y.shape[y_num_col_dims:]))
     _append("mul", {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]},
             {"x_num_col_dims": x_num_col_dims,
              "y_num_col_dims": y_num_col_dims})
@@ -450,31 +459,53 @@ def cross_entropy2(input, label, ignore_index=-100):
 
 @_export
 def dice_loss(input, label, epsilon=1e-5):
-    """ref fluid/layers/nn.py dice_loss — composition of existing ops."""
-    land = _L.elementwise_mul(input, label)
-    inter = _L.reduce_sum(land)
-    union = _L.elementwise_add(_L.reduce_sum(input), _L.reduce_sum(label))
+    """ref fluid/layers/nn.py dice_loss: one_hot the int labels, dice per
+    SAMPLE over dims 1.., then mean — the reference composition exactly."""
+    # v1 one_hot semantics: the trailing size-1 label dim is replaced by
+    # the class dim (label [N1..ND-1,1] -> [N1..ND-1,classes])
+    depth = input.shape[-1]
+    label_oh = _out("float32", tuple(label.shape[:-1]) + (depth,))
+    _append("one_hot", {"X": [label.name]}, {"Out": [label_oh.name]},
+            {"depth": depth})
+    rd = list(range(1, input.ndim))
+    inse = _L.reduce_sum(_L.elementwise_mul(input, label_oh), dim=rd)
+    denom = _L.elementwise_add(_L.reduce_sum(input, dim=rd),
+                               _L.reduce_sum(label_oh, dim=rd))
     two = _L.fill_constant((), "float32", 2.0)
     one = _L.fill_constant((), "float32", 1.0)
     eps = _L.fill_constant((), "float32", epsilon)
-    dice = _L.elementwise_div(
-        _L.elementwise_mul(two, inter),
-        _L.elementwise_add(union, eps))
-    return _L.elementwise_sub(one, dice)
+    score = _L.elementwise_sub(one, _L.elementwise_div(
+        _L.elementwise_mul(inse, two), _L.elementwise_add(denom, eps)))
+    return _L.mean(score)
 
 
 @_export
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    """ref fluid/layers/loss.py npair_loss — cross-entropy over the
-    anchor·positiveᵀ similarity matrix + L2 on the embeddings."""
+    """ref fluid/layers/loss.py npair_loss (NIPS'16 N-pair): soft-label CE
+    over the anchor·positiveᵀ similarity matrix, where the soft target is
+    the row-normalized label-EQUALITY matrix; plus Beta*l2_reg * mean
+    per-sample embedding norms — the reference composition exactly."""
+    B = labels.shape[0]
+    lab = _L.reshape(labels, (B, 1))
+    expanded = _out(lab.dtype, (B, B))
+    _append("expand_v2", {"X": [lab.name]}, {"Out": [expanded.name]},
+            {"shape": (B, B)})
+    eq_b = _out("bool", (B, B))
+    _append("equal", {"X": [expanded.name],
+                      "Y": [_L.transpose(expanded, [1, 0]).name]},
+            {"Out": [eq_b.name]})
+    eq = _L.cast(eq_b, "float32")
+    target = _L.elementwise_div(
+        eq, _L.reduce_sum(eq, dim=1, keep_dim=True))
+    l2 = _L.elementwise_add(
+        _L.mean(_L.reduce_sum(_L.elementwise_mul(anchor, anchor), dim=1)),
+        _L.mean(_L.reduce_sum(_L.elementwise_mul(positive, positive),
+                              dim=1)))
+    reg = _L.fill_constant((), "float32", 0.25 * l2_reg)
     sim = _L.matmul(anchor, positive, transpose_y=True)
-    ce = _L.softmax_with_cross_entropy(sim, labels)
-    l2 = _L.elementwise_add(_L.reduce_sum(_L.elementwise_mul(anchor,
-                                                             anchor)),
-                            _L.reduce_sum(_L.elementwise_mul(positive,
-                                                             positive)))
-    reg = _L.fill_constant((), "float32", l2_reg * 0.25)
-    return _L.elementwise_add(_L.mean(ce), _L.elementwise_mul(reg, l2))
+    ce = _L.softmax_with_cross_entropy(sim, target, soft_label=True)
+    celoss = _L.mean(_L.reduce_sum(_L.elementwise_mul(target, ce), dim=0))
+    return _L.elementwise_add(celoss, _L.elementwise_mul(reg, l2))
 
 
 @_export

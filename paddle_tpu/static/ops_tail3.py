@@ -338,3 +338,89 @@ def _bilateral_slice(ins, attrs, op):
         co = coeffs.reshape(N, Cout, Cin, H, W)
         out = jnp.einsum("ncihw,nihw->nchw", co, x.astype(jnp.float32))
     return {"Out": [out.astype(x.dtype)]}
+
+
+# =========================================================================
+# reference-named sequence op aliases + last stragglers.  The _padded
+# rules ARE the dense re-scope of the same-named LoD ops; registering the
+# reference names keeps converted programs loadable without a rename pass.
+# =========================================================================
+
+from .registry import get_lowering as _get_lowering  # noqa: E402
+
+for _ref, _padded in [
+        ("sequence_pool", "sequence_pool_padded"),
+        ("sequence_conv", "sequence_conv_padded"),
+        ("sequence_reverse", "sequence_reverse_padded"),
+        ("sequence_concat", "sequence_concat_padded"),
+        ("sequence_expand", "sequence_expand_padded"),
+        ("sequence_slice", "sequence_slice_padded")]:
+    register_op(_ref)(_get_lowering(_padded))
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ins, attrs, op):
+    """ref sequence_reshape_op.cc: re-chunk each sequence's flattened
+    values to a new feature dim; dense layout keeps (B, T', new_dim)."""
+    x = _one(ins, "X")
+    new_dim = attrs["new_dim"]
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0, "sequence_reshape: indivisible new_dim"
+    return {"Out": [x.reshape(B, (T * D) // new_dim, new_dim)]}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ins, attrs, op):
+    """ref sequence_scatter_op.cc: scatter per-sequence updates into X at
+    per-sequence positions (dense: Ids (B, U) positions, Updates (B, U, D)
+    or (B, U))."""
+    x = _one(ins, "X")
+    ids = _one(ins, "Ids").astype(jnp.int32)
+    upd = _one(ins, "Updates")
+    b_idx = jnp.arange(x.shape[0])[:, None]
+    return {"Out": [x.at[b_idx, ids].add(upd)]}
+
+
+@register_op("select_input")
+def _select_input(ins, attrs, op):
+    """ref controlflow/select_input_op: route ONE of N inputs by Mask.
+    Static shapes: inputs must agree; lax.select keeps it traceable."""
+    xs = ins["X"]
+    mask = _one(ins, "Mask").reshape(()).astype(jnp.int32)
+    out = xs[0]
+    for i in range(1, len(xs)):
+        out = jnp.where(mask == i, xs[i], out)
+    return {"Out": [out]}
+
+
+@register_op("select_output")
+def _select_output(ins, attrs, op):
+    """ref controlflow/select_output_op: copy X to the Mask-selected
+    output; dense re-scope writes X to every branch and zeros the
+    non-selected ones (static shapes; the paired select_input re-picks)."""
+    x = _one(ins, "X")
+    mask = _one(ins, "Mask").reshape(()).astype(jnp.int32)
+    n = len(op.outputs["Out"])
+    return {"Out": [jnp.where(mask == i, x, jnp.zeros_like(x))
+                    for i in range(n)]}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ins, attrs, op):
+    """ref fused/fusion_seqexpand_concat_fc_op.cc: expand the second
+    (per-sequence) input along time, concat features, fc (+act)."""
+    x = ins["X"][0]              # (B, T, D1)
+    ref = ins["X"][1]            # (B, D2) per-sequence vector
+    w = _one(ins, "FCWeight")    # (D1+D2, out)
+    b = _one(ins, "FCBias")
+    T = x.shape[1]
+    expanded = jnp.broadcast_to(ref[:, None, :],
+                                (ref.shape[0], T, ref.shape[1]))
+    cat = jnp.concatenate([x, expanded], axis=-1)
+    out = jnp.einsum("btd,do->bto", cat, w)
+    if b is not None:
+        out = out + b
+    act = attrs.get("fc_activation", "identity")
+    if act != "identity":
+        out = getattr(jax.nn, act)(out)
+    return {"Out": [out]}

@@ -94,24 +94,41 @@ def test_mul_and_losses():
 
 
 def test_dice_and_npair_compositions():
+    """Match the reference formulas exactly: dice = mean over per-sample
+    dice with one-hot int labels; npair = soft-label CE over the
+    label-equality target + Beta*l2_reg*mean embedding norms."""
     p = RNG.uniform(0.1, 0.9, (4, 5)).astype(np.float32)
-    lab = RNG.integers(0, 2, (4, 5)).astype(np.float32)
+    p = p / p.sum(-1, keepdims=True)
+    lab_int = RNG.integers(0, 5, (4, 1)).astype(np.int64)
 
     def build():
         pv = static.data("p", (4, 5), append_batch_size=False)
-        lv = static.data("l", (4, 5), append_batch_size=False)
+        lv = static.data("l", (4, 1), dtype="int64",
+                         append_batch_size=False)
         d = L.dice_loss(pv, lv)
         a = static.data("a", (4, 5), append_batch_size=False)
-        labels = static.data("lab", (4, 1), dtype="int64",
+        labels = static.data("lab", (4,), dtype="int64",
                              append_batch_size=False)
         n = L.npair_loss(a, pv, labels)
         return d, n
 
-    d, n = _run(build, {"p": p, "l": lab, "a": p,
-                        "lab": np.arange(4)[:, None].astype(np.int64)})
-    expect = 1 - 2 * (p * lab).sum() / (p.sum() + lab.sum() + 1e-5)
-    np.testing.assert_allclose(float(d), expect, rtol=1e-4)
-    assert np.isfinite(n)
+    # labels with DUPLICATES and class ids OUTSIDE [0, B) — the cases the
+    # reference's equality-matrix semantics must handle
+    np_labels = np.array([7, 23, 7, 40], np.int64)
+    d, n = _run(build, {"p": p, "l": lab_int, "a": p, "lab": np_labels})
+    oh = np.eye(5)[lab_int.reshape(-1)]
+    per = 1 - 2 * (p * oh).sum(1) / (p.sum(1) + oh.sum(1) + 1e-5)
+    np.testing.assert_allclose(float(d), per.mean(), rtol=1e-4)
+    # reference npair oracle in numpy
+    eq = (np_labels[:, None] == np_labels[None, :]).astype(np.float32)
+    target = eq / eq.sum(1, keepdims=True)
+    sim = p @ p.T
+    logp = sim - np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(
+        1, keepdims=True)) - sim.max(1, keepdims=True)
+    ce = -(target * logp).sum(1)
+    celoss = (target * ce[None, :].T).sum(0).mean()
+    l2 = ((p ** 2).sum(1).mean() + (p ** 2).sum(1).mean()) * 0.25 * 0.002
+    np.testing.assert_allclose(float(n), celoss + l2, rtol=1e-3)
 
 
 def test_random_and_position_encoding():

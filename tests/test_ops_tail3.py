@@ -209,3 +209,55 @@ def test_prroi_batch_roi_nums_are_per_image_counts():
         attrs={"spatial_scale": 1.0, "pooled_height": 1,
                "pooled_width": 1})
     np.testing.assert_allclose(out[0], out[1], rtol=1e-6)  # same image
+
+
+def test_sequence_reference_name_aliases():
+    """The reference-NAMED sequence ops route to the padded rules."""
+    x = RNG.normal(0, 1, (2, 4, 3)).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    (pooled,) = _run_single_op("sequence_pool",
+                               {"X": x, "Lengths": lens},
+                               attrs={"pooltype": "sum"})
+    mask = (np.arange(4)[None, :, None] < lens[:, None, None])
+    np.testing.assert_allclose(pooled, (x * mask).sum(1), rtol=1e-5)
+
+
+def test_sequence_reshape_and_scatter():
+    x = RNG.normal(0, 1, (2, 4, 6)).astype(np.float32)
+    (out,) = _run_single_op("sequence_reshape", {"X": x},
+                            attrs={"new_dim": 8})
+    assert out.shape == (2, 3, 8)
+    np.testing.assert_allclose(out.reshape(2, -1), x.reshape(2, -1),
+                               rtol=1e-6)
+    base = np.zeros((2, 5, 3), np.float32)
+    ids = np.array([[0, 2], [4, 1]], np.int64)
+    upd = np.ones((2, 2, 3), np.float32)
+    (sc,) = _run_single_op("sequence_scatter",
+                           {"X": base, "Ids": ids, "Updates": upd})
+    assert sc[0, 0].sum() == 3 and sc[0, 2].sum() == 3 and sc[0, 1].sum() == 0
+    assert sc[1, 4].sum() == 3 and sc[1, 1].sum() == 3
+
+
+def test_select_input_output_pair():
+    a = np.full((2, 2), 1.0, np.float32)
+    b = np.full((2, 2), 2.0, np.float32)
+    mask = np.array([1], np.int32)
+    (out,) = _run_single_op("select_input",
+                            {"X": [a, b], "Mask": mask})
+    np.testing.assert_allclose(out, b, rtol=1e-6)
+    o0, o1 = _run_single_op("select_output",
+                            {"X": a, "Mask": mask},
+                            n_out={"Out": 2}, out_slots=("Out",))
+    assert (o0 == 0).all() and np.allclose(o1, a)
+
+
+def test_fusion_seqexpand_concat_fc():
+    x = RNG.normal(0, 1, (2, 3, 4)).astype(np.float32)
+    ref = RNG.normal(0, 1, (2, 5)).astype(np.float32)
+    w = RNG.normal(0, 1, (9, 6)).astype(np.float32)
+    (out,) = _run_single_op("fusion_seqexpand_concat_fc",
+                            {"X": [x, ref], "FCWeight": w},
+                            attrs={"fc_activation": "relu"})
+    cat = np.concatenate([x, np.broadcast_to(ref[:, None], (2, 3, 5))], -1)
+    np.testing.assert_allclose(out, np.maximum(cat @ w, 0), rtol=1e-4,
+                               atol=1e-5)
