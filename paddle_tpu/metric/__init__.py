@@ -1,6 +1,7 @@
 """Metrics (ref: python/paddle/metric/metrics.py — Metric ABC, Accuracy,
 Precision, Recall, Auc; fluid/metrics.py).  Accumulation is host-side numpy;
 the distributed variants allreduce host scalars (fleet/metrics/metric.py)."""
-from .metrics import Accuracy, Auc, Metric, Precision, Recall
+from .metrics import Accuracy, Auc, ChunkEvaluator, Metric, Precision, Recall
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
+           "ChunkEvaluator"]

@@ -140,3 +140,39 @@ class Auc(Metric):
         fpr = np.concatenate([[0.0], neg_cum / tot_neg])
         return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") else \
             float(np.trapz(tpr, fpr))
+
+
+class ChunkEvaluator(Metric):
+    """ref fluid/metrics.py ChunkEvaluator: accumulate
+    (num_infer, num_label, num_correct) chunk counts across batches and
+    expose (precision, recall, f1) — the NER evaluation companion of
+    layers.chunk_eval / the chunk_eval op."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "chunk")
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+        return self.eval()
+
+    def eval(self):
+        p = (self.num_correct_chunks / self.num_infer_chunks
+             if self.num_infer_chunks else 0.0)
+        r = (self.num_correct_chunks / self.num_label_chunks
+             if self.num_label_chunks else 0.0)
+        f1 = 2 * p * r / (p + r) if self.num_correct_chunks else 0.0
+        return p, r, f1
+
+    accumulate = eval
+
+    def compute(self, *args):
+        return args

@@ -1,0 +1,146 @@
+"""Chunk evaluation (NER precision/recall/F1 over label chunks).
+
+Reference parity: ``chunk_eval_op.h`` — the IOB/IOE/IOBES/plain chunk
+parse (``ChunkBegin``/``ChunkEnd`` predicates + the scalar segment scan)
+and the (num_infer, num_label, num_correct) → P/R/F1 computation that
+``fluid.layers.chunk_eval`` / ``fluid.metrics.ChunkEvaluator`` expose.
+
+TPU-native design: the reference's per-position begin/end predicates
+depend only on (prev, cur) tag pairs, so the whole parse vectorizes —
+begins/ends are elementwise boolean maps, each chunk's end index comes
+from a reverse min-scan, and a chunk is "correct" iff both sequences
+begin at the same position with the same type and the same end index.
+No host loop, jit-safe, batched.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["chunk_eval"]
+
+_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_bounds(labels, lengths, num_chunk_types, scheme):
+    """(begins, type, end_idx) per position for a (B, T) tag batch."""
+    ntag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types  # ref: other_chunk_type = num_chunk_types
+    B, T = labels.shape
+    tag = labels % ntag
+    typ = labels // ntag
+    pos = jnp.arange(T)
+    valid = pos[None, :] < lengths[:, None]
+    typ = jnp.where(valid, typ, other)  # padding acts like Other
+
+    prev_tag = jnp.concatenate(
+        [jnp.full((B, 1), -1, tag.dtype), tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate(
+        [jnp.full((B, 1), other, typ.dtype), typ[:, :-1]], axis=1)
+
+    # ChunkBegin(prev, cur) — chunk_eval_op.h:103, vectorized
+    beg = jnp.where(
+        prev_typ == other, typ != other,
+        jnp.where(
+            typ == other, False,
+            jnp.where(
+                typ != prev_typ, True,
+                (tag == t_begin)
+                | ((tag == t_inside) & ((prev_tag == t_end)
+                                        | (prev_tag == t_single)))
+                | ((tag == t_end) & ((prev_tag == t_end)
+                                     | (prev_tag == t_single)))
+                | (tag == t_single))))
+    begins = beg & valid
+
+    # ChunkEnd(cur, next) — a chunk ends AT i when the (i, i+1) transition
+    # closes it (or the sequence ends); every non-Other position is inside
+    # a chunk, so in_chunk == (typ != other)
+    nxt_tag = jnp.concatenate(
+        [tag[:, 1:], jnp.full((B, 1), -1, tag.dtype)], axis=1)
+    nxt_typ = jnp.concatenate(
+        [typ[:, 1:], jnp.full((B, 1), other, typ.dtype)], axis=1)
+    end_trans = jnp.where(
+        typ == other, False,
+        jnp.where(
+            nxt_typ == other, True,
+            jnp.where(
+                nxt_typ != typ, True,
+                jnp.where(
+                    tag == t_begin,
+                    (nxt_tag == t_begin) | (nxt_tag == t_single),
+                    jnp.where(
+                        tag == t_inside,
+                        (nxt_tag == t_begin) | (nxt_tag == t_single),
+                        (tag == t_end) | (tag == t_single))))))
+    last_valid = pos[None, :] == (lengths[:, None] - 1)
+    ends = (typ != other) & valid & (end_trans | last_valid)
+
+    # end index of the chunk covering position i: first j >= i with ends[j]
+    idx = jnp.where(ends, pos[None, :], T + 1)
+    end_idx = jnp.flip(
+        jnp.minimum.accumulate(jnp.flip(idx, axis=1), axis=1), axis=1)
+    return begins, typ, end_idx
+
+
+def chunk_eval(inference, label, lengths=None, chunk_scheme: str = "IOB",
+               num_chunk_types: int = 1,
+               excluded_chunk_types: Optional[Sequence[int]] = None
+               ) -> Tuple:
+    """ref chunk_eval_op.h: compare the chunk segmentations of
+    ``inference`` and ``label`` tag sequences.
+
+    Args:
+        inference/label: (B, T) int tag ids (``type * num_tag_types +
+            tag``; Other = ``num_chunk_types * num_tag_types``).
+        lengths: (B,) valid steps (default T).
+
+    Returns (precision, recall, f1, num_infer, num_label, num_correct)
+    as 0-d arrays (the reference op's six outputs).
+    """
+    if chunk_scheme not in _SCHEMES:
+        raise ValueError(f"unknown chunk_scheme {chunk_scheme!r}; one of "
+                         f"{sorted(_SCHEMES)}")
+    inference = jnp.asarray(inference, jnp.int32)
+    label = jnp.asarray(label, jnp.int32)
+    B, T = inference.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    else:
+        lengths = jnp.asarray(lengths, jnp.int32)
+
+    bi, ti, ei = _chunk_bounds(inference, lengths, num_chunk_types,
+                               chunk_scheme)
+    bl, tl, el = _chunk_bounds(label, lengths, num_chunk_types,
+                               chunk_scheme)
+
+    if excluded_chunk_types:
+        excl = jnp.asarray(list(excluded_chunk_types), jnp.int32)
+        keep_i = ~jnp.isin(ti, excl)
+        keep_l = ~jnp.isin(tl, excl)
+    else:
+        keep_i = jnp.ones_like(bi)
+        keep_l = jnp.ones_like(bl)
+
+    num_infer = jnp.sum(bi & keep_i)
+    num_label = jnp.sum(bl & keep_l)
+    correct = bi & bl & (ti == tl) & (ei == el) & keep_i
+    num_correct = jnp.sum(correct)
+
+    nc = num_correct.astype(jnp.float32)
+    precision = jnp.where(num_infer > 0, nc / num_infer, 0.0)
+    recall = jnp.where(num_label > 0, nc / num_label, 0.0)
+    f1 = jnp.where(num_correct > 0,
+                   2 * precision * recall / (precision + recall), 0.0)
+    # int32 counts: int64 truncates under 32-bit jax (chunk counts are
+    # bounded by B*T anyway)
+    return (precision, recall, f1, num_infer.astype(jnp.int32),
+            num_label.astype(jnp.int32), num_correct.astype(jnp.int32))

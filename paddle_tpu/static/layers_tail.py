@@ -784,3 +784,29 @@ def _act_layer(op_type, **default_attrs):
 soft_relu = _act_layer("soft_relu", threshold=40.0)
 brelu = _act_layer("brelu", t_min=0.0, t_max=24.0)
 stanh = _act_layer("stanh", scale_a=0.67, scale_b=1.7159)
+
+
+@_export
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """ref fluid/layers/nn.py chunk_eval -> chunk_eval op.  Returns the
+    reference's six outputs (precision, recall, f1, n_infer, n_label,
+    n_correct)."""
+    outs = {
+        "Precision": _out("float32", ()),
+        "Recall": _out("float32", ()),
+        "F1-Score": _out("float32", ()),
+        "NumInferChunks": _out("int64", ()),
+        "NumLabelChunks": _out("int64", ()),
+        "NumCorrectChunks": _out("int64", ()),
+    }
+    ins = {"Inference": [input.name], "Label": [label.name]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length.name]
+    _append("chunk_eval", ins, {k: [v.name] for k, v in outs.items()},
+            {"chunk_scheme": chunk_scheme,
+             "num_chunk_types": num_chunk_types,
+             "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return (outs["Precision"], outs["Recall"], outs["F1-Score"],
+            outs["NumInferChunks"], outs["NumLabelChunks"],
+            outs["NumCorrectChunks"])

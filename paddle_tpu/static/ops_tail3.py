@@ -410,13 +410,16 @@ def _fusion_seqexpand_concat_fc(ins, attrs, op):
     """ref fused/fusion_seqexpand_concat_fc_op.cc: expand the second
     (per-sequence) input along time, concat features, fc (+act)."""
     x = ins["X"][0]              # (B, T, D1)
-    ref = ins["X"][1]            # (B, D2) per-sequence vector
-    w = _one(ins, "FCWeight")    # (D1+D2, out)
+    w = _one(ins, "FCWeight")    # (D1 + sum(D_ref_i), out)
     b = _one(ins, "FCBias")
     T = x.shape[1]
-    expanded = jnp.broadcast_to(ref[:, None, :],
-                                (ref.shape[0], T, ref.shape[1]))
-    cat = jnp.concatenate([x, expanded], axis=-1)
+    # X is duplicable in the reference: EVERY extra input is a
+    # per-sequence vector expanded along time then concatenated
+    parts = [x]
+    for ref in ins["X"][1:]:
+        parts.append(jnp.broadcast_to(
+            ref[:, None, :], (ref.shape[0], T, ref.shape[1])))
+    cat = jnp.concatenate(parts, axis=-1)
     out = jnp.einsum("btd,do->bto", cat, w)
     if b is not None:
         out = out + b
@@ -424,3 +427,19 @@ def _fusion_seqexpand_concat_fc(ins, attrs, op):
     if act != "identity":
         out = getattr(jax.nn, act)(out)
     return {"Out": [out]}
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ins, attrs, op):
+    """ref metrics chunk_eval_op.h (IOB/IOE/IOBES/plain chunk P/R/F1)."""
+    from ..ops.chunk import chunk_eval as _ce
+
+    p, r, f1, ni, nl, nc = _ce(
+        _one(ins, "Inference"), _one(ins, "Label"),
+        _one(ins, "SeqLength"),
+        chunk_scheme=attrs.get("chunk_scheme", "IOB"),
+        num_chunk_types=attrs.get("num_chunk_types", 1),
+        excluded_chunk_types=attrs.get("excluded_chunk_types"))
+    return {"Precision": [p], "Recall": [r], "F1-Score": [f1],
+            "NumInferChunks": [ni], "NumLabelChunks": [nl],
+            "NumCorrectChunks": [nc]}

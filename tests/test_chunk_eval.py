@@ -1,0 +1,111 @@
+"""chunk_eval (ref chunk_eval_op.h): vectorized chunk parse vs a direct
+transcription of the reference's scalar GetSegments scan."""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.chunk import _SCHEMES, chunk_eval
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(66)
+
+
+def _segments_oracle(labels, length, num_chunk_types, scheme):
+    """Direct transcription of chunk_eval_op.h GetSegments."""
+    ntag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(pt, pT, t, T):
+        if pT == other: return False
+        if T == other: return True
+        if T != pT: return True
+        if pt == t_begin: return t in (t_begin, t_single)
+        if pt == t_inside: return t in (t_begin, t_single)
+        if pt == t_end: return True
+        if pt == t_single: return True
+        return False
+
+    def chunk_begin(pt, pT, t, T):
+        if pT == other: return T != other
+        if T == other: return False
+        if T != pT: return True
+        if t == t_begin: return True
+        if t == t_inside: return pt in (t_end, t_single)
+        if t == t_end: return pt in (t_end, t_single)
+        if t == t_single: return True
+        return False
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i in range(length):
+        pt, pT = tag, typ
+        tag, typ = labels[i] % ntag, labels[i] // ntag
+        if in_chunk and chunk_end(pt, pT, tag, typ):
+            segs.append((start, i - 1, pT))
+            in_chunk = False
+        if chunk_begin(pt, pT, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, length - 1, typ))
+    return segs
+
+
+def _counts_oracle(inf, lab, lens, nct, scheme, excluded=()):
+    ni = nl = nc = 0
+    for b in range(inf.shape[0]):
+        si = [s for s in _segments_oracle(inf[b], lens[b], nct, scheme)
+              if s[2] not in excluded]
+        sl = [s for s in _segments_oracle(lab[b], lens[b], nct, scheme)
+              if s[2] not in excluded]
+        ni += len(si)
+        nl += len(sl)
+        nc += len(set(si) & set(sl))
+    return ni, nl, nc
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
+def test_chunk_eval_matches_scalar_reference(scheme):
+    ntag = _SCHEMES[scheme][0]
+    nct = 3
+    B, T = 5, 17
+    hi = nct * ntag + 1  # includes the Other tag id
+    inf = RNG.integers(0, hi, (B, T)).astype(np.int64)
+    lab = RNG.integers(0, hi, (B, T)).astype(np.int64)
+    lens = RNG.integers(3, T + 1, (B,)).astype(np.int64)
+    p, r, f1, ni, nl, nc = chunk_eval(inf, lab, lens, scheme, nct)
+    eni, enl, enc = _counts_oracle(inf, lab, lens, nct, scheme)
+    assert (int(ni), int(nl), int(nc)) == (eni, enl, enc), scheme
+    if eni and enl:
+        np.testing.assert_allclose(float(p), enc / eni, rtol=1e-6)
+        np.testing.assert_allclose(float(r), enc / enl, rtol=1e-6)
+
+
+def test_chunk_eval_excluded_types_and_perfect_match():
+    # perfect inference: all counts equal, F1 = 1
+    lab = np.array([[0, 1, 4, 0, 1, 6, 6]], np.int64)  # IOB, 3 types
+    p, r, f1, ni, nl, nc = chunk_eval(lab, lab, None, "IOB", 3)
+    assert int(ni) == int(nl) == int(nc) and float(f1) == 1.0
+    # excluding type 0 removes its chunks from the counts
+    _, _, _, ni2, _, _ = chunk_eval(lab, lab, None, "IOB", 3,
+                                    excluded_chunk_types=[0])
+    assert int(ni2) < int(ni)
+
+
+def test_chunk_eval_static_op_and_dsl():
+    inf = np.array([[0, 1, 6, 2, 3]], np.int64)
+    lab = np.array([[0, 1, 6, 2, 1]], np.int64)
+    outs = _run_single_op(
+        "chunk_eval", {"Inference": inf, "Label": lab},
+        attrs={"chunk_scheme": "IOB", "num_chunk_types": 3},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"))
+    p, r, f1, ni, nl, nc = [np.asarray(o) for o in outs]
+    eni, enl, enc = _counts_oracle(inf, lab, [5], 3, "IOB")
+    assert (int(ni), int(nl), int(nc)) == (eni, enl, enc)
+
+    from paddle_tpu.metric import ChunkEvaluator
+
+    m = ChunkEvaluator()
+    m.update(int(ni), int(nl), int(nc))
+    m.update(2, 2, 2)
+    prec, rec, f1v = m.eval()
+    assert prec == (int(nc) + 2) / (int(ni) + 2)
