@@ -100,9 +100,12 @@ bool EnsurePython() {
   if (g_py.ok) return true;
   void* lib = nullptr;
   if (!dlsym(RTLD_DEFAULT, "Py_IsInitialized")) {
-    const char* cand[] = {getenv("PD_LIBPYTHON"), "libpython3.12.so.1.0",
-                          "libpython3.12.so", "libpython3.11.so.1.0",
-                          "libpython3.11.so", "libpython3.10.so.1.0"};
+    const char* cand[] = {getenv("PD_LIBPYTHON"),
+                          "libpython3.14.so.1.0", "libpython3.14.so",
+                          "libpython3.13.so.1.0", "libpython3.13.so",
+                          "libpython3.12.so.1.0", "libpython3.12.so",
+                          "libpython3.11.so.1.0", "libpython3.11.so",
+                          "libpython3.10.so.1.0"};
     for (const char* c : cand) {
       if (!c) continue;  // PD_LIBPYTHON may be unset
       lib = dlopen(c, RTLD_NOW | RTLD_GLOBAL);
@@ -336,6 +339,18 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs, int n_inputs,
     void* h = g_py.Long_FromLong(pred->inproc_handle);
     void* payload = g_py.Bytes_FromStringAndSize(
         req.data(), static_cast<ssize_t>(req.size()));
+    if (!name || !h || !payload) {
+      // Py_DecRef is NULL-safe, so partial allocations clean up below;
+      // drain the pending MemoryError before releasing the GIL
+      if (g_py.Err_Occurred()) g_py.Err_Print();
+      g_py.Object_DecRef(payload);
+      g_py.Object_DecRef(h);
+      g_py.Object_DecRef(name);
+      g_py.Object_DecRef(mod);
+      SetError("python object allocation failed");
+      g_py.GILState_Release(g);
+      return -1;
+    }
     void* res = g_py.Object_CallMethodObjArgs(mod, name, h, payload, nullptr);
     char* out_p = nullptr;
     ssize_t out_n = 0;
@@ -391,6 +406,15 @@ PD_Predictor* PD_PredictorCreateInProcess(const char* model_path) {
   }
   void* name = g_py.Unicode_FromString("create");
   void* path = g_py.Unicode_FromString(model_path);
+  if (!name || !path) {
+    if (g_py.Err_Occurred()) g_py.Err_Print();
+    g_py.Object_DecRef(path);
+    g_py.Object_DecRef(name);
+    g_py.Object_DecRef(mod);
+    SetError("python object allocation failed");
+    g_py.GILState_Release(g);
+    return nullptr;
+  }
   void* res = g_py.Object_CallMethodObjArgs(mod, name, path, nullptr);
   long handle = -1;
   if (res) {

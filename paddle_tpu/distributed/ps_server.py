@@ -119,6 +119,60 @@ class PSServer:
         self._barriers: Dict[bytes, threading.Barrier] = {}
         self._barrier_lock = threading.Lock()
         self._open_conns: set = set()
+        # exactly-once bookkeeping: per-client high-water mark (LRU-bounded)
+        # + in-flight markers so a resend racing the original apply waits
+        from collections import OrderedDict
+
+        self._applied_seq: "OrderedDict[int, int]" = OrderedDict()
+        self._applied_max_clients = 4096
+        self._inflight: set = set()
+        self._applied_lock = threading.Lock()
+        self._applied_cv = threading.Condition(self._applied_lock)
+
+    # -- exactly-once for mutating ops ------------------------------------
+    # `_Conn` retries are at-least-once; push/delta carry a trailing
+    # (client_id, seq) tag — allocated and sent under one client-side lock
+    # hold, so per-client arrival order equals seq order — and the server
+    # dedupes on a per-client high-water mark.  An in-flight marker covers
+    # the resend-races-the-original-apply window: the duplicate WAITS for
+    # the first apply to finish, then reads the updated mark.  Client state
+    # is LRU-bounded (a retry is seconds-scale; eviction after 4096 newer
+    # clients cannot race a live resend).
+    def _begin_apply(self, tag: Sequence[np.ndarray]) -> bool:
+        """True → caller must apply (then _record_applied / _abort_apply);
+        False → duplicate of an already-applied request, just ack."""
+        if not tag:
+            return True  # legacy client without the tag: at-least-once
+        cid, seq = (int(x) for x in tag[0])
+        with self._applied_cv:
+            while (cid, seq) in self._inflight:
+                self._applied_cv.wait()
+            if seq <= self._applied_seq.get(cid, -1):
+                return False
+            self._inflight.add((cid, seq))
+            return True
+
+    def _record_applied(self, tag: Sequence[np.ndarray]) -> None:
+        if not tag:
+            return
+        cid, seq = (int(x) for x in tag[0])
+        with self._applied_cv:
+            self._applied_seq[cid] = max(seq, self._applied_seq.get(cid, -1))
+            self._applied_seq.move_to_end(cid)
+            while len(self._applied_seq) > self._applied_max_clients:
+                self._applied_seq.popitem(last=False)
+            self._inflight.discard((cid, seq))
+            self._applied_cv.notify_all()
+
+    def _abort_apply(self, tag: Sequence[np.ndarray]) -> None:
+        """Apply raised: release the in-flight marker WITHOUT advancing the
+        mark, so a retry of the same seq is attempted, not skipped."""
+        if not tag:
+            return
+        cid, seq = (int(x) for x in tag[0])
+        with self._applied_cv:
+            self._inflight.discard((cid, seq))
+            self._applied_cv.notify_all()
 
     def _get_barrier(self, name: bytes, n: int) -> threading.Barrier:
         with self._barrier_lock:
@@ -173,11 +227,27 @@ class PSServer:
                         rows = self.table.pull(arrays[0])
                         _send_msg(conn, _OP_OK, [rows])
                     elif op == _OP_PUSH:
-                        ids, grads, lr = arrays
-                        self.table.push(ids, grads, float(lr[0]))
+                        ids, grads, lr = arrays[:3]
+                        if not self._begin_apply(arrays[3:]):
+                            _send_msg(conn, _OP_OK, [])
+                            continue
+                        try:
+                            self.table.push(ids, grads, float(lr[0]))
+                        except BaseException:
+                            self._abort_apply(arrays[3:])
+                            raise
+                        self._record_applied(arrays[3:])
                         _send_msg(conn, _OP_OK, [])
                     elif op == _OP_DELTA:
-                        self.table.apply_delta(arrays[0], arrays[1])
+                        if not self._begin_apply(arrays[2:]):
+                            _send_msg(conn, _OP_OK, [])
+                            continue
+                        try:
+                            self.table.apply_delta(arrays[0], arrays[1])
+                        except BaseException:
+                            self._abort_apply(arrays[2:])
+                            raise
+                        self._record_applied(arrays[2:])
                         _send_msg(conn, _OP_OK, [])
                     elif op == _OP_NUM_ROWS:
                         _send_msg(conn, _OP_OK,
@@ -250,21 +320,38 @@ class _Conn:
     """One persistent client connection (lock-serialized request/response)
     with reconnect-and-retry on transport failure (ref the brpc channel's
     retry policy / communicator rescue paths): exponential backoff, then
-    the request is re-sent on a fresh socket.  Requests are at-least-once
-    — pull/num_rows/state are idempotent; a push/delta retried across a
-    failure that landed server-side can double-apply, the same
-    at-least-once contract the reference's resend path has."""
+    the request is re-sent on a fresh socket.  The transport is
+    at-least-once; mutating ops become exactly-once by carrying a
+    (client_id, seq) tag from :meth:`next_tag` that the server dedupes on —
+    a push/delta that landed before the connection dropped is recognized
+    and skipped on resend."""
 
     def __init__(self, endpoint: str, max_retries: int = 5,
                  backoff_s: float = 0.2, timeout_s: float = 120.0):
+        import os
+
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.max_retries = max_retries
         self.backoff_s = backoff_s
         self.timeout_s = timeout_s
         self.lock = threading.Lock()
+        # survives reconnects (unlike per-socket state on the server side)
+        self._client_id = int.from_bytes(os.urandom(8), "little") >> 1
+        self._seq = 0
         self.sock: Optional[socket.socket] = None
         self._connect()
+
+    def next_tag(self) -> np.ndarray:
+        """Fresh (client_id, seq) dedupe tag — one per logical mutating
+        request; retries of that request re-send the SAME tag.  For
+        concurrent callers use ``call(..., mutating=True)`` instead, which
+        allocates the tag under the same lock hold as the send (otherwise
+        a lower seq can arrive after a higher one and be dropped as a
+        replay by the server's high-water mark)."""
+        with self.lock:
+            self._seq += 1
+            return np.asarray([self._client_id, self._seq], np.int64)
 
     def _connect(self):
         self.sock = socket.create_connection(self._addr,
@@ -272,10 +359,17 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def call(self, op: int, arrays: Sequence[np.ndarray],
-             retryable: bool = True):
+             retryable: bool = True, mutating: bool = False):
         import time as _time
 
         with self.lock:
+            if mutating:
+                # allocate seq inside the SAME lock hold as the send:
+                # per-client arrival order then equals seq order, which the
+                # server's high-water dedupe relies on
+                self._seq += 1
+                arrays = list(arrays) + [
+                    np.asarray([self._client_id, self._seq], np.int64)]
             delay = self.backoff_s
             retries = self.max_retries if retryable else 0
             for attempt in range(retries + 1):
@@ -341,7 +435,8 @@ class RemoteSparseTable:
         for s in range(self.n):
             m = srv == s
             if m.any():
-                self._conns[s].call(_OP_PUSH, [ids[m], grads[m], lr_arr])
+                self._conns[s].call(_OP_PUSH, [ids[m], grads[m], lr_arr],
+                                    mutating=True)
 
     def apply_delta(self, ids, delta) -> None:
         ids, srv = self._route(ids)
@@ -349,7 +444,8 @@ class RemoteSparseTable:
         for s in range(self.n):
             m = srv == s
             if m.any():
-                self._conns[s].call(_OP_DELTA, [ids[m], delta[m]])
+                self._conns[s].call(_OP_DELTA, [ids[m], delta[m]],
+                                    mutating=True)
 
     @property
     def num_rows(self) -> int:

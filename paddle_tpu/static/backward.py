@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from .framework import Parameter, Program, Variable, default_main_program
+from .framework import (SUB_BLOCK_ATTRS, Parameter, Program, Variable,
+                        default_main_program)
 
 GRAD_SUFFIX = "@GRAD"
 
@@ -27,9 +28,7 @@ def _effective_io(program, op):
     (closure capture in the Executor's lowering)."""
     ins = set(op.input_names())
     outs = set(op.output_names())
-    blk_attrs = [a for a in ("true_block", "false_block",
-                             "cond_block", "body_block", "rnn_block")
-                 if a in op.attrs]
+    blk_attrs = [a for a in SUB_BLOCK_ATTRS if a in op.attrs]
     for a in blk_attrs:
         blk = program.blocks[op.attrs[a]]
         defined = set()
@@ -55,8 +54,7 @@ def _reject_while_ops(program, loss_names, param_names, api_name: str) -> None:
         if op.type == "while":
             return True
         return any(contains_while(sub)
-                   for a in ("true_block", "false_block",
-                             "cond_block", "body_block", "rnn_block")
+                   for a in SUB_BLOCK_ATTRS
                    if a in op.attrs
                    for sub in program.blocks[op.attrs[a]].ops)
 

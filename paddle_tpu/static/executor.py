@@ -28,7 +28,8 @@ import numpy as np
 from ..core import random as _random
 from . import ops as _ops  # registers lowerings
 from .backward import GRAD_SUFFIX
-from .framework import Program, Variable, default_main_program
+from .framework import (SUB_BLOCK_ATTRS, Program, Variable,
+                        default_main_program)
 from .registry import get_lowering
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
@@ -407,12 +408,35 @@ class Executor:
     def _needs_value(self, program: Program, name: str) -> bool:
         """A persistable var needs a prior value unless some op in this
         program writes it before any read (init ops in startup programs)."""
-        for op in program.global_block().ops:
-            if name in op.output_names():
-                return False
+        return self._first_access(program, program.global_block(), name) == "read"
+
+    def _first_access(self, program: Program, block, name: str):
+        """First access to `name` in execution order: 'read', 'write', or None.
+
+        Walks cond/while/rnn sub-blocks at the point of their control-flow
+        op.  Sub-block READS count — branch/body traces close over a
+        snapshot of the enclosing env (`_lower_cond`/`_lower_while`), so an
+        unset persistable read there fails just like a block-0 read.
+        Sub-block WRITES do not — they mutate the branch-local env copy and
+        escape only through the control-flow op's declared outputs, which
+        the parent-level ``output_names()`` check already covers."""
+        for op in block.ops:
             if name in op.input_names():
-                return True
-        return False
+                return "read"
+            attrs = getattr(op, "attrs", None) or {}
+            for a in SUB_BLOCK_ATTRS:
+                if a in attrs:
+                    sub = self._first_access(
+                        program, program.blocks[attrs[a]], name)
+                    if sub == "read":
+                        return "read"
+                    # sub == 'write': local to that branch trace; a
+                    # write-then-read inside the sub-block was already
+                    # resolved locally (the recursion returned at the
+                    # write), so keep scanning the parent.
+            if name in op.output_names():
+                return "write"
+        return None
 
     def _build(self, program: Program, feed_names, fetch_names, state_names,
                devices=None, feed_arrays=None):

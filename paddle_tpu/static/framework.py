@@ -28,8 +28,16 @@ from ..core import dtype as _dtype_mod
 __all__ = [
     "Variable", "Parameter", "Operator", "Block", "Program",
     "default_main_program", "default_startup_program", "program_guard",
-    "unique_name", "name_scope",
+    "unique_name", "name_scope", "SUB_BLOCK_ATTRS",
 ]
+
+# Every attr name through which a control-flow op references a sub-block
+# (by block index).  Dataflow walkers (backward._effective_io, the
+# Executor's _first_access precondition scan) descend through these; a new
+# block-carrying op MUST add its attr here or those walkers go blind to
+# reads inside its body.
+SUB_BLOCK_ATTRS = ("true_block", "false_block", "cond_block", "body_block",
+                   "rnn_block")
 
 
 class _UniqueNames(threading.local):

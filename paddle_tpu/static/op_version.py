@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["register_op_version", "op_version", "op_version_map",
-           "apply_converters", "OpVersionDesc"]
+           "apply_converters", "check_compatible", "OpVersionDesc"]
 
 
 class OpVersionDesc:

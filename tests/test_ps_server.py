@@ -134,3 +134,48 @@ def test_two_process_geo_sgd_converges(tmp_path):
         assert table.num_rows == 16
     finally:
         srv.stop()
+
+
+def test_push_replay_deduped(server):
+    """round-5: mutating ops are exactly-once.  A push re-sent with the
+    same (client_id, seq) tag — what the retry path does after a transport
+    failure whose request already landed — must NOT double-apply."""
+    from paddle_tpu.distributed.ps_server import _OP_PUSH, _Conn
+
+    conn = _Conn(server.endpoint)
+    ids = np.array([7], np.int64)
+    remote = RemoteSparseTable([server.endpoint], dim=8)
+    before = remote.pull(ids).copy()
+
+    g = np.ones((1, 8), np.float32)
+    lr = np.asarray([0.5], np.float32)
+    tag = conn.next_tag()
+    conn.call(_OP_PUSH, [ids, g, lr, tag])
+    once = remote.pull(ids).copy()
+    assert not np.allclose(once, before)
+
+    # simulate the retry: identical request, identical tag
+    conn.call(_OP_PUSH, [ids, g, lr, tag])
+    np.testing.assert_allclose(remote.pull(ids), once)
+
+    # a FRESH tag applies again
+    conn.call(_OP_PUSH, [ids, g, lr, conn.next_tag()])
+    assert not np.allclose(remote.pull(ids), once)
+    conn.close()
+    remote.close()
+
+
+def test_delta_replay_deduped(server):
+    from paddle_tpu.distributed.ps_server import _OP_DELTA, _Conn
+
+    conn = _Conn(server.endpoint)
+    ids = np.array([3], np.int64)
+    remote = RemoteSparseTable([server.endpoint], dim=8)
+    d = np.full((1, 8), 2.0, np.float32)
+    tag = conn.next_tag()
+    conn.call(_OP_DELTA, [ids, d, tag])
+    once = remote.pull(ids).copy()
+    conn.call(_OP_DELTA, [ids, d, tag])   # replay: no-op
+    np.testing.assert_allclose(remote.pull(ids), once)
+    conn.close()
+    remote.close()

@@ -238,3 +238,54 @@ def test_cond_under_append_backward(_fresh_programs):
     for _ in range(5):
         l1, = exe.run(main, feed={"x": v}, fetch_list=[loss])
     assert float(l1) < float(l0)
+
+
+def test_needs_value_read_only_inside_cond(_fresh_programs):
+    """round-5 fix: Executor._needs_value walks sub-blocks.  A persistable
+    read ONLY inside a cond branch (the branch trace closes over the env
+    snapshot) must trigger the run-startup-first precondition — and must
+    stop triggering once startup has populated it."""
+    from paddle_tpu.core import errors
+
+    main, startup = _fresh_programs
+    x = L.data("x", [2])
+    w = L.create_parameter([2], "float32")
+    pred = less_than(L.reduce_sum(x), L.fill_constant([1], "float32", 0.0))
+    out = cond(pred,
+               lambda: L.elementwise_add(x, w),
+               lambda: L.elementwise_mul(x, w))
+
+    exe = static.Executor()
+    v = np.ones((1, 2), np.float32)
+    with pytest.raises(errors.PreconditionNotMetError, match="startup"):
+        exe.run(main, feed={"x": v}, fetch_list=[out])
+
+    exe.run(startup)
+    r, = exe.run(main, feed={"x": v}, fetch_list=[out])
+    assert r.shape == (1, 2)
+
+
+def test_needs_value_write_inside_cond_is_local(_fresh_programs):
+    """Counterpart: a persistable whose only appearance is a WRITE inside a
+    cond branch escapes only through the cond op's declared outputs
+    (executor._lower_cond traces branches on an env copy), so it needs no
+    prior value and no precondition error may fire."""
+    main, startup = _fresh_programs
+    x = L.data("x", [2])
+    sink = main.current_block().create_var(
+        shape=(1, 2), dtype="float32", persistable=True)
+    pred = less_than(L.reduce_sum(x), L.fill_constant([1], "float32", 0.0))
+
+    def write_branch():
+        y = L.scale(x, scale=2.0)
+        # route the value through the persistable's NAME inside the branch
+        from paddle_tpu.static.layers import _main_block
+        _main_block().append_op("assign", {"X": [y.name]},
+                                {"Out": [sink.name]})
+        return y
+
+    out = cond(pred, write_branch, lambda: L.scale(x, scale=-1.0))
+    exe = static.Executor()
+    v = np.ones((1, 2), np.float32)
+    r, = exe.run(main, feed={"x": v}, fetch_list=[out])  # no startup needed
+    np.testing.assert_allclose(r, v * -1.0)
