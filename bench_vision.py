@@ -65,9 +65,13 @@ def bench_resnet50(on_tpu):
     batch = int(os.environ.get("BENCH_RESNET_BATCH", "256" if on_tpu
                                else "8"))
     size = 224 if on_tpu else 64
+    # NHWC is the TPU-native layout (channels on the 128-lane minor dim;
+    # measured r05 ladder) — overridable for A/B via BENCH_RESNET_LAYOUT
+    layout = os.environ.get("BENCH_RESNET_LAYOUT", "NHWC" if on_tpu
+                            else "NCHW")
     warmup, iters = (3, int(os.environ.get("BENCH_ITERS", "30"))) \
         if on_tpu else (1, 3)
-    model = M.resnet50(num_classes=1000)
+    model = M.resnet50(num_classes=1000, data_format=layout)
     model.train()
     opt = Momentum(learning_rate=0.1, momentum=0.9)
     params = parameters_dict(model)
@@ -87,15 +91,16 @@ def bench_resnet50(on_tpu):
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
     rng = np.random.default_rng(0)
-    images = jnp.asarray(rng.standard_normal((batch, 3, size, size)),
-                         compute_dtype)
+    shape = ((batch, 3, size, size) if layout == "NCHW"
+             else (batch, size, size, 3))
+    images = jnp.asarray(rng.standard_normal(shape), compute_dtype)
     labels = jnp.asarray(rng.integers(0, 1000, (batch, 1)), jnp.int32)
     dt, loss = _bench_loop(step, params, opt_state, (images, labels),
                            warmup, iters,
                            int(os.environ.get("BENCH_SYNC_EVERY", "10")))
     return dict(metric="resnet50_train_throughput", batch=batch,
                 imgs_per_sec=batch * iters / dt, iters=iters, loss=loss,
-                model="resnet50", size=size)
+                model="resnet50", size=size, layout=layout)
 
 
 def bench_yolov3(on_tpu):

@@ -27,6 +27,16 @@ convert_* contract).  Supported subset (documented, checked):
     the `while` form — static bounds keep the plain Python loop (list
     appends etc. still work), traced bounds or a traced break become
     lax.while_loop,
+  * tail transformers (ref assert_transformer.py, cast_transformer.py,
+    print_transformer.py, tensor_shape_transformer.py, convert_len):
+    `assert` dispatches to a host check when the predicate is traced;
+    `int(x)`/`float(x)`/`bool(x)` on traced tensors become astype;
+    `print(tensor)` becomes jax.debug.print under trace; `len(tensor)`
+    and `x.shape[i]` are STATIC under XLA, so the reference's
+    dynamic-shape plumbing collapses to python ints (python lists with
+    static-bound loops keep working through the plain-loop path for the
+    same reason — the reference's LoDTensorArray conversion is only
+    needed when shapes are dynamic),
   * no `return`/`yield` inside converted bodies; no list append inside a
     loop that actually lowers to lax.while_loop (a lax carry cannot grow
     — use a preallocated buffer + indexed writes, the dense analogue of
@@ -48,7 +58,9 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ast_transform", "convert_ifelse", "convert_while", "Unsupported"]
+__all__ = ["ast_transform", "convert_ifelse", "convert_while",
+           "convert_assert", "convert_cast", "convert_print", "convert_len",
+           "Unsupported"]
 
 
 class Unsupported(Exception):
@@ -117,6 +129,82 @@ def convert_while(cond_fn: Callable, body_fn: Callable, carry: Tuple) -> Tuple:
             return carry
         carry = tuple(body_fn(*carry))
         probe = cond_fn(*carry)
+
+
+def convert_assert(pred, msg_fn=None):
+    """ref dygraph_to_static/assert_transformer.py -> layers.Assert: a
+    traced predicate checks host-side via ordered io_callback (needs PJRT
+    host callbacks — CPU/real-TPU runtimes, not the axon dev tunnel);
+    concrete values keep plain `assert` semantics.  ``msg_fn`` is a THUNK:
+    python evaluates an assert's message only on failure, so the AST
+    rewrite wraps it in a lambda and it is called here only when the
+    check actually fails."""
+    def _msg():
+        return msg_fn() if msg_fn is not None else "converted assert failed"
+
+    if isinstance(pred, jax.core.Tracer):
+        import numpy as np
+        from jax.experimental import io_callback
+
+        def host_check(p):
+            # ALL elements must hold (the reference Assert op contract;
+            # eager python would refuse a multi-element truth test)
+            if not bool(np.asarray(p).all()):
+                raise AssertionError(_msg())
+            return np.zeros((), np.int32)
+
+        io_callback(host_check, jax.ShapeDtypeStruct((), jnp.int32),
+                    pred, ordered=True)
+        return
+    if not pred:
+        raise AssertionError(_msg())
+
+
+_CAST_DTYPES = {"int": jnp.int32, "float": jnp.float32, "bool": jnp.bool_}
+
+
+def convert_cast(value, ty: str):
+    """ref cast_transformer.py: int(x)/float(x)/bool(x) on a TRACED tensor
+    become astype (int32/float32/bool — x64 is off on TPU); concrete
+    values keep the builtin conversion."""
+    if isinstance(value, jax.core.Tracer):
+        return value.astype(_CAST_DTYPES[ty])
+    return {"int": int, "float": float, "bool": bool}[ty](value)
+
+
+def convert_print(*args, **kwargs):
+    """ref print_transformer.py -> Print op: traced args print host-side
+    via ordered io_callback with FULL builtin-print semantics (sep/end/
+    file honored — jax.debug.print would drop them); same runtime caveat
+    as convert_assert.  Concrete values use builtin print directly."""
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        import numpy as np
+        from jax.experimental import io_callback
+
+        arr_idx = [i for i, a in enumerate(args)
+                   if isinstance(a, (jax.core.Tracer, jax.Array))]
+        static_args = list(args)
+
+        def host_print(*arrs):
+            merged = list(static_args)
+            for i, a in zip(arr_idx, arrs):
+                merged[i] = np.asarray(a)
+            print(*merged, **kwargs)
+            return np.zeros((), np.int32)
+
+        io_callback(host_print, jax.ShapeDtypeStruct((), jnp.int32),
+                    *[args[i] for i in arr_idx], ordered=True)
+        return
+    print(*args, **kwargs)
+
+
+def convert_len(x):
+    """ref convert_operators.py convert_len + tensor_shape_transformer:
+    len(tensor) is the leading dim — STATIC under XLA, so the reference's
+    dynamic-shape plumbing collapses to a python int."""
+    if isinstance(x, (jax.core.Tracer, jax.Array)):
+        return x.shape[0]
+    return len(x)
 
 
 def _and_not(test, brk):
@@ -281,6 +369,39 @@ class _Transformer(ast.NodeTransformer):
     def _fresh(self, kind):
         self.counter += 1
         return f"__pdtpu_{kind}_{self.counter}"
+
+    # -- tail transformers: assert / cast / print / len ----------------------
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        # the message becomes a thunk: python evaluates it only on failure
+        msg = (ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=node.msg) if node.msg is not None
+            else ast.Constant(value=None))
+        return ast.Expr(value=ast.Call(
+            func=_name("__pdtpu_convert_assert"),
+            args=[node.test, msg], keywords=[]))
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if not isinstance(node.func, ast.Name):
+            return node
+        fid = node.func.id
+        if fid in ("int", "float", "bool") and len(node.args) == 1 \
+                and not node.keywords:
+            return ast.Call(func=_name("__pdtpu_convert_cast"),
+                            args=[node.args[0], ast.Constant(value=fid)],
+                            keywords=[])
+        if fid == "len" and len(node.args) == 1 and not node.keywords:
+            return ast.Call(func=_name("__pdtpu_convert_len"),
+                            args=list(node.args), keywords=[])
+        if fid == "print":
+            return ast.Call(func=_name("__pdtpu_convert_print"),
+                            args=list(node.args),
+                            keywords=list(node.keywords))
+        return node
 
     # -- if ------------------------------------------------------------------
     def visit_If(self, node: ast.If):
@@ -495,7 +616,9 @@ def ast_transform(fn: Callable) -> Callable:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise Unsupported("not a plain function definition")
-    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+    if not any(isinstance(n, (ast.If, ast.While, ast.For, ast.Assert))
+               or (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                   and n.func.id in ("print", "int", "float", "bool"))
                for n in ast.walk(fdef)):
         raise Unsupported("nothing to convert")
     fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
@@ -510,6 +633,10 @@ def ast_transform(fn: Callable) -> Callable:
     glb["__pdtpu_and_not"] = _and_not
     glb["__pdtpu_not_skipping"] = _not_skipping
     glb["__pdtpu_range_cond"] = _range_cond
+    glb["__pdtpu_convert_assert"] = convert_assert
+    glb["__pdtpu_convert_cast"] = convert_cast
+    glb["__pdtpu_convert_print"] = convert_print
+    glb["__pdtpu_convert_len"] = convert_len
     loc: dict = {}
     exec(code, glb, loc)
     out = loc[fdef.name]

@@ -30,9 +30,24 @@ def _padding(padding, spatial_dims):
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
-    """x: (N, C, H, W); weight: (out_c, in_c/groups, kh, kw) — ref layouts."""
+    """x: (N, C, H, W) or (N, H, W, C); weight: (out_c, in_c/groups, kh, kw)
+    — ref layouts.  NHWC is a NATIVE path (dimension_numbers carry the
+    layout straight into XLA, no transposes): channels-last keeps C on the
+    128-lane minor dimension the TPU vector units and MXU feeds want, so
+    the compiler stops materializing layout conversions around every conv
+    (the r05 ResNet ladder's first rung)."""
     if data_format == "NHWC":
-        x = jnp.transpose(x, (0, 3, 1, 2))
+        out = lax.conv_general_dilated(
+            x, weight,
+            window_strides=_pair(stride),
+            padding=_padding(padding, 2),
+            rhs_dilation=_pair(dilation),
+            feature_group_count=groups,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+        )
+        if bias is not None:
+            out = out + bias.reshape(1, 1, 1, -1)
+        return out
     out = lax.conv_general_dilated(
         x, weight,
         window_strides=_pair(stride),
@@ -43,8 +58,6 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     )
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
-    if data_format == "NHWC":
-        out = jnp.transpose(out, (0, 2, 3, 1))
     return out
 
 

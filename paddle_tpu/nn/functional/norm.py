@@ -19,19 +19,33 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(range(x.ndim - 1))
         shape = [1] * (x.ndim - 1) + [-1]
     if training:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        # ONE-PASS stats (E[x^2] - E[x]^2, fp32 accumulation) instead of
+        # jnp.var's two-pass mean-then-centered form: both reductions read
+        # x once and fuse into the producing conv's output on TPU — the
+        # two-pass form forces an extra full HBM pass over the activation
+        # per BN (r05 ResNet ladder, BASELINE.md)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+        mean = mean.astype(running_mean.dtype)
+        var = var.astype(running_var.dtype)
         new_rm = momentum * running_mean + (1 - momentum) * mean
         new_rv = momentum * running_var + (1 - momentum) * var
     else:
         mean, var = running_mean, running_var
         new_rm, new_rv = running_mean, running_var
-    inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(var + epsilon)
-    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    # fold scale/shift into per-channel a, b in fp32, then ONE fused
+    # elementwise apply in x's dtype (a*x + b): XLA input-fuses this into
+    # the consuming conv, so the normalize costs no extra HBM pass
+    inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + epsilon)
+    a = inv
     if weight is not None:
-        out = out * weight.reshape(shape)
+        a = a * weight.astype(jnp.float32)
+    b = -mean.astype(jnp.float32) * a
     if bias is not None:
-        out = out + bias.reshape(shape)
+        b = b + bias.astype(jnp.float32)
+    out = x * a.astype(x.dtype).reshape(shape) \
+        + b.astype(x.dtype).reshape(shape)
     return out, new_rm, new_rv
 
 

@@ -11,38 +11,48 @@ def _pair(v, n=2):
     return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
 
 
-def _pool2d(x, kernel, stride, padding, init, op, norm=None):
+def _pool2d(x, kernel, stride, padding, init, op, norm=None,
+            data_format="NCHW"):
     kernel = _pair(kernel)
     stride = _pair(stride if stride is not None else kernel)
     pads = _pair(padding)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding_cfg = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    if data_format == "NHWC":
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding_cfg = [(0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding_cfg = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
     out = lax.reduce_window(x, init, op, window, strides, padding_cfg)
     if norm is not None:
         out = norm(out, kernel, stride, pads, x.shape)
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False):
-    out = _pool2d(x, kernel_size, stride, padding, -jnp.inf, lax.max)
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               data_format="NCHW"):
+    out = _pool2d(x, kernel_size, stride, padding, -jnp.inf, lax.max,
+                  data_format=data_format)
     if return_mask:
         # index mask (ref: max_pool2d_with_index) computed via broadcast compare
         raise NotImplementedError("return_mask is not supported yet")
     return out
 
 
-def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               data_format="NCHW"):
     kernel = _pair(kernel_size)
     if padding == 0 or not exclusive:
-        out = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
-        if padding != 0 and not exclusive:
-            return out / float(np.prod(kernel))
+        out = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add,
+                      data_format=data_format)
         return out / float(np.prod(kernel))
     # exclusive: divide by actual window size (count non-pad elements)
-    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
+    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add,
+                data_format=data_format)
     ones = jnp.ones_like(x)
-    cnt = _pool2d(ones, kernel_size, stride, padding, 0.0, lax.add)
+    cnt = _pool2d(ones, kernel_size, stride, padding, 0.0, lax.add,
+                  data_format=data_format)
     return s / cnt
 
 
@@ -60,33 +70,42 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True):
     return out[..., 0]
 
 
-def adaptive_avg_pool2d(x, output_size):
+def _adaptive_pool2d(x, output_size, reduce_fn, data_format):
+    """Divisible dims: one reshape+reduce.  General case: per-output-bin
+    slices (reference AdaptivePool bin edges (i*h)//oh .. ceil((i+1)h/oh)),
+    axes parameterized by layout."""
     oh, ow = _pair(output_size)
-    n, c, h, w = x.shape
-    if h % oh == 0 and w % ow == 0:
-        return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    # general case: average over per-output-bin slices
-    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :] for i in range(oh)]
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        if h % oh == 0 and w % ow == 0:
+            return reduce_fn(x.reshape(n, oh, h // oh, ow, w // ow, c),
+                             (2, 4))
+        ha, wa = 1, 2
+    else:
+        n, c, h, w = x.shape
+        if h % oh == 0 and w % ow == 0:
+            return reduce_fn(x.reshape(n, c, oh, h // oh, ow, w // ow),
+                             (3, 5))
+        ha, wa = 2, 3
+    # each bin reduces to (n, c); spatial axes re-enter at `ha` so the
+    # result is (n, c, oh, ow) for NCHW and (n, oh, ow, c) for NHWC
+    rows = [lax.slice_in_dim(x, (i * h) // oh, -(-((i + 1) * h) // oh),
+                             axis=ha) for i in range(oh)]
     out_rows = []
     for r in rows:
-        cols = [jnp.mean(r[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)],
-                         axis=(2, 3)) for j in range(ow)]
-        out_rows.append(jnp.stack(cols, axis=-1))
-    return jnp.stack(out_rows, axis=-2)
+        cols = [reduce_fn(
+            lax.slice_in_dim(r, (j * w) // ow, -(-((j + 1) * w) // ow),
+                             axis=wa), (ha, wa)) for j in range(ow)]
+        out_rows.append(jnp.stack(cols, axis=ha))
+    return jnp.stack(out_rows, axis=ha)
 
 
-def adaptive_max_pool2d(x, output_size):
-    oh, ow = _pair(output_size)
-    n, c, h, w = x.shape
-    if h % oh == 0 and w % ow == 0:
-        return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :] for i in range(oh)]
-    out_rows = []
-    for r in rows:
-        cols = [jnp.max(r[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)],
-                        axis=(2, 3)) for j in range(ow)]
-        out_rows.append(jnp.stack(cols, axis=-1))
-    return jnp.stack(out_rows, axis=-2)
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, jnp.mean, data_format)
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, jnp.max, data_format)
 
 
 def _pool3d(x, kernel, stride, padding, init, op):

@@ -6,27 +6,33 @@ from .base import Layer
 
 
 class MaxPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW"):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 data_format="NCHW"):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.exclusive = exclusive
+        self.data_format = data_format
 
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            exclusive=self.exclusive)
+                            exclusive=self.exclusive,
+                            data_format=self.data_format)
 
 
 class MaxPool1D(Layer):
@@ -52,18 +58,22 @@ class AvgPool1D(Layer):
 
 
 class AdaptiveAvgPool2D(Layer):
-    def __init__(self, output_size):
+    def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):
-    def __init__(self, output_size):
+    def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     data_format=self.data_format)

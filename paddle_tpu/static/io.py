@@ -172,10 +172,17 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars: Sequence[Variable], executor: Executor,
                          main_program: Optional[Program] = None,
                          scope: Optional[Scope] = None,
-                         cipher=None):
+                         cipher=None, model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
     """ref fluid/io.py:1164.  ``cipher`` (utils.crypto.Cipher) encrypts the
     saved parameter file like the reference's encrypted inference models
-    (framework/io/crypto/): params.npz becomes params.npz.enc."""
+    (framework/io/crypto/): params.npz becomes params.npz.enc.
+
+    ``model_filename`` selects the REFERENCE BINARY format: the program is
+    written as a `framework.proto` ProgramDesc (conventionally
+    ``model_filename="__model__"``) and parameters as LoDTensor files —
+    one per var, or combined into ``params_filename`` — loadable by the
+    reference's `load_inference_model` (static/proto_format.py)."""
     from .framework import default_main_program
     program = main_program or default_main_program()
     scope = scope or global_scope()
@@ -183,10 +190,35 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                    for v in target_vars]
     pruned = _prune_for_inference(program, list(feeded_var_names), fetch_names)
     os.makedirs(dirname, exist_ok=True)
+    if model_filename is not None:
+        from . import proto_format as PF
+
+        if cipher is not None:
+            raise ValueError("cipher is a feature of the native json+npz "
+                             "format; the reference wire format has no "
+                             "encryption envelope")
+        desc = PF.program_to_desc(pruned, list(feeded_var_names),
+                                  fetch_names)
+        with open(os.path.join(dirname, model_filename), "wb") as f:
+            f.write(PF.encode_program_desc(desc))
+        PF.save_reference_params(
+            dirname, _persistable_values(pruned, scope), params_filename)
+        # a stale native-format program would win load auto-detection
+        for stale in ("program.json", "params.npz", "params.npz.enc"):
+            sp = os.path.join(dirname, stale)
+            if os.path.exists(sp):
+                os.remove(sp)
+        return fetch_names
     with open(os.path.join(dirname, "program.json"), "w") as f:
         json.dump({"program": _program_to_json(pruned),
                    "feeds": list(feeded_var_names),
                    "fetches": fetch_names}, f, indent=1)
+    # mirror of the reference-format branch: a stale __model__ would win
+    # the reference-API load spelling (model_filename="__model__")
+    for stale in ("__model__", "__params__"):
+        sp = os.path.join(dirname, stale)
+        if os.path.exists(sp):
+            os.remove(sp)
     plain = os.path.join(dirname, "params.npz")
     enc = plain + ".enc"
     if cipher is None:
@@ -206,11 +238,41 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
 
 def load_inference_model(dirname: str, executor: Executor,
                          scope: Optional[Scope] = None,
-                         cipher=None) -> Tuple[Program, List[str], List[str]]:
+                         cipher=None, model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None
+                         ) -> Tuple[Program, List[str], List[str]]:
     """ref fluid/io.py:1374 — returns (program, feed_names, fetch_names).
-    Pass the ``cipher`` used at save time to read encrypted params."""
+    Pass the ``cipher`` used at save time to read encrypted params.
+
+    Accepts BOTH formats: the native `program.json` + `params.npz`, and
+    the reference's binary `__model__` ProgramDesc + LoDTensor parameter
+    files (auto-detected; or name them via ``model_filename`` /
+    ``params_filename`` exactly like the reference API) — so a model
+    exported by the reference's `save_inference_model` serves here
+    unchanged (static/proto_format.py)."""
     scope = scope or global_scope()
-    with open(os.path.join(dirname, "program.json")) as f:
+    json_path = os.path.join(dirname, "program.json")
+    if model_filename is None and not os.path.exists(json_path) \
+            and os.path.exists(os.path.join(dirname, "__model__")):
+        model_filename = "__model__"
+    if model_filename is not None:
+        from .framework import Parameter
+        from . import proto_format as PF
+
+        if cipher is not None:
+            raise ValueError("cipher is a feature of the native json+npz "
+                             "format; the reference wire format has no "
+                             "encryption envelope")
+        with open(os.path.join(dirname, model_filename), "rb") as f:
+            desc = PF.parse_program_desc(f.read())
+        program, feeds, fetches = PF.program_from_desc(desc)
+        names = [v.name for v in program.list_vars()
+                 if v.persistable or isinstance(v, Parameter)]
+        for name, arr in PF.load_reference_params(
+                dirname, names, params_filename).items():
+            scope.set(name, arr)
+        return program, feeds, fetches
+    with open(json_path) as f:
         d = json.load(f)
     program = _program_from_json(d["program"])
     enc = os.path.join(dirname, "params.npz.enc")

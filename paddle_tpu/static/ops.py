@@ -1056,3 +1056,5 @@ def _nce(ins, attrs, op):
 from . import ops_tail  # noqa: E402,F401 — long-tail lowerings (registry side effects)
 from . import ops_tail2  # noqa: E402,F401 — batch-2 lowerings (registry side effects)
 from . import ops_tail3  # noqa: E402,F401 — batch-3 lowerings (registry side effects)
+from . import ops_tail4  # noqa: E402,F401 — batch-4 lowerings (registry side effects)
+from . import ops_tail5  # noqa: E402,F401 — batch-5 lowerings (registry side effects)

@@ -1,8 +1,13 @@
 """ResNet family (ref: python/paddle/vision/models/resnet.py:168 — the
 BASELINE.json config-2 flagship, "PaddleClas ResNet-50").
 
-TPU notes: NCHW layout like the reference (XLA canonicalizes layout for the
-MXU); BasicBlock for 18/34, BottleneckBlock for 50/101/152.
+TPU notes: layout is selectable.  ``data_format="NCHW"`` matches the
+reference default; ``"NHWC"`` runs every conv/BN/pool channels-last —
+the TPU-native layout (C rides the 128-lane minor dim, XLA stops
+materializing layout conversions around each conv; the r05 vision-perf
+ladder measured this as the dominant single-chip win).  Parameters keep
+the reference OIHW layout either way, so checkpoints are
+layout-portable.  BasicBlock for 18/34, BottleneckBlock for 50/101/152.
 """
 from __future__ import annotations
 
@@ -12,14 +17,16 @@ from ... import nn
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(planes, data_format=data_format)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
+        self.bn2 = nn.BatchNorm2D(planes, data_format=data_format)
         self.downsample = downsample
 
     def forward(self, x):
@@ -34,16 +41,19 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False,
+                               data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(planes, data_format=data_format)
         self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
+                               bias_attr=False, data_format=data_format)
+        self.bn2 = nn.BatchNorm2D(planes, data_format=data_format)
         self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = nn.BatchNorm2D(planes * self.expansion)
+                               bias_attr=False, data_format=data_format)
+        self.bn3 = nn.BatchNorm2D(planes * self.expansion,
+                                  data_format=data_format)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -67,23 +77,26 @@ class ResNet(nn.Layer):
             152: (BottleneckBlock, (3, 8, 36, 3))}
 
     def __init__(self, depth=50, num_classes=1000, with_pool=True,
-                 in_channels=3):
+                 in_channels=3, data_format="NCHW"):
         super().__init__()
         block, layers = self._cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.inplanes = 64
+        self.data_format = data_format
         self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(64)
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(64, data_format=data_format)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -92,12 +105,16 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
+                nn.BatchNorm2D(planes * block.expansion,
+                               data_format=self.data_format))
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):

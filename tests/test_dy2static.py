@@ -300,3 +300,166 @@ def test_nested_for_with_return_falls_back():
 
     with pytest.raises(Unsupported, match="return"):
         ast_transform(f)
+
+
+# -- r05 tail transformers (ref dygraph_to_static/{assert,cast,print,
+#    tensor_shape}_transformer.py + test_list.py style programs) -----------
+
+def test_convert_assert_eager_and_traced():
+    """ref test_assert.py: assert over a tensor predicate."""
+    @to_static
+    def f(x):
+        assert jnp.sum(x) > 0, "sum must be positive"
+        return x * 2.0
+
+    assert f._converted
+    # StaticFunction jits every call, so the predicate is traced and the
+    # host check surfaces wrapped in jax's callback error
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0 * np.ones(3))
+    with pytest.raises(Exception, match="sum must be positive"):
+        jax.block_until_ready(f(-jnp.ones(3)))
+    # plain python call of the converted source: clean AssertionError
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    plain = ast_transform(f._orig_fn)
+    with pytest.raises(AssertionError, match="sum must be positive"):
+        plain(jnp.asarray(-1.0))
+
+
+def test_convert_cast():
+    """ref test_cast.py: int()/float()/bool() over tensors inside a
+    converted function keep working under trace as astype."""
+    @to_static
+    def f(x):
+        if jnp.sum(x) > 0:          # force conversion machinery on
+            y = float(x)
+        else:
+            y = float(-x)
+        z = int(jnp.abs(x) * 3.7)
+        return y, z, bool(jnp.max(jnp.abs(x)) > 0)
+
+    assert f._converted
+    # StaticFunction jits the call: casts become astype under trace
+    y, z, b = f(jnp.asarray(2.0))
+    assert y.dtype == jnp.float32 and float(y) == 2.0
+    assert z.dtype == jnp.int32 and int(z) == 7
+    assert b.dtype == jnp.bool_ and bool(b)
+    # plain python call of the converted source: top-level casts keep
+    # builtin semantics (y flows through lax.cond, so it stays an array)
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    plain = ast_transform(f._orig_fn)
+    y2, z2, b2 = plain(jnp.asarray(2.0))
+    assert float(y2) == 2.0
+    assert isinstance(z2, int) and z2 == 7 and b2 is True
+
+
+def test_convert_print(capsys):
+    """ref test_print.py: print(tensor) converts (Print op semantics =
+    debug print under trace, builtin print eagerly)."""
+    @to_static
+    def f(x):
+        print("value:", x)
+        return x + 1.0
+
+    assert f._converted
+    out = f(jnp.asarray(1.5))
+    assert float(out) == 2.5
+    captured = capsys.readouterr()
+    assert "value:" in captured.out
+
+    g = jax.jit(lambda x: f._fn(x))
+    jax.block_until_ready(g(jnp.asarray(1.5)))
+    jax.effects_barrier()
+    captured = capsys.readouterr()
+    assert "1.5" in captured.out
+
+
+def test_tensor_shape_in_converted_loop():
+    """ref test_tensor_shape.py: x.shape / len(x) drive loop bounds and
+    zeros() shapes — static under XLA, identical numerics to dygraph."""
+    @to_static
+    def f(x):
+        acc = jnp.zeros(x.shape[1:])
+        for i in range(len(x)):
+            acc = acc + x[i] * float(i + 1)
+        return acc
+
+    assert f._converted
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    expect = sum(x[i] * (i + 1) for i in range(3))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))), expect)
+    g = jax.jit(lambda x: f._fn(x))
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray(x))), expect)
+
+
+def test_list_programs_static_bounds():
+    """ref test_list.py: python list append/pop inside static-bound loops
+    and python conditions — the plain-loop path keeps list semantics."""
+    @to_static
+    def f(x):
+        outs = []
+        for i in range(len(x)):        # static bound
+            outs.append(x[i] * 2.0)
+        if x.shape[0] > 2:              # STATIC (python) predicate
+            outs.append(jnp.sum(x, keepdims=True)[0] * 0.0)
+            outs.pop()
+        return jnp.stack(outs)
+
+    assert f._converted
+    x = np.arange(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))), x * 2.0)
+    short = np.arange(2, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(short))),
+                               short * 2.0)
+    g = jax.jit(lambda x: f._fn(x))
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray(x))), x * 2.0)
+
+
+def test_assert_message_lazily_evaluated():
+    """Python evaluates an assert's message only on failure; the converted
+    form must too (the message is rewritten into a thunk)."""
+    @to_static
+    def f(x):
+        err = None
+        assert x.shape[0] > 0, err.nonexistent_attribute  # noqa: B011
+        if jnp.sum(x) > 0:   # keep the function inside the subset
+            y = x
+        else:
+            y = -x
+        return y
+
+    assert f._converted
+    # passing assert: message never evaluated, no AttributeError
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(2))), np.ones(2))
+
+
+def test_convert_assert_checks_all_elements():
+    """A vector predicate must fail if ANY element is false (the Assert
+    op's full-tensor contract)."""
+    @to_static
+    def f(x):
+        assert x > 0
+        if jnp.sum(x) > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    assert f._converted
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), np.ones(3))
+    with pytest.raises(Exception, match="assert"):
+        jax.block_until_ready(f(jnp.asarray([1.0, -5.0, 2.0])))
+
+
+def test_convert_print_honors_kwargs(capsys):
+    @to_static
+    def f(x):
+        print("a", x, sep="|", end="<END>\n")
+        return x * 1.0
+
+    assert f._converted
+    jax.block_until_ready(f(jnp.asarray(3.0)))
+    jax.effects_barrier()
+    out = capsys.readouterr().out
+    assert "a|" in out and "<END>" in out

@@ -1,0 +1,369 @@
+"""Batch-4 static ops: the audited registry stragglers (unique family,
+where_index, hash, sequence_enumerate/erase, proximal optimizers,
+positive_negative_pair, DGC op family, root collectives).  Numeric oracles
+mirror the reference kernels (see static/ops_tail4.py per-op docstrings)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from tests.op_test_base import OpTest
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(44)
+
+
+# -- unique family ------------------------------------------------------------
+
+def test_unique_first_appearance_order():
+    x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+    out, idx, valid = _run_single_op(
+        "unique", {"X": x}, out_slots=("Out", "Index", "ValidCount"))
+    assert int(valid) == 4
+    np.testing.assert_array_equal(out[:4], [2, 3, 1, 5])   # reference order
+    np.testing.assert_array_equal(out[4:], 0)              # pad contract
+    np.testing.assert_array_equal(idx, [0, 1, 1, 2, 3, 1])
+
+
+def test_unique_with_counts_matches_reference_walk():
+    x = np.array([1, 1, 2, 4, 4, 4, 7, 1], np.int64)
+    out, idx, cnt, valid = _run_single_op(
+        "unique_with_counts", {"X": x},
+        out_slots=("Out", "Index", "Count", "ValidCount"))
+    k = int(valid)
+    assert k == 4
+    np.testing.assert_array_equal(out[:k], [1, 2, 4, 7])
+    np.testing.assert_array_equal(cnt[:k], [3, 1, 3, 1])
+    # Index reconstructs X through Out (the reference's inverse contract)
+    np.testing.assert_array_equal(out[idx], x)
+
+
+def test_where_index_coordinates():
+    x = np.array([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]], np.float32)
+    out, valid = _run_single_op("where_index", {"Condition": x},
+                                out_slots=("Out", "ValidCount"))
+    k = int(valid)
+    assert k == 3
+    np.testing.assert_array_equal(out[:k], [[0, 1], [1, 0], [1, 2]])
+    np.testing.assert_array_equal(out[k:], 0)
+
+
+# -- hash ---------------------------------------------------------------------
+
+def test_hash_deterministic_seeded_and_bounded():
+    x = RNG.integers(0, 1000, (6, 3)).astype(np.int64)
+    mod_by = 10007
+    out1, = _run_single_op("hash", {"X": x},
+                           {"num_hash": 4, "mod_by": mod_by})
+    out2, = _run_single_op("hash", {"X": x},
+                           {"num_hash": 4, "mod_by": mod_by})
+    assert out1.shape == (6, 4, 1)
+    np.testing.assert_array_equal(out1, out2)          # deterministic
+    assert (out1 >= 0).all() and (out1 < mod_by).all()
+    # different seeds produce different hash streams
+    assert not np.array_equal(out1[:, 0], out1[:, 1])
+    # row content governs the value: equal rows hash equal, others differ
+    x2 = x.copy()
+    x2[0] = x2[1]
+    out3, = _run_single_op("hash", {"X": x2}, {"num_hash": 4,
+                                               "mod_by": mod_by})
+    np.testing.assert_array_equal(out3[0], out3[1])
+    np.testing.assert_array_equal(out3[2:], out1[2:])
+
+
+# -- sequence_enumerate / sequence_erase -------------------------------------
+
+def test_sequence_enumerate_matches_reference_windows():
+    # reference oracle: out[t] = x[t:t+win] padded past the sequence end
+    x = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], np.int64)
+    lengths = np.array([4, 2], np.int64)
+    win, pad = 3, -1
+    out, = _run_single_op("sequence_enumerate",
+                          {"X": x, "Length": lengths},
+                          {"win_size": win, "pad_value": pad})
+    expect = np.full((2, 5, win), pad, np.int64)
+    for b, L in enumerate(lengths):
+        for t in range(L):
+            for k in range(win):
+                expect[b, t, k] = x[b, t + k] if t + k < L else pad
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_sequence_erase_compacts_and_reports_lengths():
+    x = np.array([[2, 8, 2, 1, 3], [9, 2, 9, 0, 0]], np.int64)
+    lengths = np.array([5, 3], np.int64)
+    out, new_len = _run_single_op(
+        "sequence_erase", {"X": x, "Length": lengths},
+        {"tokens": [2, 9]}, out_slots=("Out", "Length"))
+    np.testing.assert_array_equal(new_len, [3, 0])
+    np.testing.assert_array_equal(out[0], [8, 1, 3, 0, 0])
+    np.testing.assert_array_equal(out[1], 0)
+
+
+# -- proximal optimizers ------------------------------------------------------
+
+def _prox_oracle(prox_param, lr, l1, l2):
+    if l1 > 0:
+        return (np.sign(prox_param)
+                * np.maximum(np.abs(prox_param) - lr * l1, 0) / (1 + lr * l2))
+    return prox_param / (1 + lr * l2)
+
+
+@pytest.mark.parametrize("l1,l2", [(0.0, 0.0), (0.1, 0.05)])
+def test_proximal_adagrad(l1, l2):
+    p = RNG.normal(0, 1, (7,)).astype(np.float32)
+    g = RNG.normal(0, 1, (7,)).astype(np.float32)
+    m = np.abs(RNG.normal(0, 1, (7,))).astype(np.float32)
+    lr = np.array([0.05], np.float32)
+    p_out, m_out = _run_single_op(
+        "proximal_adagrad",
+        {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+        {"l1": l1, "l2": l2}, out_slots=("ParamOut", "MomentOut"))
+    m_ref = m + g * g
+    p_ref = _prox_oracle(p - lr * g / np.sqrt(m_ref), lr[0], l1, l2)
+    np.testing.assert_allclose(m_out, m_ref, rtol=1e-5)
+    np.testing.assert_allclose(p_out, p_ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("l1,l2", [(0.0, 0.1), (0.2, 0.0)])
+def test_proximal_gd(l1, l2):
+    p = RNG.normal(0, 1, (5,)).astype(np.float32)
+    g = RNG.normal(0, 1, (5,)).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    p_out, = _run_single_op(
+        "proximal_gd", {"Param": p, "Grad": g, "LearningRate": lr},
+        {"l1": l1, "l2": l2}, out_slots=("ParamOut",))
+    p_ref = _prox_oracle(p - lr * g, lr[0], l1, l2)
+    np.testing.assert_allclose(p_out, p_ref, rtol=1e-5, atol=1e-6)
+
+
+# -- positive_negative_pair ---------------------------------------------------
+
+def _pnp_oracle(score, label, query, weight, column):
+    """Direct transcription of the reference's per-query double loop."""
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for i in range(score.shape[0]):
+        groups[int(query[i])].append(
+            (score[i, column], label[i, 0],
+             weight[i, 0] if weight is not None else 1.0))
+    pos = neg = neu = 0.0
+    for vec in groups.values():
+        for a in range(len(vec)):
+            for b in range(a + 1, len(vec)):
+                s1, l1, w1 = vec[a]
+                s2, l2, w2 = vec[b]
+                if l1 == l2:
+                    continue
+                w = (w1 + w2) * 0.5
+                if s1 == s2:
+                    neu += w
+                if (s1 - s2) * (l1 - l2) > 0:
+                    pos += w
+                else:
+                    neg += w
+    return pos, neg, neu
+
+
+def test_positive_negative_pair_matches_reference_loop():
+    B, W = 12, 3
+    score = RNG.normal(0, 1, (B, W)).astype(np.float32)
+    score[3, 1] = score[5, 1]          # force a tie inside a query group
+    label = RNG.integers(0, 3, (B, 1)).astype(np.float32)
+    query = np.array([0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2, 2], np.int64)[:, None]
+    weight = np.abs(RNG.normal(1, 0.2, (B, 1))).astype(np.float32)
+    query[5] = query[3]
+    pos, neg, neu = _run_single_op(
+        "positive_negative_pair",
+        {"Score": score, "Label": label, "QueryID": query, "Weight": weight},
+        {"column": 1},
+        out_slots=("PositivePair", "NegativePair", "NeutralPair"))
+    epos, eneg, eneu = _pnp_oracle(score, label, query, weight, 1)
+    np.testing.assert_allclose(float(pos), epos, rtol=1e-5)
+    np.testing.assert_allclose(float(neg), eneg, rtol=1e-5)
+    np.testing.assert_allclose(float(neu), eneu, rtol=1e-5)
+
+
+def test_positive_negative_pair_accumulates():
+    score = np.array([[0.9], [0.1]], np.float32)
+    label = np.array([[1.0], [0.0]], np.float32)
+    query = np.zeros((2, 1), np.int64)
+    pos, neg, neu = _run_single_op(
+        "positive_negative_pair",
+        {"Score": score, "Label": label, "QueryID": query,
+         "AccumulatePositivePair": np.array([10.0], np.float32),
+         "AccumulateNegativePair": np.array([20.0], np.float32),
+         "AccumulateNeutralPair": np.array([30.0], np.float32)},
+        {"column": 0},
+        out_slots=("PositivePair", "NegativePair", "NeutralPair"))
+    assert float(pos) == pytest.approx(11.0)
+    assert float(neg) == pytest.approx(20.0)
+    assert float(neu) == pytest.approx(30.0)
+
+
+# -- DGC family ---------------------------------------------------------------
+
+def test_dgc_momentum_correction_and_sparsify():
+    n = 64
+    u = RNG.normal(0, 1, (n,)).astype(np.float32)
+    v = RNG.normal(0, 1, (n,)).astype(np.float32)
+    g = RNG.normal(0, 1, (n,)).astype(np.float32)
+    p = RNG.normal(0, 1, (n,)).astype(np.float32)
+    outs = _run_single_op(
+        "dgc",
+        {"U": u, "V": v, "Grad": g, "Param": p,
+         "current_step": np.array([10.0], np.float32),
+         "nranks": np.array([2.0], np.float32)},
+        {"m": 0.9, "use_nesterov": False, "sparsity": [0.75],
+         "rampup_begin_step": 0.0, "rampup_step": 1.0,
+         "regular_coeff": 0.01, "regular_type": 2},
+        out_slots=("U_out", "V_out", "EncodeGrad", "Grad_out", "k"))
+    u_out, v_out, enc, g_out, k = outs
+    g_ref = 2.0 * g + 0.01 * p
+    np.testing.assert_allclose(g_out, g_ref, rtol=1e-5)
+    u_ref = 0.9 * u + g_ref
+    np.testing.assert_allclose(u_out, u_ref, rtol=1e-5)
+    v_full = v + u_ref
+    # sparsity 0.75 -> ~25% of entries survive in EncodeGrad
+    nz = np.count_nonzero(enc)
+    assert 0.15 * n <= nz <= 0.35 * n
+    # error feedback: encode + residual == full velocity
+    np.testing.assert_allclose(enc + v_out, v_full, rtol=1e-5)
+    # selected entries are the largest-magnitude ones
+    assert np.abs(v_full[enc != 0]).min() >= np.abs(v_full[enc == 0]).max() - 1e-6
+
+
+def test_dgc_before_rampup_passes_through():
+    n = 16
+    u = RNG.normal(0, 1, (n,)).astype(np.float32)
+    v = RNG.normal(0, 1, (n,)).astype(np.float32)
+    g = RNG.normal(0, 1, (n,)).astype(np.float32)
+    p = np.zeros((n,), np.float32)
+    u_out, v_out, enc, g_out = _run_single_op(
+        "dgc",
+        {"U": u, "V": v, "Grad": g, "Param": p,
+         "current_step": np.array([1.0], np.float32),
+         "nranks": np.array([2.0], np.float32)},
+        {"m": 0.9, "sparsity": [0.999], "rampup_begin_step": 5.0,
+         "rampup_step": 1.0},
+        out_slots=("U_out", "V_out", "EncodeGrad", "Grad_out"))
+    np.testing.assert_allclose(u_out, u)               # buffers untouched
+    np.testing.assert_allclose(v_out, v)
+    np.testing.assert_allclose(g_out, 2.0 * g, rtol=1e-5)
+    np.testing.assert_allclose(enc, g_out, rtol=1e-5)  # dense pre-rampup
+
+
+def test_dgc_momentum_switches_momentum_to_sgd():
+    n = 8
+    p = RNG.normal(0, 1, (n,)).astype(np.float32)
+    g = RNG.normal(0, 1, (n,)).astype(np.float32)
+    v = RNG.normal(0, 1, (n,)).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    common = {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr,
+              "nranks": np.array([4.0], np.float32)}
+    # before rampup: momentum
+    p1, v1, g1 = _run_single_op(
+        "dgc_momentum",
+        {**common, "current_step": np.array([0.0], np.float32)},
+        {"mu": 0.9, "rampup_begin_step": 10.0},
+        out_slots=("ParamOut", "VelocityOut", "Grad_out"))
+    v_ref = 0.9 * v + g
+    np.testing.assert_allclose(v1, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(p1, p - 0.1 * v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g1, g / 4.0, rtol=1e-5)
+    # after rampup: plain SGD, velocity untouched
+    p2, v2, _ = _run_single_op(
+        "dgc_momentum",
+        {**common, "current_step": np.array([20.0], np.float32)},
+        {"mu": 0.9, "rampup_begin_step": 10.0},
+        out_slots=("ParamOut", "VelocityOut", "Grad_out"))
+    np.testing.assert_allclose(p2, p - 0.1 * g, rtol=1e-5)
+    np.testing.assert_allclose(v2, v, rtol=1e-5)
+
+
+def test_dgc_clip_by_norm_gated():
+    x = (RNG.normal(0, 1, (6,)) * 10).astype(np.float32)
+    before, = _run_single_op(
+        "dgc_clip_by_norm",
+        {"X": x, "current_step": np.array([0.0], np.float32)},
+        {"max_norm": 1.0, "rampup_begin_step": 5.0})
+    np.testing.assert_allclose(before, x)
+    after, = _run_single_op(
+        "dgc_clip_by_norm",
+        {"X": x, "current_step": np.array([9.0], np.float32)},
+        {"max_norm": 1.0, "rampup_begin_step": 5.0})
+    np.testing.assert_allclose(np.linalg.norm(after), 1.0, rtol=1e-4)
+
+
+# -- gradient checks through the OpTest harness -------------------------------
+
+class TestSequenceEnumerateOp(OpTest):
+    def setup_method(self):
+        self.op_type = "sequence_enumerate"
+        x = np.array([[1, 2, 3, 4]], np.int64)
+        self.inputs = {"X": x, "Length": np.array([4], np.int64)}
+        self.attrs = {"win_size": 2, "pad_value": 0}
+        expect = np.array([[[1, 2], [2, 3], [3, 4], [4, 0]]], np.int64)
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+# -- root collectives under a bound mesh axis --------------------------------
+
+def _run_collective(op_type, full, attrs):
+    """Run a collective static op under the 8-device CPU mesh the way
+    with_data_parallel binds the dp axis (test_ops_tail2 pattern)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.static.registry import get_lowering
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    m = dist.init_parallel_env(dp=8)
+    rule = get_lowering(op_type)
+
+    def body(x):
+        return rule({"X": [x]}, attrs, None)["Out"][0]
+
+    try:
+        with m:
+            out = shard_map(body, mesh=m, in_specs=P("dp"),
+                            out_specs=P("dp"))(jnp.asarray(full))
+        return np.asarray(out)
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+def test_c_reduce_sum_root_gets_total():
+    # device i feeds row i = constant i; root 2 receives the total
+    full = np.repeat(np.arange(8, dtype=np.float32)[:, None], 4, axis=1)
+    out = _run_collective("c_reduce_sum", full, {"root_id": 2})
+    np.testing.assert_allclose(out[2], sum(range(8)))   # root has the sum
+    np.testing.assert_allclose(out[0], 0.0)             # others untouched
+    np.testing.assert_allclose(out[5], 5.0)
+
+
+def test_c_scatter_distributes_root_buffer():
+    # each device feeds an (8, 2) buffer (rows 8i:8i+8 of the global
+    # array); root 0's is the payload
+    payload = np.arange(16, dtype=np.float32).reshape(8, 2)
+    full = np.zeros((64, 2), np.float32)
+    full[:8] = payload
+    out = _run_collective("c_scatter", full, {"root": 0, "nranks": 8})
+    # device i's slice == payload row i
+    np.testing.assert_allclose(out, payload)
+
+
+def test_barrier_identity():
+    x = RNG.normal(0, 1, (3, 3)).astype(np.float32)
+    out, = _run_single_op("barrier", {"X": x})
+    np.testing.assert_allclose(out, x)

@@ -1,0 +1,366 @@
+"""Batch-5 static ops: v1 aliases + the remaining numeric tail + SSD
+training-assignment trio (see static/ops_tail5.py per-op reference files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(55)
+
+
+def test_v1_aliases_registered():
+    from paddle_tpu.static.registry import registered_ops
+
+    reg = set(registered_ops())
+    for n in ["reshape", "transpose", "sequence_softmax", "multiclass_nms2",
+              "merge_lod_tensor_infer", "allreduce", "broadcast"]:
+        assert n in reg, n
+
+
+def test_reshape_v1():
+    x = RNG.normal(0, 1, (2, 6)).astype(np.float32)
+    out, = _run_single_op("reshape", {"X": x}, {"shape": [3, 4]},
+                          out_slots=("Out",))
+    np.testing.assert_allclose(out, x.reshape(3, 4))
+
+
+def test_allclose_and_equal_nan():
+    x = np.array([1.0, 2.0], np.float32)
+    y = np.array([1.0, 2.0 + 1e-7], np.float32)
+    out, = _run_single_op("allclose", {"Input": x, "Other": y},
+                          {"rtol": 1e-5, "atol": 1e-8})
+    assert bool(out)
+    z = np.array([1.0, np.nan], np.float32)
+    out2, = _run_single_op("allclose", {"Input": z, "Other": z},
+                           {"rtol": 1e-5, "atol": 1e-8, "equal_nan": False})
+    assert not bool(out2)
+    out3, = _run_single_op("allclose", {"Input": z, "Other": z},
+                           {"rtol": 1e-5, "atol": 1e-8, "equal_nan": True})
+    assert bool(out3)
+
+
+def test_eye_fill_diag():
+    out, = _run_single_op("eye", {}, {"num_rows": 3, "num_columns": 4})
+    np.testing.assert_allclose(out, np.eye(3, 4))
+    out, = _run_single_op("fill", {}, {"shape": [2, 2],
+                                       "value": [1.0, 2.0, 3.0, 4.0]})
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    out, = _run_single_op("diag_v2", {"X": x}, {"offset": 1})
+    np.testing.assert_allclose(out, np.diag(x, 1))
+    out, = _run_single_op("diag_embed", {"X": x[None]}, {"offset": 0})
+    np.testing.assert_allclose(out[0], np.diag(x))
+
+
+def test_histogram():
+    x = np.array([0.0, 1.0, 1.5, 2.9, 3.0, -1.0], np.float32)
+    out, = _run_single_op("histogram", {"X": x},
+                          {"bins": 3, "min": 0.0, "max": 3.0})
+    # numpy oracle over the same [min, max] range
+    expect, _ = np.histogram(x, bins=3, range=(0.0, 3.0))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_random_family_shapes_and_determinism():
+    import paddle_tpu
+
+    paddle_tpu.seed(7)
+    a, = _run_single_op("randint", {}, {"shape": [4, 3], "low": 0,
+                                        "high": 10})
+    assert a.shape == (4, 3) and (a >= 0).all() and (a < 10).all()
+    p, = _run_single_op("randperm", {}, {"n": 8})
+    assert sorted(p.tolist()) == list(range(8))
+    b, = _run_single_op("bernoulli",
+                        {"X": np.full((1000,), 0.3, np.float32)}, {})
+    assert 0.2 < b.mean() < 0.4
+    probs = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+    s, = _run_single_op("sampling_id", {"X": probs}, {})
+    np.testing.assert_array_equal(s, [1, 0])
+
+
+def test_modified_huber_loss_regions():
+    x = np.array([-2.0, 0.0, 2.0], np.float32)
+    y = np.array([1.0, 1.0, 1.0], np.float32)
+    inter, loss = _run_single_op("modified_huber_loss", {"X": x, "Y": y},
+                                 out_slots=("IntermediateVal", "Out"))
+    np.testing.assert_allclose(inter, x)  # z = x*(2*1-1)
+    np.testing.assert_allclose(loss, [8.0, 1.0, 0.0])
+
+
+def test_add_position_encoding_matches_reference_loop():
+    B, T, D = 2, 4, 6
+    x = RNG.normal(0, 1, (B, T, D)).astype(np.float32)
+    alpha, beta = 0.7, 1.3
+    out, = _run_single_op("add_position_encoding", {"X": x},
+                          {"alpha": alpha, "beta": beta})
+    half = D // 2
+    expect = np.empty_like(x)
+    for b in range(B):
+        for j in range(T):
+            for k in range(half):
+                val = j / (10000.0 ** (k / (half - 1))) if half > 1 \
+                    else j / 10000.0
+                expect[b, j, k] = x[b, j, k] * alpha + np.sin(val) * beta
+                expect[b, j, half + k] = (x[b, j, half + k] * alpha
+                                          + np.cos(val) * beta)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_amp_check_finite_and_scale():
+    xs = [np.array([1.0, 2.0], np.float32), np.array([3.0], np.float32)]
+    scale = np.array([0.5], np.float32)
+    o0, o1, found = _run_single_op(
+        "amp_check_finite_and_scale", {"X": xs, "Scale": scale},
+        out_slots=("Out", "FoundInfinite"), n_out={"Out": 2,
+                                                   "FoundInfinite": 1})
+    np.testing.assert_allclose(o0, [0.5, 1.0])
+    np.testing.assert_allclose(o1, [1.5])
+    assert not bool(found[0])
+    xs[1] = np.array([np.inf], np.float32)
+    _, _, found2 = _run_single_op(
+        "amp_check_finite_and_scale", {"X": xs, "Scale": scale},
+        out_slots=("Out", "FoundInfinite"), n_out={"Out": 2,
+                                                   "FoundInfinite": 1})
+    assert bool(found2[0])
+
+
+def test_bilinear_tensor_product():
+    B, I, J, K = 3, 4, 5, 2
+    x = RNG.normal(0, 1, (B, I)).astype(np.float32)
+    y = RNG.normal(0, 1, (B, J)).astype(np.float32)
+    w = RNG.normal(0, 1, (K, I, J)).astype(np.float32)
+    bias = RNG.normal(0, 1, (1, K)).astype(np.float32)
+    out, = _run_single_op("bilinear_tensor_product",
+                          {"X": x, "Y": y, "Weight": w, "Bias": bias})
+    expect = np.einsum("bi,kij,bj->bk", x, w, y) + bias
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_size_like_random_ops():
+    ref = np.zeros((5, 2), np.float32)
+    out, = _run_single_op("gaussian_random_batch_size_like",
+                          {"Input": ref}, {"shape": [-1, 7], "mean": 0.0,
+                                           "std": 1.0})
+    assert out.shape == (5, 7)
+    out, = _run_single_op("uniform_random_batch_size_like",
+                          {"Input": ref}, {"shape": [-1, 3], "min": 0.0,
+                                           "max": 1.0})
+    assert out.shape == (5, 3) and (out >= 0).all() and (out <= 1).all()
+
+
+def test_flatten_contiguous_range():
+    x = RNG.normal(0, 1, (2, 3, 4, 5)).astype(np.float32)
+    out, = _run_single_op("flatten_contiguous_range", {"X": x},
+                          {"start_axis": 1, "stop_axis": 2})
+    np.testing.assert_allclose(out, x.reshape(2, 12, 5))
+
+
+def test_dequantize_family():
+    x = (RNG.integers(-127, 128, (4, 4))).astype(np.float32)
+    scale = np.array([0.5], np.float32)
+    out, = _run_single_op("fake_dequantize_max_abs",
+                          {"X": x, "Scale": scale}, {"max_range": 127.0})
+    np.testing.assert_allclose(out, x * 0.5 / 127.0, rtol=1e-6)
+
+    # channel-wise: per-output-channel scales on axis 0
+    cw = RNG.integers(-127, 128, (3, 4)).astype(np.float32)
+    scales = np.array([0.5, 1.0, 2.0], np.float32)
+    out, = _run_single_op("fake_channel_wise_dequantize_max_abs",
+                          {"X": cw, "Scales": scales},
+                          {"quant_axis": 0, "quant_bits": [8]})
+    np.testing.assert_allclose(out, cw * scales[:, None] / 127.0, rtol=1e-6)
+
+    codes = np.array([-3, 0, 5, -128], np.int8)
+    table = np.linspace(0.1, 12.8, 128).astype(np.float32)
+    out, = _run_single_op("dequantize_log",
+                          {"X": codes, "Dict": table}, {})
+    expect = np.where(codes < 0, -table[(codes.astype(np.int32) + 128) % 128],
+                      table[codes.astype(np.int32) % 128])
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_fake_quantize_moving_average_abs_max():
+    x = RNG.normal(0, 2, (8, 8)).astype(np.float32)
+    in_scale = np.array([1.0], np.float32)
+    state = np.array([1.0], np.float32)
+    accum = np.array([1.0], np.float32)
+    out, oscale, ostate, oaccum = _run_single_op(
+        "fake_quantize_moving_average_abs_max",
+        {"X": x, "InScale": in_scale, "InState": state, "InAccum": accum},
+        {"moving_rate": 0.9, "bit_length": 8},
+        out_slots=("Out", "OutScale", "OutState", "OutAccum"))
+    new_state = 0.9 * 1.0 + 1
+    new_accum = 0.9 * 1.0 + np.abs(x).max()
+    scale = new_accum / new_state
+    np.testing.assert_allclose(ostate, [new_state], rtol=1e-5)
+    np.testing.assert_allclose(oaccum, [new_accum], rtol=1e-5)
+    np.testing.assert_allclose(oscale, [scale], rtol=1e-5)
+    inv = 127 / scale
+    np.testing.assert_allclose(out, np.clip(np.round(x * inv), -127,
+                                            127) / inv, rtol=1e-5)
+
+
+def test_average_accumulates_plain_and_restart():
+    p = np.ones((4,), np.float32)
+    s1 = np.full((4,), 2.0, np.float32)
+    s2 = np.zeros((4,), np.float32)
+    s3 = np.zeros((4,), np.float32)
+    base = {"param": p, "in_sum_1": s1, "in_sum_2": s2, "in_sum_3": s3,
+            "in_num_updates": np.array([5], np.int64),
+            "in_num_accumulates": np.array([2], np.int64),
+            "in_old_num_accumulates": np.array([0], np.int64)}
+    outs = _run_single_op(
+        "average_accumulates", base,
+        {"average_window": 0.5, "max_average_window": 100,
+         "min_average_window": 100},
+        out_slots=("out_sum_1", "out_sum_2", "out_sum_3",
+                   "out_num_updates", "out_num_accumulates",
+                   "out_old_num_accumulates"))
+    np.testing.assert_allclose(outs[0], s1 + p)   # plain accumulate
+    assert int(outs[3][0]) == 6 and int(outs[4][0]) == 3
+    # restart branch: min window already met
+    outs2 = _run_single_op(
+        "average_accumulates", base,
+        {"average_window": 1.0, "max_average_window": 2,
+         "min_average_window": 1},
+        out_slots=("out_sum_1", "out_sum_2", "out_sum_3",
+                   "out_num_updates", "out_num_accumulates",
+                   "out_old_num_accumulates"))
+    np.testing.assert_allclose(outs2[2], s1 + p)  # sum3 <- sum1+sum2
+    np.testing.assert_allclose(outs2[0], 0.0)
+    assert int(outs2[4][0]) == 0 and int(outs2[5][0]) == 3
+
+
+def test_precision_recall_binary_oracle():
+    # 2 classes, hand-checked confusion: preds [0,0,1,1], labels [0,1,1,0]
+    idx = np.array([[0], [0], [1], [1]], np.int32)
+    labels = np.array([[0], [1], [1], [0]], np.int32)
+    batch, accum, states = _run_single_op(
+        "precision_recall", {"Indices": idx, "Labels": labels},
+        {"class_number": 2},
+        out_slots=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"))
+    # class 0: tp=1 fp=1 fn=1; class 1: tp=1 fp=1 fn=1
+    np.testing.assert_allclose(states[:, 0], [1, 1])
+    np.testing.assert_allclose(states[:, 1], [1, 1])
+    np.testing.assert_allclose(states[:, 3], [1, 1])
+    # macro p = r = f1 = 0.5; micro same
+    np.testing.assert_allclose(batch, [0.5] * 6, atol=1e-6)
+    np.testing.assert_allclose(accum, batch)
+
+
+def test_spp_shapes_and_values():
+    x = RNG.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    out, = _run_single_op("spp", {"X": x},
+                          {"pyramid_height": 2, "pooling_type": "max"})
+    # level 0: global max (3), level 1: 2x2 bins (12) -> 15 per image
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    out, = _run_single_op("polygon_box_transform", {"Input": x},
+                          out_slots=("Output",))
+    expect_x = np.tile(4.0 * np.arange(3), (2, 1))            # 4*w_idx
+    expect_y = np.tile((4.0 * np.arange(2))[:, None], (1, 3))  # 4*h_idx
+    np.testing.assert_allclose(out[0, 0], expect_x)
+    np.testing.assert_allclose(out[0, 1], expect_y)
+
+
+def test_random_crop():
+    x = RNG.normal(0, 1, (2, 10, 10)).astype(np.float32)
+    out, _ = _run_single_op("random_crop", {"X": x}, {"shape": [4, 4]},
+                            out_slots=("Out", "SeedOut"))
+    assert out.shape == (2, 4, 4)
+    # every output row must be a contiguous slice of some input window
+    found = any(np.allclose(out[0], x[0, i:i + 4, j:j + 4])
+                for i in range(7) for j in range(7))
+    assert found
+
+
+def test_hierarchical_sigmoid_default_tree():
+    B, D, C = 4, 6, 7
+    x = RNG.normal(0, 1, (B, D)).astype(np.float32)
+    w = RNG.normal(0, 1, (C - 1, D)).astype(np.float32)
+    bias = RNG.normal(0, 1, (C - 1,)).astype(np.float32)
+    label = np.array([0, 3, 5, 6], np.int64)[:, None]
+    loss, pre = _run_single_op(
+        "hierarchical_sigmoid",
+        {"X": x, "W": w, "Label": label, "Bias": bias},
+        {"num_classes": C}, out_slots=("Out", "PreOut"))
+
+    # oracle: SimpleCode walk (ref math/matrix_bit_code.h:119 —
+    # calc_index(j) = (c >> (j+1)) - 1, calc_bit(j) = c & (1 << j),
+    # length = FindLastSet(c) - 1)
+    def simple_code(lab):
+        c = lab + C
+        length = c.bit_length() - 1
+        nodes = [(c >> (j + 1)) - 1 for j in range(length)]
+        bits = [(c >> j) & 1 for j in range(length)]
+        return nodes, bits
+
+    expect = np.zeros((B,))
+    for b in range(B):
+        nodes, bits = simple_code(int(label[b, 0]))
+        for node, bit in zip(nodes, bits):
+            z = float(x[b] @ w[node] + bias[node])
+            expect[b] += np.log1p(np.exp(z)) - bit * z
+    np.testing.assert_allclose(loss[:, 0], expect, rtol=1e-4, atol=1e-4)
+    assert float(loss.min()) > 0
+
+
+def test_bipartite_match_greedy():
+    # hand-checked: global max first, then next-best unmatched
+    dist = np.array([[[0.9, 0.1, 0.3],
+                      [0.8, 0.7, 0.2]]], np.float32)  # (1, 2 gt, 3 priors)
+    mi, md = _run_single_op("bipartite_match", {"DistMat": dist},
+                            out_slots=("ColToRowMatchIndices",
+                                       "ColToRowMatchDist"))
+    # greedy: (r0,c0,0.9) first, then r1's best free col c1 (0.7)
+    np.testing.assert_array_equal(mi[0], [0, 1, -1])
+    np.testing.assert_allclose(md[0], [0.9, 0.7, 0.0])
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[[0.9, 0.1, 0.6],
+                      [0.8, 0.7, 0.2]]], np.float32)
+    mi, md = _run_single_op("bipartite_match", {"DistMat": dist},
+                            {"match_type": "per_prediction",
+                             "dist_threshold": 0.5},
+                            out_slots=("ColToRowMatchIndices",
+                                       "ColToRowMatchDist"))
+    # bipartite assigns c0<-r0, c1<-r1; c2 unmatched but argmax r0 dist
+    # 0.6 >= 0.5 -> matched per-prediction
+    np.testing.assert_array_equal(mi[0], [0, 1, 0])
+    np.testing.assert_allclose(md[0], [0.9, 0.7, 0.6])
+
+
+def test_target_assign_with_negatives():
+    # B=1, P=2 gt rows of K=3, M=4 priors
+    x = np.array([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]], np.float32)
+    match = np.array([[0, -1, 1, -1]], np.int32)
+    neg = np.array([[3, -1, -1, -1]], np.int32)
+    out, wt = _run_single_op(
+        "target_assign", {"X": x, "MatchIndices": match, "NegIndices": neg},
+        {"mismatch_value": 0}, out_slots=("Out", "OutWeight"))
+    np.testing.assert_allclose(out[0, 0], [1, 2, 3])
+    np.testing.assert_allclose(out[0, 2], [4, 5, 6])
+    np.testing.assert_allclose(out[0, 1], 0)
+    np.testing.assert_allclose(wt[0].ravel(), [1, 0, 1, 1])  # neg 3 weighted
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 image, 6 priors, 2 positives -> neg_sel = min(2*1.0, #candidates)
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2, 0.3]], np.float32)
+    match = np.array([[0, -1, -1, -1, 1, -1]], np.int32)
+    dist = np.zeros((1, 6), np.float32)
+    neg_idx, upd = _run_single_op(
+        "mine_hard_examples",
+        {"ClsLoss": cls_loss, "MatchIndices": match, "MatchDist": dist},
+        {"neg_pos_ratio": 1.0, "mining_type": "max_negative"},
+        out_slots=("NegIndices", "UpdatedMatchIndices"))
+    # candidates {1,2,3,5} by loss desc -> 1 (0.9), 3 (0.7); ascending
+    np.testing.assert_array_equal(neg_idx[0][:2], [1, 3])
+    np.testing.assert_array_equal(neg_idx[0][2:], -1)
+    np.testing.assert_array_equal(upd, match)  # unchanged for max_negative

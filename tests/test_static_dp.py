@@ -100,3 +100,50 @@ def test_dp_uneven_batch_raises():
 def test_compiled_program_type_checks():
     with pytest.raises(TypeError):
         static.CompiledProgram(object())
+
+
+@needs_devices
+def test_dp_steady_state_places_once():
+    """round-5 (r03 weak #6): persistables must NOT round-trip through
+    device_put on the steady-state path — after step 1 the state arrays
+    come back from the jitted step already replicated, and step 2 must
+    reuse those exact buffers (pinned by unsafe_buffer_pointer identity)."""
+    main, startup, loss = _build_mnist_like(seed=7)
+    compiled = static.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        x = np.zeros((64, 32), np.float32)
+        y = np.zeros((64, 1), np.int64)
+        exe.run(compiled, feed={"img": x, "label": y}, fetch_list=[loss])
+        pname = main.all_parameters()[0].name
+        w1 = scope.find_var(pname)
+        ptrs1 = [s.data.unsafe_buffer_pointer()
+                 for s in w1.addressable_shards]
+
+        # spy on device_put: the state dict must not flow through it again
+        placed = []
+        orig = jax.device_put
+
+        def spy(v, *a, **kw):
+            placed.append(v)
+            return orig(v, *a, **kw)
+
+        jax.device_put, saved = spy, jax.device_put
+        try:
+            exe.run(compiled, feed={"img": x, "label": y},
+                    fetch_list=[loss])
+        finally:
+            jax.device_put = saved
+        # feeds + PRNG key are placed each step; persistables are not
+        assert not any(isinstance(p, jax.Array)
+                       and getattr(p, "shape", None) == w1.shape
+                       for p in placed)
+        # and the buffers the second step consumed are w1's own: the
+        # input state arrays were passed through untouched, so w1's
+        # buffers are still alive and unmoved
+        ptrs_again = [s.data.unsafe_buffer_pointer()
+                      for s in w1.addressable_shards]
+        assert ptrs_again == ptrs1

@@ -1,0 +1,224 @@
+"""Vision perf probes behind the r05 ResNet-50 ladder (BASELINE.md).
+
+Consolidates the round-5 profiling scripts into one reproducible harness.
+Probes (select by name on the command line; default runs all):
+
+  matmul     8192^3 bf16 matmul in a fori_loop — the chip/harness sanity
+             ceiling (reads ~77% MFU through the axon tunnel)
+  floor      tiny-op fori_loop — the per-iteration fixed overhead
+  convs      marginal per-conv cost via 1/2/4 chained convs (the ONLY
+             valid per-op timing over this tunnel; single-op loops are
+             floor-dominated, host-chained calls pay a ~40-80 ms RTT each)
+  steps      ResNet-50 train step: K jit calls vs ONE jit with
+             lax.fori_loop over K steps (dispatch pipelining check)
+  fwdbwd     fwd-only and fwd+bwd device time inside fori_loop
+  batch      full-step time at batch 256 vs 512 (overhead-bound check)
+
+Every probe chains iterations through `x + (mean(y)*1e-12).astype(dtype)`
+— a structural dependence XLA cannot hoist that is numerically a bf16
+no-op.  See BASELINE.md "r05 ResNet-50 ladder" for the recorded numbers
+and conclusions.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 197e12
+
+
+def _time_loop(body, x0, iters):
+    @jax.jit
+    def run(x):
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    jax.block_until_ready(run(x0))
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(x0))
+    return (time.perf_counter() - t0) / iters
+
+
+def _chain(x, y):
+    return x + (jnp.mean(y) * 1e-12).astype(x.dtype)
+
+
+def probe_matmul():
+    n = 8192
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)) * 0.01,
+                    jnp.bfloat16)
+
+    def body(i, x):
+        y = x @ a
+        return y / (jnp.max(jnp.abs(y)).astype(y.dtype) + 1.0)
+
+    dt = _time_loop(body, a, 100)
+    print(json.dumps({"probe": "matmul8192", "ms": round(dt * 1e3, 2),
+                      "mfu": round(2 * n ** 3 / dt / PEAK, 3)}))
+
+
+def probe_floor():
+    rng = np.random.default_rng(3)
+    a0 = jnp.asarray(rng.standard_normal((128, 128)), jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, jnp.bfloat16)
+    dt = _time_loop(lambda i, a: _chain(a, a @ b), a0, 50)
+    print(json.dumps({"probe": "tiny_matmul128_floor",
+                      "ms_per_iter": round(dt * 1e3, 3)}))
+
+
+def probe_convs():
+    rng = np.random.default_rng(4)
+    x0 = jnp.asarray(rng.standard_normal((256, 14, 14, 256)), jnp.bfloat16)
+    ws = [jnp.asarray(rng.standard_normal((256, 256, 3, 3)) * 0.05,
+                      jnp.bfloat16) for _ in range(4)]
+
+    def mk(k):
+        def body(i, x):
+            y = x
+            for w in ws[:k]:
+                y = jnp.tanh(jax.lax.conv_general_dilated(
+                    y, w, (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "OIHW", "NHWC")))
+            return _chain(x, y)
+        return body
+
+    times = {k: _time_loop(mk(k), x0, 50) for k in (1, 2, 4)}
+    for k, dt in times.items():
+        print(json.dumps({"probe": f"conv_l3_x{k}",
+                          "ms": round(dt * 1e3, 3)}))
+    marginal = (times[4] - times[1]) / 3
+    flops = 2 * 256 * 14 * 14 * 256 * 256 * 9
+    print(json.dumps({"probe": "conv_l3_marginal",
+                      "ms": round(marginal * 1e3, 3),
+                      "mfu": round(flops / marginal / PEAK, 3)}))
+
+
+def _resnet_setup(batch):
+    from paddle_tpu import autograd
+    from paddle_tpu.autograd import parameters_dict
+    from paddle_tpu.optimizer import Momentum
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision import models as M
+
+    model = M.resnet50(num_classes=1000)
+    model.train()
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    params = parameters_dict(model)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)),
+                         jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch, 1)), jnp.int32)
+
+    def cast(p):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+    def loss_of(p_, imgs):
+        logits = autograd.functional_call(model, cast(p_), (imgs,))
+        return jnp.mean(F.cross_entropy(logits.astype(jnp.float32), labels))
+
+    def one_step(p, s):
+        loss, grads = jax.value_and_grad(loss_of)(p, images)
+        p, s = opt.update(grads, s, p)
+        return p, s, loss
+
+    return params, opt_state, images, loss_of, one_step
+
+
+def probe_steps():
+    K = 10
+    params, opt_state, images, loss_of, one_step = _resnet_setup(256)
+    step = jax.jit(one_step)
+    p, s, loss = step(params, opt_state)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(K):
+        p, s, loss = step(p, s)
+    float(loss)
+    dt_calls = (time.perf_counter() - t0) / K
+
+    @jax.jit
+    def k_steps(p, s):
+        def body(i, carry):
+            p, s, _ = carry
+            return one_step(p, s)
+        return jax.lax.fori_loop(0, K, body,
+                                 (p, s, jnp.zeros((), jnp.float32)))
+
+    out = k_steps(params, opt_state)
+    float(out[2])
+    t0 = time.perf_counter()
+    out = k_steps(params, opt_state)
+    float(out[2])
+    dt_fori = (time.perf_counter() - t0) / K
+    for name, dt in [("step_calls", dt_calls), ("step_foriloop", dt_fori)]:
+        print(json.dumps({"probe": f"resnet50_{name}",
+                          "ms": round(dt * 1e3, 2),
+                          "mfu": round(3 * 4.09e9 * 256 / dt / PEAK, 4)}))
+
+
+def probe_fwdbwd():
+    K = 10
+    params, _, images, loss_of, _ = _resnet_setup(256)
+
+    @jax.jit
+    def fwd_loop(imgs):
+        def body(i, im):
+            return im + (loss_of(params, im) * 1e-12).astype(im.dtype)
+        return jax.lax.fori_loop(0, K, body, imgs)
+
+    jax.block_until_ready(fwd_loop(images))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd_loop(images))
+    dt = (time.perf_counter() - t0) / K
+    print(json.dumps({"probe": "resnet50_fwd_loop", "ms":
+                      round(dt * 1e3, 2),
+                      "mfu": round(4.09e9 * 256 / dt / PEAK, 4)}))
+
+    @jax.jit
+    def fwdbwd_loop(imgs):
+        def body(i, im):
+            loss, grads = jax.value_and_grad(loss_of)(params, im)
+            g0 = jax.tree_util.tree_leaves(grads)[0]
+            return im + (loss * 1e-12).astype(im.dtype) \
+                + (jnp.mean(g0) * 1e-12).astype(im.dtype)
+        return jax.lax.fori_loop(0, K, body, imgs)
+
+    jax.block_until_ready(fwdbwd_loop(images))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwdbwd_loop(images))
+    dt = (time.perf_counter() - t0) / K
+    print(json.dumps({"probe": "resnet50_fwdbwd_loop",
+                      "ms": round(dt * 1e3, 2),
+                      "mfu": round(3 * 4.09e9 * 256 / dt / PEAK, 4)}))
+
+
+def probe_batch():
+    for batch in (256, 512):
+        params, opt_state, _, _, one_step = _resnet_setup(batch)
+        step = jax.jit(one_step)
+        p, s, loss = step(params, opt_state)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            p, s, loss = step(p, s)
+        float(loss)
+        dt = (time.perf_counter() - t0) / 10
+        print(json.dumps({"probe": f"resnet50_bs{batch}",
+                          "ms": round(dt * 1e3, 2),
+                          "ips": round(batch / dt, 1),
+                          "mfu": round(3 * 4.09e9 * batch / dt / PEAK,
+                                       4)}))
+
+
+PROBES = {"matmul": probe_matmul, "floor": probe_floor,
+          "convs": probe_convs, "steps": probe_steps,
+          "fwdbwd": probe_fwdbwd, "batch": probe_batch}
+
+if __name__ == "__main__":
+    for name in (sys.argv[1:] or list(PROBES)):
+        PROBES[name]()
