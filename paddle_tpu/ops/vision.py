@@ -355,9 +355,12 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     t_x, t_y = scat(tx), scat(ty)
     t_w, t_h = scat(tw), scat(th)
     t_scale = scat(box_scale)
-    t_cls = jnp.zeros((N, A, H, W, C), jnp.float32)
+    # class targets scattered DIRECTLY in the head's (N, A, C, H, W)
+    # layout: the [..., C]-last form needed an 83 MB fp32 transpose of the
+    # prediction tensor per head per step (r05 YOLO ladder, BASELINE.md)
     cls_idx = jnp.clip(gt_label, 0, C - 1)
-    t_cls = t_cls.at[sel + (cls_idx,)].set(1.0, mode="drop")
+    t_cls = jnp.zeros((N, A, C, H, W), jnp.float32).at[
+        (bidx, local_anchor, cls_idx, gj, gi)].set(1.0, mode="drop")
 
     # ---- ignore mask: predictions overlapping any gt beyond thresh ----
     # same decode as yolo_box, restricted to this head's anchors
@@ -377,22 +380,30 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     ignore = (best_iou > ignore_thresh) & (obj_mask <= 0)
 
     # ---- loss terms (BCE-with-logits like the reference) ----
+    # grid math stays fp32 regardless of head dtype (the r05 ladder
+    # measured bf16 grid math NEUTRAL on throughput, so exact loss parity
+    # wins); reductions carry explicit fp32 accumulators
+    dt = jnp.float32
+
     def bce(logit, target):
         return jnp.maximum(logit, 0) - logit * target + \
             jnp.log1p(jnp.exp(-jnp.abs(logit)))
 
-    lx = bce(xr[:, :, 0], t_x) * t_scale * obj_mask
-    ly = bce(xr[:, :, 1], t_y) * t_scale * obj_mask
-    lw = jnp.abs(xr[:, :, 2] - t_w) * t_scale * obj_mask
-    lh = jnp.abs(xr[:, :, 3] - t_h) * t_scale * obj_mask
-    pos = bce(xr[:, :, 4], jnp.ones_like(obj_mask)) * obj_mask
-    neg = bce(xr[:, :, 4], jnp.zeros_like(obj_mask)) * \
-        jnp.where((obj_mask <= 0) & (~ignore), 1.0, 0.0)
+    obj = obj_mask.astype(dt)
+    tsc = t_scale.astype(dt)
+    lx = bce(xr[:, :, 0], t_x.astype(dt)) * tsc * obj
+    ly = bce(xr[:, :, 1], t_y.astype(dt)) * tsc * obj
+    lw = jnp.abs(xr[:, :, 2] - t_w.astype(dt)) * tsc * obj
+    lh = jnp.abs(xr[:, :, 3] - t_h.astype(dt)) * tsc * obj
+    pos = bce(xr[:, :, 4], jnp.ones_like(obj)) * obj
+    neg = bce(xr[:, :, 4], jnp.zeros_like(obj)) * \
+        jnp.where((obj_mask <= 0) & (~ignore), 1.0, 0.0).astype(dt)
     smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
     t_cls_s = t_cls * (1 - 2 * smooth) + smooth if use_label_smooth else t_cls
-    lcls = (bce(xr[:, :, 5:].transpose(0, 1, 3, 4, 2), t_cls_s) *
-            obj_mask[..., None]).sum(-1)
-    per_img = (lx + ly + lw + lh + pos + neg + lcls).sum(axis=(1, 2, 3))
+    lcls = (bce(xr[:, :, 5:], t_cls_s.astype(dt))
+            * obj[:, :, None]).sum(axis=2, dtype=jnp.float32)
+    per_img = (lx + ly + lw + lh + pos + neg).sum(
+        axis=(1, 2, 3), dtype=jnp.float32) + lcls.sum(axis=(1, 2, 3))
     return per_img
 
 

@@ -1,0 +1,79 @@
+"""Flash-attention head_dim-64 MXU-rate probe (r05, VERDICT item 5).
+
+Measures the packed kernel's achieved matmul rate at the ERNIE flagship
+shape against the d=64 STRUCTURAL ceiling (contraction/output dim 64 =
+half the 128-lane MXU -> 98.5 TFLOP/s), with a block-size sweep.
+fori_loop-chained (the only valid micro over the axon tunnel).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas import flash_attention_packed as fp
+
+PEAK = 197e12
+HALF = PEAK / 2
+B, S, H, D = 64, 512, 12, 64
+ITERS = 20
+
+
+def timed(fn, x0, iters=ITERS):
+    @jax.jit
+    def run(x):
+        return jax.lax.fori_loop(0, iters, fn, x)
+
+    jax.block_until_ready(run(x0))
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(x0))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q0 = jnp.asarray(rng.standard_normal((B, S, H * D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, H * D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, H * D)), jnp.bfloat16)
+    fwd_flops = 4 * B * H * S * S * D
+
+    for bq, bk in [(512, 512), (256, 256)]:
+        def body(i, q, bq=bq, bk=bk):
+            o = fp.flash_attention_packed(q, k, v, num_heads=H,
+                                          block_q=bq, block_k=bk)
+            return q + (jnp.mean(o.astype(jnp.float32)) * 1e-12).astype(
+                q.dtype)
+
+        dt = timed(body, q0)
+        print(json.dumps({
+            "probe": f"packed_fwd_bq{bq}_bk{bk}",
+            "ms": round(dt * 1e3, 3),
+            "pct_of_half_peak": round(fwd_flops / dt / HALF * 100, 1)}))
+
+    # fwd+bwd at the default blocks
+    dy = jnp.asarray(rng.standard_normal((B, S, H * D)), jnp.bfloat16)
+
+    def fb(i, q):
+        def f(q_):
+            return jnp.sum(fp.flash_attention_packed(
+                q_, k, v, num_heads=H).astype(jnp.float32) * dy.astype(
+                jnp.float32))
+        g = jax.grad(f)(q)
+        return q + (g * 1e-12).astype(q.dtype)
+
+    dt = timed(fb, q0)
+    total_flops = fwd_flops * 3.5  # fwd + dkdv + dq kernel passes
+    print(json.dumps({"probe": "packed_fwdbwd_default",
+                      "ms": round(dt * 1e3, 3),
+                      "pct_of_half_peak":
+                      round(total_flops / dt / HALF * 100, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,12 @@ no-op.  See BASELINE.md "r05 ResNet-50 ladder" for the recorded numbers
 and conclusions.
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,116 @@
+"""YOLOv3 step decomposition (r05 ladder): fwd / fwd+loss / full device
+time via fori_loop, plus a loss-only micro.  Run on the TPU."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import autograd
+from paddle_tpu.autograd import parameters_dict
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.vision.models.yolov3 import yolov3_darknet53
+
+PEAK = 197e12
+BATCH, SIZE, NGT = 32, 416, 16
+K = 10
+FWD_FLOPS = 65.86e9 * BATCH
+
+
+def main():
+    model = yolov3_darknet53(num_classes=80)
+    model.train()
+    params = parameters_dict(model)
+    opt = Momentum(learning_rate=1e-4, momentum=0.9)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((BATCH, 3, SIZE, SIZE)),
+                         jnp.bfloat16)
+    wh = rng.uniform(0.05, 0.4, (BATCH, NGT, 2))
+    cxy = rng.uniform(0.2, 0.8, (BATCH, NGT, 2))
+    gt_box = jnp.asarray(np.concatenate([cxy, wh], -1), jnp.float32)
+    gt_label = jnp.asarray(rng.integers(0, 80, (BATCH, NGT)), jnp.int32)
+
+    def cast(p):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+
+    def heads_of(p, imgs):
+        return autograd.functional_call(model, cast(p), (imgs,))
+
+    def loss_of(p, imgs):
+        heads = [h.astype(jnp.float32) for h in heads_of(p, imgs)]
+        return model.loss(heads, gt_box, gt_label)
+
+    def timed(jit_fn, x0):
+        out = jit_fn(x0)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        jax.block_until_ready(jit_fn(x0))
+        return (time.perf_counter() - t0) / K
+
+    @jax.jit
+    def fwd_loop(imgs):
+        def body(i, im):
+            heads = heads_of(params, im)
+            s = sum(jnp.mean(h.astype(jnp.float32)) for h in heads)
+            return im + (s * 1e-12).astype(im.dtype)
+        return jax.lax.fori_loop(0, K, body, imgs)
+
+    dt = timed(fwd_loop, images)
+    print(json.dumps({"probe": "fwd", "ms": round(dt * 1e3, 2),
+                      "mfu": round(FWD_FLOPS / dt / PEAK, 4)}))
+
+    # loss-only: heads precomputed, loss recomputed per iteration
+    heads_const = [h.astype(jnp.float32)
+                   for h in heads_of(params, images)]
+
+    @jax.jit
+    def loss_loop(h0):
+        def body(i, h):
+            heads = [h] + heads_const[1:]
+            loss = model.loss(heads, gt_box, gt_label)
+            return h + (loss * 1e-12).astype(h.dtype)
+        return jax.lax.fori_loop(0, K, body, h0)
+
+    dt = timed(loss_loop, heads_const[0])
+    print(json.dumps({"probe": "loss_only", "ms": round(dt * 1e3, 2)}))
+
+    @jax.jit
+    def fwdloss_loop(imgs):
+        def body(i, im):
+            return im + (loss_of(params, im) * 1e-12).astype(im.dtype)
+        return jax.lax.fori_loop(0, K, body, imgs)
+
+    dt = timed(fwdloss_loop, images)
+    print(json.dumps({"probe": "fwd+loss", "ms": round(dt * 1e3, 2)}))
+
+    @jax.jit
+    def full_loop(imgs):
+        def body(i, carry):
+            p, s, _ = carry
+            loss, grads = jax.value_and_grad(loss_of)(p, imgs)
+            p, s = opt.update(grads, s, p)
+            return p, s, loss
+        return jax.lax.fori_loop(
+            0, K, body, (params, opt_state, jnp.zeros(())))
+
+    out = full_loop(images)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    jax.block_until_ready(full_loop(images))
+    dt = (time.perf_counter() - t0) / K
+    print(json.dumps({"probe": "full", "ms": round(dt * 1e3, 2),
+                      "ips": round(BATCH / dt, 1),
+                      "mfu": round(3 * FWD_FLOPS / dt / PEAK, 4)}))
+
+
+if __name__ == "__main__":
+    main()
