@@ -6,7 +6,91 @@ the mutable buffers (TPU-native: state is explicit, never hidden in kernels).
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, weight, bias, axes, epsilon):
+    """Training-mode BN core with a hand-written VJP (ref
+    batch_norm_op.cc BatchNormGradKernel — the reference ships a fused
+    backward for exactly this reason).
+
+    Forward: ONE-PASS fp32 stats (E[x^2]-E[x]^2) folded to a per-channel
+    a·x+b apply — both reductions read x once and fuse into the producing
+    conv; the apply input-fuses into the consumer.  Backward: the
+    classic two-pass schedule (one fused pass for dβ=Σdy and
+    dγ=Σdy·x̂, one elementwise pass for dx) instead of leaving AD to
+    schedule the passes (r05 ResNet ladder, BASELINE.md).
+
+    Returns (out, mean_f32, var_f32); weight/bias may be None.
+    """
+    out, mean, var, _, _ = _bn_train_fwd_math(x, weight, bias, axes,
+                                              epsilon)
+    return out, mean, var
+
+
+def _bn_train_fwd_math(x, weight, bias, axes, epsilon):
+    shape = [1] * x.ndim
+    (ch_axis,) = [i for i in range(x.ndim) if i not in axes]
+    shape[ch_axis] = -1
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+    inv = 1.0 / jnp.sqrt(var + epsilon)
+    a = inv if weight is None else inv * weight.astype(jnp.float32)
+    b = -mean * a
+    if bias is not None:
+        b = b + bias.astype(jnp.float32)
+    out = x * a.astype(x.dtype).reshape(shape) \
+        + b.astype(x.dtype).reshape(shape)
+    return out, mean, var, inv, shape
+
+
+def _bn_train_vjp_fwd(x, weight, bias, axes, epsilon):
+    out, mean, var, inv, _ = _bn_train_fwd_math(x, weight, bias, axes,
+                                                epsilon)
+    return (out, mean, var), (x, weight, bias, mean, inv)
+
+
+def _bn_train_vjp_bwd(axes, epsilon, res, cts):
+    x, weight, bias, mean, inv = res
+    dout, dmean, dvar = cts
+    shape = [1] * x.ndim
+    (ch_axis,) = [i for i in range(x.ndim) if i not in axes]
+    shape[ch_axis] = -1
+    m = 1
+    for ax in axes:
+        m *= x.shape[ax]
+    mean_b = mean.reshape(shape)
+    inv_b = inv.reshape(shape)
+    xf = x.astype(jnp.float32)
+    dof = dout.astype(jnp.float32)
+    xhat = (xf - mean_b) * inv_b
+    # pass 1: both reductions in one fused read of (x, dout)
+    dbeta = jnp.sum(dof, axis=axes)
+    dgamma = jnp.sum(dof * xhat, axis=axes)
+    g = jnp.ones_like(inv) if weight is None \
+        else weight.astype(jnp.float32)
+    # pass 2: elementwise dx (reads x, dout once more, writes dx)
+    dx = (g * inv).reshape(shape) * (
+        dof - (dbeta / m).reshape(shape)
+        - xhat * (dgamma / m).reshape(shape))
+    # cotangents of the returned (mean, var): custom_vjp always delivers
+    # instantiated arrays — zeros on the buffer path (batch_norm wraps
+    # mean/var in stop_gradient), which XLA folds away; the terms stay so
+    # direct _bn_train users who DO differentiate mean/var get full grads
+    dmean_t = (dmean / m).reshape(shape)
+    dvar_t = dvar.reshape(shape) * 2.0 * (xf - mean_b) / m
+    dx = (dx + dmean_t + dvar_t).astype(x.dtype)
+    dw = None if weight is None else dgamma.astype(weight.dtype)
+    db = None if bias is None else dbeta.astype(bias.dtype)
+    return dx, dw, db
+
+
+_bn_train.defvjp(_bn_train_vjp_fwd, _bn_train_vjp_bwd)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
@@ -19,24 +103,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(range(x.ndim - 1))
         shape = [1] * (x.ndim - 1) + [-1]
     if training:
-        # ONE-PASS stats (E[x^2] - E[x]^2, fp32 accumulation) instead of
-        # jnp.var's two-pass mean-then-centered form: both reductions read
-        # x once and fuse into the producing conv's output on TPU — the
-        # two-pass form forces an extra full HBM pass over the activation
-        # per BN (r05 ResNet ladder, BASELINE.md)
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
-        mean = mean.astype(running_mean.dtype)
-        var = var.astype(running_var.dtype)
+        out, mean, var = _bn_train(x, weight, bias, tuple(axes),
+                                   float(epsilon))
+        mean = jax.lax.stop_gradient(mean).astype(running_mean.dtype)
+        var = jax.lax.stop_gradient(var).astype(running_var.dtype)
         new_rm = momentum * running_mean + (1 - momentum) * mean
         new_rv = momentum * running_var + (1 - momentum) * var
-    else:
-        mean, var = running_mean, running_var
-        new_rm, new_rv = running_mean, running_var
-    # fold scale/shift into per-channel a, b in fp32, then ONE fused
-    # elementwise apply in x's dtype (a*x + b): XLA input-fuses this into
-    # the consuming conv, so the normalize costs no extra HBM pass
+        return out, new_rm, new_rv
+    mean, var = running_mean, running_var
+    # inference: fold to per-channel a·x+b (input-fuses into the consumer)
     inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + epsilon)
     a = inv
     if weight is not None:
@@ -46,7 +121,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         b = b + bias.astype(jnp.float32)
     out = x * a.astype(x.dtype).reshape(shape) \
         + b.astype(x.dtype).reshape(shape)
-    return out, new_rm, new_rv
+    return out, running_mean, running_var
 
 
 def _use_fused_ln(x, normalized_shape) -> bool:

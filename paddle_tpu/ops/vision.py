@@ -298,7 +298,11 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     any gt exceeds ignore_thresh.  All built as dense scatters — no ragged
     tensors (static-shape policy).
     """
-    x = jnp.asarray(x)
+    # the loss contract is fp32 regardless of head dtype (bf16 heads
+    # measured throughput-NEUTRAL, r05 ladder — so exact parity wins);
+    # casting at entry makes the invariant hold for EVERY term, including
+    # the ignore-mask decode below
+    x = jnp.asarray(x).astype(jnp.float32)
     gt_box = jnp.asarray(gt_box, jnp.float32)
     gt_label = jnp.asarray(gt_label)
     N, _, H, W = x.shape
@@ -379,10 +383,8 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     best_iou = ious.max(axis=-1).reshape(N, A, H, W)
     ignore = (best_iou > ignore_thresh) & (obj_mask <= 0)
 
-    # ---- loss terms (BCE-with-logits like the reference) ----
-    # grid math stays fp32 regardless of head dtype (the r05 ladder
-    # measured bf16 grid math NEUTRAL on throughput, so exact loss parity
-    # wins); reductions carry explicit fp32 accumulators
+    # ---- loss terms (BCE-with-logits like the reference; everything is
+    # fp32 via the entry cast, reductions carry explicit accumulators) ----
     dt = jnp.float32
 
     def bce(logit, target):

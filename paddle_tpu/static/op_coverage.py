@@ -130,26 +130,20 @@ DESCOPED = {
                                  "match_matrix_tensor",
     "var_conv_2d": None,  # registered in ops_tail3
     # -- detection label-generation (RCNN/RetinaNet training pipelines) ---
-    "generate_proposals": "host: RPN proposal stage mixes NMS + dynamic "
-                          "top-k; eager ops (vision.py multiclass_nms, "
-                          "box_coder) cover the math — static-graph RCNN "
-                          "training is descoped, SSD/YOLO are the "
-                          "covered detection trainers",
-    "generate_proposal_labels": "host: same RCNN pipeline",
-    "generate_mask_labels": "host: same (Mask R-CNN)",
-    "rpn_target_assign": "host: same RCNN pipeline",
-    "retinanet_target_assign": "host: same (RetinaNet)",
-    "retinanet_detection_output": "host: same",
-    "distribute_fpn_proposals": "host: same (FPN routing)",
-    "collect_fpn_proposals": "host: same",
-    "box_decoder_and_assign": "host: same",
+    "generate_proposals": None,  # registered in ops_tail6
+    "generate_proposal_labels": "host: RCNN proposal-label sampling (ragged per-image fg/bg subsample + gather); the stages around it (generate_proposals, rpn_target_assign, FPN routing) ARE registered (ops_tail6) — this one remains host-side data prep",
+    "generate_mask_labels": "host: Mask R-CNN label crops, same host-side data-prep class as generate_proposal_labels",
+    "rpn_target_assign": None,    # registered in ops_tail6
+    "retinanet_target_assign": "host: RetinaNet variant of the registered rpn_target_assign (adds per-level anchor flattening); host-side data prep",
+    "retinanet_detection_output": "host: per-level top-k + NMS decode; the registered multiclass_nms/matrix_nms + yolo_box-style decode cover the math",
+    "distribute_fpn_proposals": None,  # registered in ops_tail6
+    "collect_fpn_proposals": None,     # registered in ops_tail6
+    "box_decoder_and_assign": None,  # registered in ops_tail6
     "deformable_psroi_pooling": "host: psroi_pool + deformable_conv "
                                 "eager ops cover the components",
     "locality_aware_nms": "host: OCR-specific NMS variant of the "
                           "registered multiclass_nms",
-    "matrix_nms": "host: soft-NMS variant; multiclass_nms is registered "
-                  "and matrix_nms's decay math has no consumer in the "
-                  "reference zoo's trainable configs",
+    "matrix_nms": None,           # registered in ops_tail6
     "roi_perspective_transform": "host: OCR contrib; perspective warp of "
                                  "rois (grid_sample is registered and "
                                  "covers the sampling core)",

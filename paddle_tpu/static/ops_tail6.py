@@ -1,0 +1,402 @@
+"""Static-op long tail, batch 6: the RCNN/FPN detection training tail.
+
+Reference parity targets: detection/generate_proposals_op.cc (RPN
+proposal stage: top-k → BoxCoder decode → clip → min-size filter → NMS),
+rpn_target_assign_op.cc (anchor fg/bg sampling), matrix_nms_op.cc
+(PP-YOLO's parallel soft-NMS), box_decoder_and_assign_op.h (per-class
+decode + argmax-class assign), distribute_fpn_proposals_op.h /
+collect_fpn_proposals_op.h (FPN level routing and its inverse).
+
+TPU-native contracts (static shapes; same padded + valid-count policy
+as batches 4/5 — valid entries first, zero/-1 pad, counts under an
+optional output slot):
+- generate_proposals emits (N, post_nms_topN, 4) rois + (N, topN, 1)
+  probs + RpnRoisNum valid counts; the adaptive-eta NMS re-threshold
+  loop (eta < 1) is descoped to the standard fixed-threshold NMS the
+  reference defaults to (eta=1).
+- rpn_target_assign's random fg/bg subsampling uses the executor's
+  per-op PRNG scope (deterministic under `paddle_tpu.seed`); outputs are
+  (N, batch_size_per_im) padded index lists per image plus counts —
+  the reference's ragged concatenation collapses to per-image rows.
+- matrix_nms is the ONE reference NMS that is embarrassingly parallel
+  (decay over a pairwise IoU matrix, no sequential suppression) — it
+  maps onto the TPU better than classic NMS: one (topk, topk) matrix
+  per class, no loop.
+- distribute_fpn_proposals returns per-level (R, 4) tensors padded to
+  the full roi count + per-level counts + RestoreIndex; collect reverses
+  it with score-ordered top-k.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from .registry import register_op
+
+
+def _one(ins, slot):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+def _iou_xyxy(a, b, normalized=True):
+    """Pairwise IoU of (n, 4) x (m, 4) corner boxes."""
+    off = 0.0 if normalized else 1.0
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + off, 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def _greedy_nms_mask(boxes, scores, thresh, max_out):
+    """Greedy NMS over score-sorted boxes: returns (order, keep_mask) with
+    at most max_out kept.  boxes (n, 4) corner form."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_xyxy(b, b)
+
+    def body(i, keep):
+        # suppressed if any higher-ranked KEPT box overlaps > thresh
+        sup = jnp.max(jnp.where(jnp.arange(n) < i,
+                                iou[i] * keep.astype(iou.dtype),
+                                0.0)) > thresh
+        return keep.at[i].set(jnp.where(sup, 0, 1))
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), jnp.int32))
+    # cap at max_out: rank among kept
+    kept_rank = jnp.cumsum(keep) - 1
+    keep = keep * (kept_rank < max_out)
+    return order, keep.astype(bool)
+
+
+@register_op("generate_proposals")
+def _generate_proposals(ins, attrs, op):
+    """ref detection/generate_proposals_op.cc (RPN stage).  Scores
+    (N, A, H, W), BboxDeltas (N, 4A, H, W), Anchors/Variances
+    (H, W, A, 4) or (A*H*W, 4), ImInfo (N, 3)."""
+    scores = _one(ins, "Scores")
+    deltas = _one(ins, "BboxDeltas")
+    im_info = _one(ins, "ImInfo")
+    anchors = _one(ins, "Anchors").reshape(-1, 4).astype(jnp.float32)
+    variances = _one(ins, "Variances")
+    variances = (variances.reshape(-1, 4).astype(jnp.float32)
+                 if variances is not None else jnp.ones_like(anchors))
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+
+    N, A, H, W = scores.shape
+    M = A * H * W
+    # (N, A, H, W) -> (N, H, W, A) -> flat, matching the kernel's
+    # transpose so flat index i maps to the same anchor row
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, M).astype(jnp.float32)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2) \
+        .reshape(N, M, 4).astype(jnp.float32)
+    pre_n = min(pre_n if pre_n > 0 else M, M)
+    post_n = min(post_n, pre_n)
+
+    def one_image(sc_i, dl_i, info):
+        top_sc, idx = jax.lax.top_k(sc_i, pre_n)
+        anc = anchors[idx]
+        var = variances[idx]
+        d = dl_i[idx]
+        # BoxCoder (generate_proposals_op.cc:69): +1 widths, var-scaled
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + 0.5 * aw
+        acy = anc[:, 1] + 0.5 * ah
+        kclip = jnp.log(1000.0 / 16.0)
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], kclip)) * aw
+        h = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], kclip)) * ah
+        props = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                           cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], -1)
+        # clip to image (im_info = (h, w, scale))
+        props = jnp.clip(props,
+                         jnp.zeros((4,)),
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        # min-size filter in ORIGINAL image scale (FilterBoxes,
+        # generate_proposals_op.cc:161: keep iff (x2-x1)/scale + 1 >= ms)
+        ms = jnp.maximum(min_size, 1.0)
+        keep_sz = ((props[:, 2] - props[:, 0]) / info[2] + 1.0 >= ms) & \
+            ((props[:, 3] - props[:, 1]) / info[2] + 1.0 >= ms)
+        sc_f = jnp.where(keep_sz, top_sc, -jnp.inf)
+        order, keep = _greedy_nms_mask(props, sc_f, nms_thresh, post_n)
+        ordered = props[order]
+        osc = sc_f[order]
+        okeep = keep & jnp.isfinite(osc)
+        tgt = jnp.cumsum(okeep) - 1
+        rois = jnp.zeros((post_n, 4), jnp.float32).at[
+            jnp.where(okeep, tgt, post_n)].set(ordered, mode="drop")
+        probs = jnp.zeros((post_n,), jnp.float32).at[
+            jnp.where(okeep, tgt, post_n)].set(
+            jnp.where(okeep, osc, 0.0), mode="drop")
+        return rois, probs[:, None], okeep.sum().astype(jnp.int64)
+
+    rois, probs, counts = jax.vmap(one_image)(sc, dl,
+                                              im_info.astype(jnp.float32))
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts], "RpnRoisLod": [jnp.cumsum(counts)]}
+
+
+@register_op("rpn_target_assign")
+def _rpn_target_assign(ins, attrs, op):
+    """ref rpn_target_assign_op.cc: per image, anchors >= pos_overlap IoU
+    with some gt (plus each gt's argmax anchor) are foreground,
+    < neg_overlap are background; subsample to rpn_batch_size_per_im at
+    rpn_fg_fraction.  Dense: Anchor (A, 4), GtBoxes (N, G, 4) (-row pad
+    with w<=0), outputs per-image padded index rows + counts."""
+    anchors = _one(ins, "Anchor").astype(jnp.float32)
+    gt = _one(ins, "GtBoxes").astype(jnp.float32)
+    if gt.ndim == 2:
+        gt = gt[None]
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    pos_th = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_th = float(attrs.get("rpn_negative_overlap", 0.3))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    use_random = bool(attrs.get("use_random", True))
+    A = anchors.shape[0]
+    fg_cap = int(batch * fg_frac)
+    key = _random.next_key()
+
+    def one_image(gt_i, key):
+        valid_gt = gt_i[:, 2] > gt_i[:, 0]
+        iou = _iou_xyxy(anchors, gt_i, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        a2g_max = iou.max(axis=1)
+        a2g_arg = iou.argmax(axis=1).astype(jnp.int32)
+        g2a_max = iou.max(axis=0)
+        # fg: >= pos_th, plus the argmax anchor of every gt
+        is_best = jnp.any((iou == g2a_max[None, :]) & (g2a_max[None, :] > 0)
+                          & valid_gt[None, :], axis=1)
+        fg = (a2g_max >= pos_th) | is_best
+        bg = (a2g_max < neg_th) & ~fg
+        kf, kb = jax.random.split(key)
+        rf = jax.random.uniform(kf, (A,))
+        rb = jax.random.uniform(kb, (A,))
+        if not use_random:
+            rf = jnp.arange(A) / A
+            rb = jnp.arange(A) / A
+        # random subsample: rank the candidates by a random draw and keep
+        # the first fg_cap / (batch - n_fg)
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rf, 2.0)))
+        fg_sel = fg & (fg_rank < fg_cap)
+        n_fg = fg_sel.sum()
+        bg_cap = batch - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rb, 2.0)))
+        bg_sel = bg & (bg_rank < bg_cap)
+
+        def compact(mask, fill):
+            tgt = jnp.cumsum(mask) - 1
+            out = jnp.full((batch,), fill, jnp.int32).at[
+                jnp.where(mask, tgt, batch)].set(
+                jnp.arange(A, dtype=jnp.int32), mode="drop")
+            return out
+
+        loc_index = compact(fg_sel, -1)
+        score_sel = fg_sel | bg_sel
+        score_index = compact(score_sel, -1)
+        tgt_lbl = jnp.zeros((batch,), jnp.int32).at[
+            jnp.where(fg_sel, jnp.cumsum(score_sel) - 1, batch)].set(
+            1, mode="drop")
+        gt_of_fg = jnp.full((batch,), -1, jnp.int32).at[
+            jnp.where(fg_sel, jnp.cumsum(fg_sel) - 1, batch)].set(
+            a2g_arg, mode="drop")
+        # TargetBBox carries the MATCHED GT BOXES (the reference's {-1,4}
+        # contract, rpn_target_assign_op.cc:76) ready for smooth-L1
+        target_bbox = jnp.where((gt_of_fg >= 0)[:, None],
+                                gt_i[jnp.maximum(gt_of_fg, 0)], 0.0)
+        return (loc_index, score_index, tgt_lbl, target_bbox, gt_of_fg,
+                n_fg.astype(jnp.int64), score_sel.sum().astype(jnp.int64))
+
+    N = gt.shape[0]
+    keys = jax.random.split(key, N)
+    loc, score, lbl, tbox, gtidx, nfg, nsc = jax.vmap(one_image)(gt, keys)
+    return {"LocationIndex": [loc], "ScoreIndex": [score],
+            "TargetLabel": [lbl], "TargetBBox": [tbox],
+            "MatchedGtIndex": [gtidx],
+            "BBoxInsideWeight": [jnp.broadcast_to(
+                (loc >= 0).astype(jnp.float32)[..., None],
+                tbox.shape)],
+            "ForegroundNumber": [nfg], "ScoreNumber": [nsc]}
+
+
+@register_op("matrix_nms")
+def _matrix_nms(ins, attrs, op):
+    """ref matrix_nms_op.cc: parallel soft-NMS — each box's score decays
+    by min over higher-ranked boxes of decay(iou, max_iou); no sequential
+    suppression, so it vectorizes as one (k, k) matrix per class.
+    Dense: BBoxes (N, M, 4), Scores (N, C, M); Out (N, keep_top_k, 6)
+    rows [class, score, x1, y1, x2, y2] zero-padded + RoisNum."""
+    bboxes = _one(ins, "BBoxes").astype(jnp.float32)
+    scores = _one(ins, "Scores").astype(jnp.float32)
+    score_th = float(attrs.get("score_threshold", 0.05))
+    post_th = float(attrs.get("post_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 200))
+    use_gaussian = bool(attrs.get("use_gaussian", False))
+    sigma = float(attrs.get("gaussian_sigma", 2.0))
+    background = int(attrs.get("background_label", 0))
+    normalized = bool(attrs.get("normalized", True))
+
+    N, C, M = scores.shape
+    k = min(nms_top_k if nms_top_k > 0 else M, M)
+
+    def one_class(boxes, sc):
+        top_sc, idx = jax.lax.top_k(sc, k)
+        valid = top_sc > score_th
+        b = boxes[idx]
+        iou = _iou_xyxy(b, b, normalized=normalized)
+        tri = jnp.tril(jnp.ones((k, k), bool), -1)  # j < i
+        iou_l = jnp.where(tri, iou, 0.0)
+        iou_max = jnp.max(iou_l, axis=1)            # max iou vs higher-ranked
+        if use_gaussian:
+            # ref matrix_nms_op.cc:83: exp((max_iou^2 - iou^2) * sigma)
+            decay = jnp.exp((iou_max[None, :] ** 2 - iou_l ** 2) * sigma)
+        else:
+            decay = (1.0 - iou_l) / jnp.maximum(1.0 - iou_max[None, :],
+                                                1e-10)
+        decay = jnp.where(tri, decay, 1.0)
+        min_decay = jnp.min(decay, axis=1)
+        ds = min_decay * top_sc
+        keep = valid & (ds > post_th)
+        return b, jnp.where(keep, ds, 0.0)
+
+    def one_image(boxes, sc_img):
+        bs, dss = jax.vmap(lambda s: one_class(boxes, s))(sc_img)  # (C,k,..)
+        cls = jnp.broadcast_to(jnp.arange(C, dtype=jnp.float32)[:, None],
+                               (C, k))
+        flat_ds = dss.reshape(-1)
+        if 0 <= background < C:
+            bg_mask = (cls.reshape(-1) == background)
+            flat_ds = jnp.where(bg_mask, 0.0, flat_ds)
+        keep_k = C * k if keep_top_k <= 0 else min(keep_top_k, C * k)
+        top_ds, fidx = jax.lax.top_k(flat_ds, keep_k)
+        out = jnp.concatenate([
+            cls.reshape(-1, 1)[fidx], top_ds[:, None],
+            bs.reshape(-1, 4)[fidx]], axis=1)
+        valid = top_ds > 0
+        out = jnp.where(valid[:, None], out, 0.0)
+        return out, valid.sum().astype(jnp.int64)
+
+    out, counts = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": [out], "Index": [jnp.zeros_like(counts)],
+            "RoisNum": [counts]}
+
+
+@register_op("box_decoder_and_assign")
+def _box_decoder_and_assign(ins, attrs, op):
+    """ref box_decoder_and_assign_op.h: decode per-class deltas against
+    shared priors (+1 widths, global 4-var), then assign each roi the box
+    of its argmax non-background class score."""
+    prior = _one(ins, "PriorBox").astype(jnp.float32)      # (R, 4)
+    pvar = _one(ins, "PriorBoxVar").astype(jnp.float32)    # (4,)
+    target = _one(ins, "TargetBox").astype(jnp.float32)    # (R, C*4)
+    score = _one(ins, "BoxScore").astype(jnp.float32)      # (R, C)
+    clip = float(attrs.get("box_clip", 4.135166556742356))
+    R, C = score.shape
+    t = target.reshape(R, C, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    dw = jnp.minimum(pvar[2] * t[:, :, 2], clip)
+    dh = jnp.minimum(pvar[3] * t[:, :, 3], clip)
+    cx = pvar[0] * t[:, :, 0] * pw[:, None] + pcx[:, None]
+    cy = pvar[1] * t[:, :, 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=-1)
+    decode_box = dec.reshape(R, C * 4)
+    # assign: argmax over classes 1..C-1 (0 = background)
+    sc = score.at[:, 0].set(-jnp.inf) if C > 1 else score
+    best = jnp.argmax(sc, axis=1)
+    assign = dec[jnp.arange(R), best]
+    return {"DecodeBox": [decode_box], "OutputAssignBox": [assign]}
+
+
+_FPN_EPS = 1e-6
+
+
+@register_op("distribute_fpn_proposals")
+def _distribute_fpn_proposals(ins, attrs, op):
+    """ref distribute_fpn_proposals_op.h: route each roi to FPN level
+    floor(refer_level + log2(sqrt(area)/refer_scale)), clipped to
+    [min_level, max_level].  Dense: FpnRois (R, 4) -> per-level (R, 4)
+    zero-padded + per-level counts + RestoreIndex."""
+    rois = _one(ins, "FpnRois").astype(jnp.float32)
+    min_l = int(attrs["min_level"])
+    max_l = int(attrs["max_level"])
+    refer_l = int(attrs["refer_level"])
+    refer_s = int(attrs["refer_scale"])
+    num_l = max_l - min_l + 1
+    R = rois.shape[0]
+    valid = (rois[:, 2] > rois[:, 0]) | (rois[:, 3] > rois[:, 1])
+    # BBoxArea(rois, normalized=false): +1 widths
+    # (distribute_fpn_proposals_op.h:32)
+    area = jnp.maximum(rois[:, 2] - rois[:, 0] + 1, 0) * \
+        jnp.maximum(rois[:, 3] - rois[:, 1] + 1, 0)
+    scale = jnp.sqrt(area)
+    lvl = jnp.floor(jnp.log2(scale / refer_s + _FPN_EPS)) + refer_l
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, -1)
+
+    outs, counts, restore_parts = [], [], []
+    offset = jnp.zeros((), jnp.int32)
+    restore = jnp.full((R,), -1, jnp.int32)
+    for li, level in enumerate(range(min_l, max_l + 1)):
+        mask = lvl == level
+        tgt = jnp.cumsum(mask) - 1
+        out = jnp.zeros((R, 4), jnp.float32).at[
+            jnp.where(mask, tgt, R)].set(rois, mode="drop")
+        outs.append(out)
+        n = mask.sum().astype(jnp.int32)
+        counts.append(n.astype(jnp.int64))
+        # original position i of this level's row r sits at offset+r in
+        # the concatenated-by-level order; RestoreIndex maps back
+        restore = restore.at[jnp.where(mask, offset + tgt, R)].set(
+            jnp.arange(R, dtype=jnp.int32), mode="drop")
+        offset = offset + n
+    return {"MultiFpnRois": outs,
+            "MultiLevelRoIsNum": [jnp.stack(counts)],
+            "RestoreIndex": [restore[:, None]]}
+
+
+@register_op("collect_fpn_proposals")
+def _collect_fpn_proposals(ins, attrs, op):
+    """ref collect_fpn_proposals_op.h: concat per-level rois+scores, keep
+    the global top post_nms_topN by score.  Dense: each level zero-padded
+    (R_l, 4) + per-level valid counts via MultiLevelRoIsNum."""
+    rois_list = ins.get("MultiLevelRois", [])
+    scores_list = ins.get("MultiLevelScores", [])
+    counts = _one(ins, "MultiLevelRoIsNum")
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    all_rois = jnp.concatenate([r.reshape(-1, 4) for r in rois_list], 0)
+    all_scores = jnp.concatenate([s.reshape(-1) for s in scores_list], 0)
+    if counts is not None:
+        masks = []
+        for i, r in enumerate(rois_list):
+            n = counts[i]
+            masks.append(jnp.arange(r.reshape(-1, 4).shape[0]) < n)
+        m = jnp.concatenate(masks)
+        all_scores = jnp.where(m, all_scores, -jnp.inf)
+    k = min(post_n, all_scores.shape[0])
+    top_sc, idx = jax.lax.top_k(all_scores, k)
+    sel = all_rois[idx]
+    valid = jnp.isfinite(top_sc)
+    sel = jnp.where(valid[:, None], sel, 0.0)
+    return {"FpnRois": [sel],
+            "RoisNum": [valid.sum().astype(jnp.int64)]}

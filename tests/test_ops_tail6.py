@@ -1,0 +1,281 @@
+"""Batch-6 static ops: the RCNN/FPN detection tail (see
+static/ops_tail6.py per-op reference files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(66)
+
+
+def _iou(a, b, off=0.0):
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1) + off)
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1) + off)
+    inter = iw * ih
+    ua = (ax2 - ax1 + off) * (ay2 - ay1 + off) \
+        + (bx2 - bx1 + off) * (by2 - by1 + off) - inter
+    return inter / max(ua, 1e-10)
+
+
+# -- generate_proposals -------------------------------------------------------
+
+def test_generate_proposals_basic():
+    N, A, H, W = 1, 3, 4, 4
+    M = A * H * W
+    scores = RNG.uniform(0, 1, (N, A, H, W)).astype(np.float32)
+    deltas = (RNG.normal(0, 0.1, (N, 4 * A, H, W))).astype(np.float32)
+    # anchors tiled over the grid, (H, W, A, 4)
+    base = np.array([[0, 0, 15, 15], [4, 4, 11, 11], [2, 2, 13, 13]],
+                    np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            anchors[i, j] = base + np.array([j * 4, i * 4, j * 4, i * 4])
+    variances = np.ones_like(anchors)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+
+    rois, probs, num = _run_single_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"pre_nms_topN": M, "post_nms_topN": 8, "nms_thresh": 0.7,
+         "min_size": 1.0},
+        out_slots=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+    n = int(num[0])
+    assert 1 <= n <= 8
+    # valid rois are inside the image and properly ordered corners
+    v = rois[0, :n]
+    assert (v[:, 0] <= v[:, 2]).all() and (v[:, 1] <= v[:, 3]).all()
+    assert (v >= 0).all() and (v <= 63).all()
+    # probs sorted descending over the valid prefix
+    p = probs[0, :n, 0]
+    assert (np.diff(p) <= 1e-6).all()
+    # pad region zeroed
+    np.testing.assert_allclose(rois[0, n:], 0)
+    # kept boxes mutually below the NMS threshold
+    for i in range(n):
+        for j in range(i):
+            assert _iou(v[i], v[j]) <= 0.7 + 1e-5
+
+
+# -- rpn_target_assign --------------------------------------------------------
+
+def test_rpn_target_assign_labels():
+    import paddle_tpu
+
+    paddle_tpu.seed(5)
+    # anchors: 4 perfectly matching gt, 4 far away
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [40, 40, 50, 50], [60, 60, 70, 70],
+                        [200, 200, 210, 210], [220, 220, 230, 230],
+                        [240, 240, 250, 250], [260, 260, 270, 270]],
+                       np.float32)
+    gt = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    loc, score, lbl, tbox, gtidx, nfg, nsc = _run_single_op(
+        "rpn_target_assign", {"Anchor": anchors, "GtBoxes": gt},
+        {"rpn_batch_size_per_im": 6, "rpn_positive_overlap": 0.7,
+         "rpn_negative_overlap": 0.3, "rpn_fg_fraction": 0.5,
+         "use_random": False},
+        out_slots=("LocationIndex", "ScoreIndex", "TargetLabel",
+                   "TargetBBox", "MatchedGtIndex", "ForegroundNumber",
+                   "ScoreNumber"))
+    n_fg = int(nfg[0])
+    assert n_fg == 2  # the two exact matches
+    fg_anchors = set(loc[0, :n_fg].tolist())
+    assert fg_anchors == {0, 1}
+    # all sampled background anchors are non-overlapping ones (2..7)
+    n_sc = int(nsc[0])
+    sampled = score[0, :n_sc].tolist()
+    bgs = [a for a in sampled if a not in fg_anchors]
+    assert bgs and all(a >= 2 for a in bgs)
+    # labels: 1 for fg slots, 0 elsewhere in the sampled prefix
+    assert int(lbl[0].sum()) == n_fg
+    # gt mapping of fg anchors: anchor 0 -> gt 0, anchor 1 -> gt 1, and
+    # TargetBBox carries the MATCHED GT BOX COORDINATES (reference {-1,4})
+    assert gtidx[0, :n_fg].tolist() == [0, 1]
+    np.testing.assert_allclose(tbox[0, :n_fg], gt[0, :2])
+    np.testing.assert_allclose(tbox[0, n_fg:], 0)
+
+
+# -- matrix_nms ---------------------------------------------------------------
+
+def _matrix_nms_oracle(boxes, scores, score_th, post_th, top_k,
+                       use_gaussian, sigma):
+    """Direct transcription of NMSMatrix (matrix_nms_op.cc)."""
+    order = np.argsort(-scores, kind="stable")
+    order = [i for i in order if scores[i] > score_th][:top_k]
+    if not order:
+        return [], []
+    iou_max = [0.0]
+    ious = {}
+    for i in range(1, len(order)):
+        mx = 0.0
+        for j in range(i):
+            iou = _iou(boxes[order[i]], boxes[order[j]])
+            ious[(i, j)] = iou
+            mx = max(mx, iou)
+        iou_max.append(mx)
+    sel, ds_out = [], []
+    if scores[order[0]] > post_th:
+        sel.append(order[0])
+        ds_out.append(scores[order[0]])
+    for i in range(1, len(order)):
+        min_decay = 1.0
+        for j in range(i):
+            iou = ious[(i, j)]
+            if use_gaussian:
+                # ref matrix_nms_op.cc:83: MULTIPLY by sigma
+                decay = np.exp((iou_max[j] ** 2 - iou ** 2) * sigma)
+            else:
+                decay = (1.0 - iou) / (1.0 - iou_max[j])
+            min_decay = min(min_decay, decay)
+        ds = min_decay * scores[order[i]]
+        if ds > post_th:
+            sel.append(order[i])
+            ds_out.append(ds)
+    return sel, ds_out
+
+
+@pytest.mark.parametrize("use_gaussian", [False, True])
+def test_matrix_nms_matches_reference_decay(use_gaussian):
+    M, C = 12, 3
+    boxes = np.zeros((1, M, 4), np.float32)
+    ctr = RNG.uniform(10, 90, (M, 2))
+    wh = RNG.uniform(8, 20, (M, 2))
+    boxes[0, :, 0] = ctr[:, 0] - wh[:, 0]
+    boxes[0, :, 1] = ctr[:, 1] - wh[:, 1]
+    boxes[0, :, 2] = ctr[:, 0] + wh[:, 0]
+    boxes[0, :, 3] = ctr[:, 1] + wh[:, 1]
+    scores = RNG.uniform(0, 1, (1, C, M)).astype(np.float32)
+    out, _, num = _run_single_op(
+        "matrix_nms", {"BBoxes": boxes, "Scores": scores},
+        {"score_threshold": 0.2, "post_threshold": 0.1, "nms_top_k": M,
+         "keep_top_k": 20, "use_gaussian": use_gaussian,
+         "gaussian_sigma": 2.0, "background_label": 0},
+        out_slots=("Out", "Index", "RoisNum"))
+    # oracle: classes 1..C-1, global sort by decayed score
+    expect = []
+    for c in range(1, C):
+        sel, ds = _matrix_nms_oracle(boxes[0], scores[0, c], 0.2, 0.1, M,
+                                     use_gaussian, 2.0)
+        expect += [(float(d), c, tuple(boxes[0, i])) for i, d in
+                   zip(sel, ds)]
+    expect.sort(key=lambda t: -t[0])
+    n = int(num[0])
+    assert n == len(expect)
+    got = out[0, :n]
+    np.testing.assert_allclose(got[:, 1], [e[0] for e in expect],
+                               rtol=1e-4)
+    np.testing.assert_array_equal(got[:, 0].astype(int),
+                                  [e[1] for e in expect])
+
+
+# -- box_decoder_and_assign ---------------------------------------------------
+
+def test_box_decoder_and_assign():
+    R, C = 4, 3
+    prior = np.array([[0, 0, 9, 9]] * R, np.float32)
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    target = RNG.normal(0, 0.5, (R, C * 4)).astype(np.float32)
+    score = RNG.uniform(0, 1, (R, C)).astype(np.float32)
+    dec, assign = _run_single_op(
+        "box_decoder_and_assign",
+        {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": target,
+         "BoxScore": score}, {"box_clip": 4.135},
+        out_slots=("DecodeBox", "OutputAssignBox"))
+    # oracle for roi 0, class 1
+    t = target.reshape(R, C, 4)
+    pw = ph = 10.0          # x2 - x1 + 1 (the reference's +1 widths)
+    pcx = pcy = 5.0         # x1 + w/2
+    j = 1
+    dw = min(pvar[2] * t[0, j, 2], 4.135)
+    dh = min(pvar[3] * t[0, j, 3], 4.135)
+    cx = pvar[0] * t[0, j, 0] * pw + pcx
+    cy = pvar[1] * t[0, j, 1] * ph + pcy
+    w, h = np.exp(dw) * pw, np.exp(dh) * ph
+    expect = [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1]
+    np.testing.assert_allclose(dec[0, 4 * j:4 * j + 4], expect, rtol=1e-5)
+    # assign picks the argmax NON-background class's box
+    best = np.argmax(score[:, 1:], axis=1) + 1
+    for r in range(R):
+        np.testing.assert_allclose(
+            assign[r], dec[r, 4 * best[r]:4 * best[r] + 4], rtol=1e-5)
+
+
+# -- FPN distribute / collect -------------------------------------------------
+
+def test_distribute_and_collect_fpn_proposals():
+    # rois sized to land on levels 2, 3, 4 (refer level 3 @ scale 224)
+    rois = np.array([
+        [0, 0, 112, 112],     # sqrt(area)=112 -> level 2
+        [0, 0, 224, 224],     # level 3
+        [0, 0, 448, 448],     # level 4
+        [0, 0, 100, 125],     # ~112 -> level 2
+    ], np.float32)
+    outs = _run_single_op(
+        "distribute_fpn_proposals", {"FpnRois": rois},
+        {"min_level": 2, "max_level": 4, "refer_level": 3,
+         "refer_scale": 224},
+        out_slots=("MultiFpnRois", "MultiLevelRoIsNum", "RestoreIndex"),
+        n_out={"MultiFpnRois": 3, "MultiLevelRoIsNum": 1,
+               "RestoreIndex": 1})
+    l2, l3, l4, counts, restore = outs
+    np.testing.assert_array_equal(counts, [2, 1, 1])
+    np.testing.assert_allclose(l2[:2], rois[[0, 3]])
+    np.testing.assert_allclose(l3[0], rois[1])
+    np.testing.assert_allclose(l4[0], rois[2])
+    # restore maps concatenated-by-level order back to the original
+    np.testing.assert_array_equal(restore.ravel(), [0, 3, 1, 2])
+
+    # collect: inverse with score-ordered top-k
+    scores = [np.array([0.9, 0.5, 0, 0], np.float32),
+              np.array([0.7, 0, 0, 0], np.float32),
+              np.array([0.8, 0, 0, 0], np.float32)]
+    rois_lvls = [l2, l3, l4]
+    sel, num = _run_single_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": rois_lvls, "MultiLevelScores": scores,
+         "MultiLevelRoIsNum": np.array([2, 1, 1], np.int64)},
+        {"post_nms_topN": 3}, out_slots=("FpnRois", "RoisNum"))
+    assert int(np.asarray(num).ravel()[0]) == 3
+    # top-3 by score: l2[0] (0.9), l4[0] (0.8), l3[0] (0.7)
+    np.testing.assert_allclose(sel[0], rois[0])
+    np.testing.assert_allclose(sel[1], rois[2])
+    np.testing.assert_allclose(sel[2], rois[1])
+
+
+def test_generate_proposals_min_size_respects_im_scale():
+    """FilterBoxes contract: keep iff (x2-x1)/scale + 1 >= min_size —
+    the +1 applies in ORIGINAL image space (review r05 regression)."""
+    N, A, H, W = 1, 1, 1, 1
+    scores = np.ones((N, A, H, W), np.float32)
+    deltas = np.zeros((N, 4, H, W), np.float32)
+    # anchor decodes to itself: width 4 px in scaled space
+    anchors = np.array([[[[0, 0, 3, 3]]]], np.float32).reshape(1, 1, 1, 4)
+    variances = np.ones_like(anchors)
+    # scale 2.0: original width = 3/2 + 1 = 2.5 -> min_size 2 keeps it,
+    # min_size 3 drops it
+    im_info = np.array([[64.0, 64.0, 2.0]], np.float32)
+    for ms, expect in ((2.0, 1), (3.0, 0)):
+        _, _, num = _run_single_op(
+            "generate_proposals",
+            {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+             "Anchors": anchors, "Variances": variances},
+            {"pre_nms_topN": 1, "post_nms_topN": 1, "nms_thresh": 0.7,
+             "min_size": ms},
+            out_slots=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+        assert int(num[0]) == expect, (ms, int(num[0]))
+
+
+def test_matrix_nms_keep_top_k_minus_one_keeps_all():
+    boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.9, 0.8], [0.7, 0.6]]], np.float32)  # C=2
+    out, _, num = _run_single_op(
+        "matrix_nms", {"BBoxes": boxes, "Scores": scores},
+        {"score_threshold": 0.1, "post_threshold": 0.1, "nms_top_k": -1,
+         "keep_top_k": -1, "background_label": -1},
+        out_slots=("Out", "Index", "RoisNum"))
+    assert int(num[0]) == 4  # both boxes for both classes survive
