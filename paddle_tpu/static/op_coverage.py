@@ -120,27 +120,22 @@ DESCOPED = {
     "similarity_focus": "host: contrib attention-visualization op with "
                         "serial per-channel dedup semantics; no model in "
                         "the reference zoo consumes it",
-    "tdm_child": "host: Baidu TDM tree-index serving; the tree lives in "
-                 "host RAM next to the PS tables (re-scope: gather on "
-                 "a host-side numpy tree, same as SparseTable)",
-    "tdm_sampler": "host: same TDM tree, layer-wise negative sampling",
-    "match_matrix_tensor": "host: contrib text-matching op used only by "
-                           "the (deleted upstream) MatchMatrix models",
-    "sequence_topk_avg_pooling": "host: contrib op paired with "
-                                 "match_matrix_tensor",
+    "tdm_child": None,  # registered in ops_tail7
+    "tdm_sampler": None,  # registered in ops_tail7
+    "match_matrix_tensor": None,  # registered in ops_tail7
+    "sequence_topk_avg_pooling": None,  # registered in ops_tail7
     "var_conv_2d": None,  # registered in ops_tail3
     # -- detection label-generation (RCNN/RetinaNet training pipelines) ---
     "generate_proposals": None,  # registered in ops_tail6
     "generate_proposal_labels": "host: RCNN proposal-label sampling (ragged per-image fg/bg subsample + gather); the stages around it (generate_proposals, rpn_target_assign, FPN routing) ARE registered (ops_tail6) — this one remains host-side data prep",
     "generate_mask_labels": "host: Mask R-CNN label crops, same host-side data-prep class as generate_proposal_labels",
     "rpn_target_assign": None,    # registered in ops_tail6
-    "retinanet_target_assign": "host: RetinaNet variant of the registered rpn_target_assign (adds per-level anchor flattening); host-side data prep",
+    "retinanet_target_assign": None,  # registered in ops_tail7
     "retinanet_detection_output": "host: per-level top-k + NMS decode; the registered multiclass_nms/matrix_nms + yolo_box-style decode cover the math",
     "distribute_fpn_proposals": None,  # registered in ops_tail6
     "collect_fpn_proposals": None,     # registered in ops_tail6
     "box_decoder_and_assign": None,  # registered in ops_tail6
-    "deformable_psroi_pooling": "host: psroi_pool + deformable_conv "
-                                "eager ops cover the components",
+    "deformable_psroi_pooling": None,  # registered in ops_tail7
     "locality_aware_nms": "host: OCR-specific NMS variant of the "
                           "registered multiclass_nms",
     "matrix_nms": None,           # registered in ops_tail6

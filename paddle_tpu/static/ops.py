@@ -1059,3 +1059,4 @@ from . import ops_tail3  # noqa: E402,F401 — batch-3 lowerings (registry side 
 from . import ops_tail4  # noqa: E402,F401 — batch-4 lowerings (registry side effects)
 from . import ops_tail5  # noqa: E402,F401 — batch-5 lowerings (registry side effects)
 from . import ops_tail6  # noqa: E402,F401 — batch-6 lowerings (registry side effects)
+from . import ops_tail7  # noqa: E402,F401 — batch-7 lowerings (registry side effects)

@@ -1,0 +1,348 @@
+"""Static-op long tail, batch 7: the remaining contrib/detection
+re-scopes that were still rationale-only in op_coverage.py.
+
+Reference parity targets: tdm_child_op.h / tdm_sampler_op.h (Baidu TDM
+tree-index recall: children gather + layer-wise negative sampling),
+match_matrix_tensor_op.cc (text-matching bilinear similarity cube),
+sequence_ops/sequence_topk_avg_pooling_op.h (per-channel top-k average
+over a (row x col) similarity grid), retinanet_target_assign_op.cc (the
+no-subsample RetinaNet variant of rpn_target_assign), and
+deformable_psroi_pooling_op.h (position-sensitive RoI pooling with
+learned per-part offsets).
+
+TPU-native notes: everything static-shaped on the batch-4 padded+count
+contract.  The TDM tree (TreeInfo/Travel/Layer tensors) is DATA, so the
+"host-side tree" rationale collapses — gathers against those tensors jit
+fine; tdm_sampler draws its negatives from the executor's per-op PRNG
+scope (deterministic under `paddle_tpu.seed`), with the reference's
+skip-the-positive trick (draw from n-1 then shift past the positive).
+match_matrix_tensor / sequence_topk_avg_pooling take the dense
+(B, L, ...) + length layout every sequence op in this rebuild uses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from .registry import register_op
+from .ops_tail6 import _iou_xyxy
+
+
+def _one(ins, slot):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+# =========================================================================
+# TDM (tree-based deep match) index ops
+# =========================================================================
+
+@register_op("tdm_child")
+def _tdm_child(ins, attrs, op):
+    """ref tdm_child_op.h: TreeInfo rows are
+    [item_id, layer_id, ancestor_id, child_0..child_{n-1}]; for each
+    input node emit its child ids and a leaf mask (child's item_id != 0);
+    nodes with id 0 or no first child emit zeros."""
+    x = _one(ins, "X")
+    info = _one(ins, "TreeInfo")
+    n = int(attrs.get("child_nums", 1))
+    shape = x.shape
+    ids = x.reshape(-1).astype(jnp.int32)
+    children = info[ids, 3:3 + n].astype(jnp.int32)      # (M, n)
+    has_child = (ids != 0) & (info[ids, 3] != 0)
+    children = jnp.where(has_child[:, None], children, 0)
+    is_item = (info[children.reshape(-1), 0] != 0).astype(jnp.int32)
+    mask = jnp.where(has_child[:, None], is_item.reshape(children.shape), 0)
+    out_shape = shape + (n,)
+    return {"Child": [children.reshape(out_shape)],
+            "LeafMask": [mask.reshape(out_shape)]}
+
+
+@register_op("tdm_sampler")
+def _tdm_sampler(ins, attrs, op):
+    """ref tdm_sampler_op.h: per input item, per tree layer, emit the
+    positive ancestor (Travel[i, layer]) plus neg_samples_num_list[layer]
+    uniform negatives from that layer's node list (Layer tensor sliced by
+    layer_offset_lod), never colliding with the positive."""
+    x = _one(ins, "X")
+    travel = _one(ins, "Travel").astype(jnp.int32)    # (items, layers)
+    layer = _one(ins, "Layer").reshape(-1).astype(jnp.int32)
+    negs = [int(v) for v in attrs["neg_samples_num_list"]]
+    offsets = [int(v) for v in attrs["layer_offset_lod"]]
+    out_pos = bool(attrs.get("output_positive", True))
+    ids = x.reshape(-1).astype(jnp.int32)
+    M = ids.shape[0]
+    key = _random.next_key()
+
+    outs, labels, masks = [], [], []
+    for li, neg in enumerate(negs):
+        lo, hi = offsets[li], offsets[li + 1]
+        layer_n = hi - lo
+        pos = travel[ids, li]                          # (M,)
+        # padding items (id 0 with travel 0) are masked out
+        valid = pos != 0
+        if out_pos:
+            outs.append(pos[:, None])
+            labels.append(jnp.ones((M, 1), jnp.int32))
+            masks.append(valid.astype(jnp.int32)[:, None])
+        if neg > 0:
+            key, sub = jax.random.split(key)
+            draw = jax.random.randint(sub, (M, neg), 0,
+                                      max(layer_n - 1, 1))
+            # skip-the-positive: values >= pos's slot shift up by one
+            pos_slot = jnp.argmax(
+                (layer[lo:hi][None, :] == pos[:, None]), axis=1)
+            draw = jnp.where(draw >= pos_slot[:, None], draw + 1, draw)
+            draw = jnp.clip(draw, 0, layer_n - 1)
+            neg_ids = layer[lo + draw]
+            outs.append(neg_ids)
+            labels.append(jnp.zeros((M, neg), jnp.int32))
+            masks.append(jnp.broadcast_to(valid.astype(jnp.int32)[:, None],
+                                          (M, neg)))
+    out = jnp.concatenate(outs, axis=1)
+    lab = jnp.concatenate(labels, axis=1)
+    msk = jnp.concatenate(masks, axis=1)
+    out = out * msk
+    lab = lab * msk
+    return {"Out": [out], "Labels": [lab], "Mask": [msk]}
+
+
+# =========================================================================
+# text matching contrib pair
+# =========================================================================
+
+@register_op("match_matrix_tensor")
+def _match_matrix_tensor(ins, attrs, op):
+    """ref match_matrix_tensor_op.cc: per (left token i, right token j,
+    channel t) similarity  out[b, t, i, j] = x_i . W_t . y_j.  Dense:
+    X (B, Lx, D), Y (B, Ly, D), W (D, dim_t, D); lengths mask the pads."""
+    x = _one(ins, "X").astype(jnp.float32)
+    y = _one(ins, "Y").astype(jnp.float32)
+    w = _one(ins, "W").astype(jnp.float32)
+    xlen = _one(ins, "XLength")
+    ylen = _one(ins, "YLength")
+    # stage x.W once (the reference's Tmp buffer), derive Out from it —
+    # the (B, Lx, D)x(D, T, D) contraction is the op's dominant FLOPs
+    tmp = jnp.einsum("bid,dte->bite", x, w)
+    out = jnp.einsum("bite,bje->btij", tmp, y)
+    if xlen is not None:
+        mi = jnp.arange(x.shape[1])[None, :] < xlen.astype(jnp.int32)[:, None]
+        out = out * mi[:, None, :, None]
+    if ylen is not None:
+        mj = jnp.arange(y.shape[1])[None, :] < ylen.astype(jnp.int32)[:, None]
+        out = out * mj[:, None, None, :]
+    return {"Out": [out], "Tmp": [tmp]}
+
+
+@register_op("sequence_topk_avg_pooling")
+def _sequence_topk_avg_pooling(ins, attrs, op):
+    """ref sequence_topk_avg_pooling_op.h: X is a (row x col) score grid
+    per (batch, channel); for each ROW position, average its top-k column
+    scores for every k in `topks`.  Dense: X (B, C, R, Cl) + RowLength /
+    ColLength masks -> Out (B, R, C * len(topks)) (row-major channel/k
+    like the reference's channel_num * k_num feature layout)."""
+    x = _one(ins, "X").astype(jnp.float32)
+    row_len = _one(ins, "RowLength")
+    col_len = _one(ins, "ColLength")
+    topks = [int(v) for v in attrs["topks"]]
+    B, C, R, Cl = x.shape
+    max_k = min(max(topks), Cl)
+    neg = jnp.asarray(-1e30, x.dtype)
+    if col_len is not None:
+        cm = jnp.arange(Cl)[None, :] < col_len.astype(jnp.int32)[:, None]
+        x = jnp.where(cm[:, None, None, :], x, neg)
+    top = jax.lax.top_k(x, max_k)[0]                    # (B, C, R, max_k)
+    top = jnp.where(top <= neg / 2, 0.0, top)           # masked cols -> 0
+    csum = jnp.cumsum(top, axis=-1)
+    feats = []
+    for k in topks:
+        kk = min(k, max_k)
+        feats.append(csum[..., kk - 1] / float(k))      # (B, C, R)
+    out = jnp.stack(feats, axis=2)                      # (B, C, K, R)
+    out = out.transpose(0, 3, 1, 2).reshape(B, R, C * len(topks))
+    if row_len is not None:
+        rm = jnp.arange(R)[None, :] < row_len.astype(jnp.int32)[:, None]
+        out = out * rm[..., None]
+    return {"Out": [out], "pos": [jnp.zeros((B, R, 1), jnp.int32)]}
+
+
+# =========================================================================
+# RetinaNet target assign (the no-subsample rpn variant)
+# =========================================================================
+
+@register_op("retinanet_target_assign")
+def _retinanet_target_assign(ins, attrs, op):
+    """ref retinanet_target_assign_op.cc: like rpn_target_assign but
+    WITHOUT fg/bg subsampling (focal loss consumes every anchor): fg =
+    IoU >= positive_overlap (plus each gt's best anchor), bg =
+    IoU < negative_overlap; TargetLabel carries the matched gt CLASS at
+    foreground slots and 0 elsewhere (the reference's convention — the
+    focal-loss consumer maps 0 to background itself)."""
+    anchors = _one(ins, "Anchor").astype(jnp.float32)
+    gt = _one(ins, "GtBoxes").astype(jnp.float32)
+    gt_labels = _one(ins, "GtLabels")
+    pos_th = float(attrs.get("positive_overlap", 0.5))
+    neg_th = float(attrs.get("negative_overlap", 0.4))
+    if gt.ndim == 2:
+        gt = gt[None]
+        gt_labels = gt_labels[None]
+    A = anchors.shape[0]
+
+    def one_image(gt_i, lbl_i):
+        valid_gt = gt_i[:, 2] > gt_i[:, 0]
+        iou = _iou_xyxy(anchors, gt_i, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        a2g_max = iou.max(axis=1)
+        a2g_arg = iou.argmax(axis=1).astype(jnp.int32)
+        g2a_max = iou.max(axis=0)
+        is_best = jnp.any((iou == g2a_max[None, :]) & (g2a_max[None, :] > 0)
+                          & valid_gt[None, :], axis=1)
+        fg = (a2g_max >= pos_th) | is_best
+        bg = (a2g_max < neg_th) & ~fg
+
+        def compact(mask):
+            tgt = jnp.cumsum(mask) - 1
+            return jnp.full((A,), -1, jnp.int32).at[
+                jnp.where(mask, tgt, A)].set(
+                jnp.arange(A, dtype=jnp.int32), mode="drop")
+
+        loc_index = compact(fg)
+        score_sel = fg | bg
+        score_index = compact(score_sel)
+        # label = the matched gt's class for fg, 0 otherwise; padded rows
+        # of the sampled prefix carry 0 (focal-loss background handling
+        # is the consumer's num_classes convention)
+        cls = lbl_i.reshape(-1).astype(jnp.int32)[a2g_arg]
+        tgt_lbl = jnp.zeros((A,), jnp.int32).at[
+            jnp.where(fg, jnp.cumsum(score_sel) - 1, A)].set(
+            cls, mode="drop")
+        tbox = jnp.zeros((A, 4), jnp.float32).at[
+            jnp.where(fg, jnp.cumsum(fg) - 1, A)].set(
+            gt_i[a2g_arg] * fg[:, None], mode="drop")
+        return (loc_index, score_index, tgt_lbl, tbox,
+                fg.sum().astype(jnp.int64),
+                score_sel.sum().astype(jnp.int64))
+
+    loc, score, lbl, tbox, nfg, nsc = jax.vmap(one_image)(gt, gt_labels)
+    return {"LocationIndex": [loc], "ScoreIndex": [score],
+            "TargetLabel": [lbl], "TargetBBox": [tbox],
+            "BBoxInsideWeight": [jnp.broadcast_to(
+                (loc >= 0).astype(jnp.float32)[..., None], tbox.shape)],
+            "ForegroundNumber": [nfg], "ScoreNumber": [nsc]}
+
+
+# =========================================================================
+# deformable PS-RoI pooling
+# =========================================================================
+
+def _pair_attr(attrs, name, default):
+    v = attrs.get(name, default)
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+@register_op("deformable_psroi_pooling")
+def _deformable_psroi_pooling(ins, attrs, op):
+    """ref deformable_psroi_pooling_op.h: position-sensitive RoI pooling
+    where each output part's sampling window shifts by a learned offset
+    (Trans (R, 2*num_classes, part_h, part_w) scaled by trans_std).
+    Reference attrs: pooled_height/pooled_width ints, group_size and
+    part_size vector<int> pairs.  Dense: Input (N, C, H, W) with
+    C = output_dim * group_h * group_w group-ordered, ROIs (R, 5)
+    [batch_idx, x1, y1, x2, y2].  Sampling matches the kernel exactly:
+    w = wstart + iw*sub_bin (no half-offset), samples outside
+    (-0.5, dim-0.5) skipped, survivors clamped to [0, dim-1]."""
+    x = _one(ins, "Input").astype(jnp.float32)
+    rois = _one(ins, "ROIs").astype(jnp.float32)
+    trans = _one(ins, "Trans")
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs["output_dim"])
+    group_h, group_w = _pair_attr(attrs, "group_size", 1)
+    pooled_h = int(attrs.get("pooled_height",
+                             _pair_attr(attrs, "pooled_size", 1)[0]))
+    pooled_w = int(attrs.get("pooled_width",
+                             _pair_attr(attrs, "pooled_size", 1)[1]))
+    part_h_n, part_w_n = _pair_attr(attrs, "part_size",
+                                    (pooled_h, pooled_w))
+    spp = int(attrs.get("sample_per_part", 4))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    num_classes = 1
+    if trans is not None and not no_trans:
+        num_classes = max(int(trans.shape[1]) // 2, 1)
+    channels_each_class = max(out_dim // num_classes, 1)
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        # reference: roi corners snapped to a 0.5-aligned grid
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pooled_w
+        bin_h = rh / pooled_h
+        sub_w = bin_w / spp
+        sub_h = bin_h / spp
+        PH, PW = jnp.meshgrid(jnp.arange(pooled_h), jnp.arange(pooled_w),
+                              indexing="ij")             # (ph, pw)
+        part_h = (PH * part_h_n) // pooled_h
+        part_w = (PW * part_w_n) // pooled_w
+        d = jnp.arange(out_dim)
+        class_id = d // channels_each_class              # (out_dim,)
+        if no_trans or tr is None:
+            off_x = jnp.zeros((out_dim, pooled_h, pooled_w))
+            off_y = jnp.zeros((out_dim, pooled_h, pooled_w))
+        else:
+            off_x = tr[class_id * 2, part_h[None], part_w[None]] \
+                * trans_std * rw
+            off_y = tr[class_id * 2 + 1, part_h[None], part_w[None]] \
+                * trans_std * rh
+        # sample grid (out_dim, ph, pw, spp, spp): w = wstart + iw*sub
+        sx = x1 + PW[None, ..., None, None] * bin_w \
+            + off_x[..., None, None] \
+            + jnp.arange(spp)[None, None, None, None, :] * sub_w
+        sy = y1 + PH[None, ..., None, None] * bin_h \
+            + off_y[..., None, None] \
+            + jnp.arange(spp)[None, None, None, :, None] * sub_h
+        inside = (sx >= -0.5) & (sx <= W - 0.5) & \
+            (sy >= -0.5) & (sy <= H - 0.5)
+        sx = jnp.clip(sx, 0.0, W - 1.0)
+        sy = jnp.clip(sy, 0.0, H - 1.0)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        fx = sx - x0
+        fy = sy - y0
+        # channel layout: c = (d * group_h + gh) * group_w + gw
+        gh = jnp.clip((PH * group_h) // pooled_h, 0, group_h - 1)
+        gw = jnp.clip((PW * group_w) // pooled_w, 0, group_w - 1)
+        cidx = (d[:, None, None] * group_h + gh[None]) * group_w + gw[None]
+        feat = x[b]                                       # (C, H, W)
+
+        def g(yi, xi):
+            return feat[cidx[:, :, :, None, None], yi, xi]
+
+        val = (g(y0, x0) * ((1 - fy) * (1 - fx))
+               + g(y0, x1i) * ((1 - fy) * fx)
+               + g(y1i, x0) * (fy * (1 - fx))
+               + g(y1i, x1i) * (fy * fx))
+        val = val * inside
+        cnt = jnp.maximum(inside.sum(axis=(-2, -1)), 1)
+        return val.sum(axis=(-2, -1)) / cnt               # (out_dim, ph, pw)
+
+    trans_r = (None if trans is None else
+               trans.astype(jnp.float32).reshape(
+                   R, 2 * num_classes, part_h_n, part_w_n))
+    if trans_r is None:
+        out = jax.vmap(lambda r: one_roi(r, None))(rois)
+    else:
+        out = jax.vmap(one_roi)(rois, trans_r)
+    return {"Output": [out], "TopCount": [jnp.ones_like(out)]}

@@ -1,0 +1,204 @@
+"""Batch-7 static ops: TDM index pair, text-matching contrib pair,
+RetinaNet target assign, deformable PS-RoI pooling (see
+static/ops_tail7.py per-op reference files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from tests.test_ops_tail2 import _run_single_op
+
+RNG = np.random.default_rng(77)
+
+
+def _tree_info():
+    """A tiny complete binary tree: 7 nodes (1..7), leaves 4..7.
+    TreeInfo rows: [item_id, layer_id, ancestor_id, child0, child1];
+    row 0 is the null node."""
+    info = np.zeros((8, 5), np.int32)
+    #        item layer anc  c0 c1
+    info[1] = [0, 0, 0, 2, 3]
+    info[2] = [0, 1, 1, 4, 5]
+    info[3] = [0, 1, 1, 6, 7]
+    info[4] = [41, 2, 2, 0, 0]   # leaves carry item ids
+    info[5] = [42, 2, 2, 0, 0]
+    info[6] = [43, 2, 3, 0, 0]
+    info[7] = [44, 2, 3, 0, 0]
+    return info
+
+
+def test_tdm_child():
+    info = _tree_info()
+    x = np.array([[1], [3], [4], [0]], np.int32)
+    child, mask = _run_single_op(
+        "tdm_child", {"X": x, "TreeInfo": info}, {"child_nums": 2},
+        out_slots=("Child", "LeafMask"))
+    np.testing.assert_array_equal(child[0, 0], [2, 3])   # inner children
+    np.testing.assert_array_equal(mask[0, 0], [0, 0])    # not items
+    np.testing.assert_array_equal(child[1, 0], [6, 7])
+    np.testing.assert_array_equal(mask[1, 0], [1, 1])    # leaves = items
+    np.testing.assert_array_equal(child[2, 0], [0, 0])   # leaf: no child
+    np.testing.assert_array_equal(child[3, 0], [0, 0])   # null node
+
+
+def test_tdm_sampler():
+    import paddle_tpu
+
+    paddle_tpu.seed(3)
+    # travel paths for items mapped to leaves 4 and 6
+    travel = np.array([[2, 4], [3, 6]], np.int32)
+    layer = np.array([2, 3, 4, 5, 6, 7], np.int32)  # layer1: [2,3], layer2: 4..7
+    x = np.array([[0], [1]], np.int32)
+    out, lab, mask = _run_single_op(
+        "tdm_sampler", {"X": x, "Travel": travel, "Layer": layer},
+        {"neg_samples_num_list": [1, 2], "layer_offset_lod": [0, 2, 6],
+         "output_positive": True},
+        out_slots=("Out", "Labels", "Mask"))
+    # layout per row: [pos_l1, neg_l1, pos_l2, neg_l2a, neg_l2b]
+    assert out.shape == (2, 5)
+    np.testing.assert_array_equal(out[:, 0], [2, 3])     # layer-1 positives
+    np.testing.assert_array_equal(out[:, 2], [4, 6])     # layer-2 positives
+    np.testing.assert_array_equal(lab[:, 0], [1, 1])
+    np.testing.assert_array_equal(lab[:, 1], [0, 0])
+    # negatives come from the right layer and never equal the positive
+    assert out[0, 1] in (2, 3) and out[0, 1] != 2
+    assert out[1, 1] in (2, 3) and out[1, 1] != 3
+    for r in range(2):
+        for c in (3, 4):
+            assert out[r, c] in (4, 5, 6, 7)
+            assert out[r, c] != out[r, 2]
+    np.testing.assert_array_equal(mask, 1)
+
+
+def test_match_matrix_tensor():
+    B, Lx, Ly, D, T = 2, 3, 4, 5, 2
+    x = RNG.normal(0, 1, (B, Lx, D)).astype(np.float32)
+    y = RNG.normal(0, 1, (B, Ly, D)).astype(np.float32)
+    w = RNG.normal(0, 1, (D, T, D)).astype(np.float32)
+    xl = np.array([3, 2], np.int64)
+    yl = np.array([4, 1], np.int64)
+    out, tmp = _run_single_op(
+        "match_matrix_tensor",
+        {"X": x, "Y": y, "W": w, "XLength": xl, "YLength": yl},
+        {"dim_t": T}, out_slots=("Out", "Tmp"))
+    expect = np.einsum("bid,dte,bje->btij", x, w, y)
+    # masked positions zeroed
+    expect[1, :, 2:, :] = 0
+    expect[1, :, :, 1:] = 0
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+    assert tmp.shape == (B, Lx, T, D)
+
+
+def test_sequence_topk_avg_pooling():
+    B, C, R, Cl = 1, 2, 3, 5
+    x = RNG.normal(0, 1, (B, C, R, Cl)).astype(np.float32)
+    row_len = np.array([2], np.int64)
+    col_len = np.array([4], np.int64)
+    out, _ = _run_single_op(
+        "sequence_topk_avg_pooling",
+        {"X": x, "RowLength": row_len, "ColLength": col_len},
+        {"topks": [1, 3], "channel_num": C}, out_slots=("Out", "pos"))
+    assert out.shape == (B, R, C * 2)
+    # oracle: rows < row_len, cols < col_len
+    for r in range(2):
+        for c in range(C):
+            vals = np.sort(x[0, c, r, :4])[::-1]
+            np.testing.assert_allclose(out[0, r, c * 2 + 0], vals[:1].mean(),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(out[0, r, c * 2 + 1],
+                                       vals[:3].sum() / 3.0, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2], 0)  # masked row
+
+
+def test_retinanet_target_assign_no_subsample():
+    # anchor 4 = [0,0,10,4]: IoU vs gt0 = 55/121 = 0.45 with the +1
+    # widths — strictly between the 0.4/0.5 thresholds, so neither fg
+    # nor bg
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [200, 200, 210, 210], [220, 220, 230, 230],
+                        [0, 0, 10, 4]], np.float32)
+    gt = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    gt_labels = np.array([[[3], [7]]], np.int64)
+    loc, score, lbl, tbox, nfg, nsc = _run_single_op(
+        "retinanet_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt, "GtLabels": gt_labels},
+        {"positive_overlap": 0.5, "negative_overlap": 0.4},
+        out_slots=("LocationIndex", "ScoreIndex", "TargetLabel",
+                   "TargetBBox", "ForegroundNumber", "ScoreNumber"))
+    n_fg = int(nfg[0])
+    assert n_fg == 2
+    np.testing.assert_array_equal(loc[0, :n_fg], [0, 1])
+    # NO subsampling: every fg + bg anchor is scored (anchor 4 overlaps
+    # gt 0 at IoU ~0.45 — between the thresholds, so excluded)
+    n_sc = int(nsc[0])
+    assert n_sc == 4
+    assert 4 not in score[0, :n_sc].tolist()
+    # labels carry gt CLASSES at fg slots
+    got = sorted(lbl[0][lbl[0] > 0].tolist())
+    assert got == [3, 7]
+    np.testing.assert_allclose(tbox[0, :n_fg], gt[0])
+
+
+def _deformable_psroi_oracle(x, roi, out_dim, group, pooled, spp,
+                             spatial_scale=1.0):
+    """Direct transcription of deformable_psroi_pooling_op.h (no_trans)."""
+    _, C, H, W = x.shape
+    b = int(roi[0])
+    x1 = round(roi[1]) * spatial_scale - 0.5
+    y1 = round(roi[2]) * spatial_scale - 0.5
+    x2 = (round(roi[3]) + 1.0) * spatial_scale - 0.5
+    y2 = (round(roi[4]) + 1.0) * spatial_scale - 0.5
+    rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+    bw, bh = rw / pooled, rh / pooled
+    sw, sh = bw / spp, bh / spp
+    out = np.zeros((out_dim, pooled, pooled))
+
+    def bilinear(plane, hh, ww):
+        h0, w0 = int(np.floor(hh)), int(np.floor(ww))
+        h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+        fh, fw = hh - h0, ww - w0
+        return (plane[h0, w0] * (1 - fh) * (1 - fw)
+                + plane[h0, w1] * (1 - fh) * fw
+                + plane[h1, w0] * fh * (1 - fw)
+                + plane[h1, w1] * fh * fw)
+
+    for d in range(out_dim):
+        for ph in range(pooled):
+            for pw in range(pooled):
+                gh = min(max(ph * group // pooled, 0), group - 1)
+                gw = min(max(pw * group // pooled, 0), group - 1)
+                c = (d * group + gh) * group + gw
+                s, n = 0.0, 0
+                for ih in range(spp):
+                    for iw in range(spp):
+                        w = x1 + pw * bw + iw * sw
+                        h = y1 + ph * bh + ih * sh
+                        if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                or h > H - 0.5:
+                            continue
+                        w = min(max(w, 0.0), W - 1.0)
+                        h = min(max(h, 0.0), H - 1.0)
+                        s += bilinear(x[b, c], h, w)
+                        n += 1
+                out[d, ph, pw] = s / max(n, 1)
+    return out
+
+
+def test_deformable_psroi_pooling_matches_reference_kernel():
+    """no_trans path against a transcription of the reference CPU kernel
+    (exact sampling grid: w = wstart + iw*sub_bin, (-0.5, dim-0.5)
+    bounds), using the reference attr names."""
+    N, out_dim, pooled = 1, 2, 2
+    group = pooled
+    C = out_dim * group * group
+    H = W = 8
+    x = RNG.normal(0, 1, (N, C, H, W)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 5]], np.float32)
+    out, _ = _run_single_op(
+        "deformable_psroi_pooling", {"Input": x, "ROIs": rois},
+        {"no_trans": True, "spatial_scale": 1.0, "output_dim": out_dim,
+         "group_size": [group, group], "pooled_height": pooled,
+         "pooled_width": pooled, "part_size": [pooled, pooled],
+         "sample_per_part": 4, "trans_std": 0.0},
+        out_slots=("Output", "TopCount"))
+    expect = _deformable_psroi_oracle(x, rois[0], out_dim, group, pooled, 4)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
