@@ -127,8 +127,14 @@ DESCOPED = {
     "var_conv_2d": None,  # registered in ops_tail3
     # -- detection label-generation (RCNN/RetinaNet training pipelines) ---
     "generate_proposals": None,  # registered in ops_tail6
-    "generate_proposal_labels": "host: RCNN proposal-label sampling (ragged per-image fg/bg subsample + gather); the stages around it (generate_proposals, rpn_target_assign, FPN routing) ARE registered (ops_tail6) — this one remains host-side data prep",
-    "generate_mask_labels": "host: Mask R-CNN label crops, same host-side data-prep class as generate_proposal_labels",
+    "generate_proposal_labels": None,  # registered in ops_tail7
+    "generate_mask_labels": "host: Mask R-CNN mask-target generation "
+                            "rasterizes per-instance POLYGON annotations "
+                            "(Poly2Mask, variable vertex counts per gt) "
+                            "into roi-cropped grids — the polygon inputs "
+                            "are inherently ragged host data, unlike the "
+                            "box-only sampling of the now-registered "
+                            "generate_proposal_labels",
     "rpn_target_assign": None,    # registered in ops_tail6
     "retinanet_target_assign": None,  # registered in ops_tail7
     "retinanet_detection_output": "host: per-level top-k + NMS decode; the registered multiclass_nms/matrix_nms + yolo_box-style decode cover the math",

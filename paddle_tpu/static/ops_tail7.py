@@ -300,9 +300,10 @@ def _deformable_psroi_pooling(ins, attrs, op):
             off_x = jnp.zeros((out_dim, pooled_h, pooled_w))
             off_y = jnp.zeros((out_dim, pooled_h, pooled_w))
         else:
-            off_x = tr[class_id * 2, part_h[None], part_w[None]] \
+            cid = class_id[:, None, None]          # (out_dim, 1, 1)
+            off_x = tr[cid * 2, part_h[None], part_w[None]] \
                 * trans_std * rw
-            off_y = tr[class_id * 2 + 1, part_h[None], part_w[None]] \
+            off_y = tr[cid * 2 + 1, part_h[None], part_w[None]] \
                 * trans_std * rh
         # sample grid (out_dim, ph, pw, spp, spp): w = wstart + iw*sub
         sx = x1 + PW[None, ..., None, None] * bin_w \
@@ -346,3 +347,136 @@ def _deformable_psroi_pooling(ins, attrs, op):
     else:
         out = jax.vmap(one_roi)(rois, trans_r)
     return {"Output": [out], "TopCount": [jnp.ones_like(out)]}
+
+
+# =========================================================================
+# Faster R-CNN proposal-target layer
+# =========================================================================
+
+@register_op("generate_proposal_labels")
+def _generate_proposal_labels(ins, attrs, op):
+    """ref detection/generate_proposal_labels_op.cc (the proposal-target
+    layer): per image, append the gt boxes to the rpn proposals (so every
+    gt can be sampled as fg), IoU-match against gt, sample
+    batch_size_per_im rois at fg_fraction, and emit per-class smooth-L1
+    regression targets (BoxToDelta with bbox_reg_weights, bbox_util.h:54)
+    in the (B, 4*class_nums) one-hot-slot layout.
+
+    Dense layout: RpnRois (N, R, 4) zero-pad + RpnRoisNum, GtBoxes
+    (N, G, 4) w<=0 pad, GtClasses/IsCrowd (N, G); outputs are
+    (N, batch_size_per_im, ...) rows + RoisNum counts.  Random fg/bg
+    subsampling uses the executor's per-op PRNG scope."""
+    rpn_rois = _one(ins, "RpnRois").astype(jnp.float32)
+    gt_classes = _one(ins, "GtClasses")
+    is_crowd = _one(ins, "IsCrowd")
+    gt_boxes = _one(ins, "GtBoxes").astype(jnp.float32)
+    im_info = _one(ins, "ImInfo").astype(jnp.float32)
+    rois_num_in = _one(ins, "RpnRoisNum")
+    if rpn_rois.ndim == 2:
+        rpn_rois = rpn_rois[None]
+        gt_boxes = gt_boxes[None]
+        gt_classes = gt_classes[None]
+        if is_crowd is not None:
+            is_crowd = is_crowd[None]
+    batch = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_th = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    weights = [float(v) for v in attrs.get(
+        "bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])]
+    N, R, _ = rpn_rois.shape
+    G = gt_boxes.shape[1]
+    M = G + R           # gts FIRST (the reference's crowd check indexes
+    # floor like the reference (and ops_tail6's rpn_target_assign)
+    fg_cap = int(fg_frac * batch)
+    take = min(batch, M)   # candidate pool may be smaller than the batch
+    key = _random.next_key()
+
+    def one_image(rois_i, gt_i, cls_i, crowd_i, info, n_rois, key):
+        scale = info[2]
+        rois_orig = rois_i / scale                  # back to ORIGINAL scale
+        valid_roi = jnp.arange(R) < n_rois
+        valid_gt = gt_i[:, 2] > gt_i[:, 0]
+        allb = jnp.concatenate([gt_i, rois_orig], axis=0)      # (M, 4)
+        valid = jnp.concatenate([valid_gt, valid_roi])
+        iou = _iou_xyxy(allb, gt_i, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_iou = iou.max(axis=1)
+        arg = iou.argmax(axis=1).astype(jnp.int32)
+        # crowd gts excluded (first G rows ARE the gts)
+        if crowd_i is not None:
+            crowd_row = jnp.concatenate(
+                [crowd_i.reshape(-1).astype(bool), jnp.zeros((R,), bool)])
+            max_iou = jnp.where(crowd_row, -1.0, max_iou)
+        max_iou = jnp.where(valid, max_iou, -1.0)
+        fg = max_iou >= fg_th
+        bg = (max_iou >= bg_lo) & (max_iou < bg_hi)
+        kf, kb = jax.random.split(key)
+        rf = jax.random.uniform(kf, (M,))
+        rb = jax.random.uniform(kb, (M,))
+        if not use_random:
+            rf = jnp.arange(M) / M
+            rb = jnp.arange(M) / M
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rf, 2.0)))
+        fg_sel = fg & (fg_rank < fg_cap)
+        n_fg = fg_sel.sum()
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rb, 2.0)))
+        bg_sel = bg & (bg_rank < batch - n_fg)
+        sel = fg_sel | bg_sel
+
+        # compact fg first, then bg (the reference's ordering); pad the
+        # row space when the candidate pool is smaller than the batch
+        order_key = jnp.where(fg_sel, 0, jnp.where(bg_sel, 1, 2)) * (M + 1.0) \
+            + jnp.arange(M)
+        order_full = jnp.argsort(order_key).astype(jnp.int32)
+        order = jnp.zeros((batch,), jnp.int32).at[:take].set(
+            order_full[:take])
+        row_ok = jnp.arange(batch) < take
+        sel_o = sel[order] & row_ok
+        rois_out = jnp.where(sel_o[:, None], allb[order], 0.0)
+        lbl = jnp.where(fg_sel[order] & row_ok,
+                        cls_i.reshape(-1).astype(jnp.int32)[arg[order]], 0)
+        lbl = jnp.where(sel_o, lbl, 0)
+
+        # BoxToDelta for fg rows (bbox_util.h:54, +1 widths)
+        ex = allb[order]
+        gtm = gt_i[arg[order]]
+        ex_w = ex[:, 2] - ex[:, 0] + 1.0
+        ex_h = ex[:, 3] - ex[:, 1] + 1.0
+        ex_cx = ex[:, 0] + 0.5 * ex_w
+        ex_cy = ex[:, 1] + 0.5 * ex_h
+        gw = gtm[:, 2] - gtm[:, 0] + 1.0
+        gh = gtm[:, 3] - gtm[:, 1] + 1.0
+        gcx = gtm[:, 0] + 0.5 * gw
+        gcy = gtm[:, 1] + 0.5 * gh
+        delta = jnp.stack([
+            (gcx - ex_cx) / ex_w / weights[0],
+            (gcy - ex_cy) / ex_h / weights[1],
+            jnp.log(jnp.maximum(gw / ex_w, 1e-10)) / weights[2],
+            jnp.log(jnp.maximum(gh / ex_h, 1e-10)) / weights[3]], axis=1)
+        is_fg_row = fg_sel[order] & row_ok
+        tgt = jnp.zeros((batch, class_nums, 4), jnp.float32)
+        bidx = jnp.arange(batch)
+        slot = jnp.where(is_fg_row, lbl, class_nums)
+        tgt = tgt.at[bidx, jnp.minimum(slot, class_nums - 1)].set(
+            jnp.where(is_fg_row[:, None], delta, 0.0))
+        w_in = jnp.zeros((batch, class_nums, 4), jnp.float32).at[
+            bidx, jnp.minimum(slot, class_nums - 1)].set(
+            jnp.where(is_fg_row[:, None], 1.0, 0.0))
+        return (rois_out, lbl[:, None], tgt.reshape(batch, -1),
+                w_in.reshape(batch, -1), w_in.reshape(batch, -1),
+                sel_o.sum().astype(jnp.int64))
+
+    if rois_num_in is None:
+        rois_num_in = jnp.full((N,), R, jnp.int32)
+    crowd = is_crowd if is_crowd is not None else jnp.zeros_like(gt_classes)
+    keys = jax.random.split(key, N)
+    rois, labels, tgts, w_in, w_out, counts = jax.vmap(one_image)(
+        rpn_rois, gt_boxes, gt_classes, crowd, im_info,
+        rois_num_in.astype(jnp.int32), keys)
+    return {"Rois": [rois], "LabelsInt32": [labels],
+            "BboxTargets": [tgts], "BboxInsideWeights": [w_in],
+            "BboxOutsideWeights": [w_out], "RoisNum": [counts]}

@@ -202,3 +202,108 @@ def test_deformable_psroi_pooling_matches_reference_kernel():
         out_slots=("Output", "TopCount"))
     expect = _deformable_psroi_oracle(x, rois[0], out_dim, group, pooled, 4)
     np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_generate_proposal_labels():
+    """Proposal-target layer: gts join the roi pool (always fg-able),
+    sampling respects fg_fraction, targets land in the matched class's
+    4-wide slot (BoxToDelta with bbox_reg_weights)."""
+    import paddle_tpu
+
+    paddle_tpu.seed(9)
+    rois = np.array([[[0, 0, 10, 10],       # IoU 1.0 with gt0 -> fg
+                      [100, 100, 120, 120],  # bg (no overlap)
+                      [40, 40, 60, 60]]], np.float32)  # bg
+    gt = np.array([[[0, 0, 10, 10], [30, 30, 50, 50]]], np.float32)
+    gt_cls = np.array([[[2], [5]]], np.int64)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    B, C = 4, 7
+    outs = _run_single_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gt_cls, "GtBoxes": gt,
+         "ImInfo": im_info, "RpnRoisNum": np.array([3], np.int32)},
+        {"batch_size_per_im": B, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": C,
+         "use_random": False, "bbox_reg_weights": [1.0, 1.0, 1.0, 1.0]},
+        out_slots=("Rois", "LabelsInt32", "BboxTargets",
+                   "BboxInsideWeights", "RoisNum"))
+    out_rois, labels, tgts, w_in, num = outs
+    assert out_rois.shape == (1, B, 4)
+    n = int(num[0])
+    assert n == B
+    lab = labels[0, :, 0]
+    # fg rows first: both gts (classes 2, 5) and the duplicate roi are
+    # all IoU-1 foregrounds, capped at fg_fraction*B = 2
+    fg_rows = [i for i in range(B) if lab[i] > 0]
+    assert len(fg_rows) == 2 and fg_rows == [0, 1]
+    assert set(lab[fg_rows].tolist()) <= {2, 5}
+    # fg targets live in the matched class's slot with weight 1
+    t = tgts[0].reshape(B, C, 4)
+    w = w_in[0].reshape(B, C, 4)
+    for i in fg_rows:
+        c = lab[i]
+        np.testing.assert_allclose(w[i, c], 1.0)
+        # exact-overlap fg: delta = 0
+        np.testing.assert_allclose(t[i, c], 0.0, atol=1e-5)
+        # every other slot empty
+        mask = np.ones(C, bool)
+        mask[c] = False
+        np.testing.assert_allclose(w[i][mask], 0.0)
+    # bg rows: label 0, no weights
+    for i in range(B):
+        if i not in fg_rows:
+            assert lab[i] == 0
+            np.testing.assert_allclose(w[i], 0.0)
+
+
+def test_deformable_psroi_pooling_trans_path():
+    """The learned-offset path (review r05 regression: class-id indexing
+    must broadcast per CHANNEL, out_dim != pooled sizes)."""
+    N, out_dim, pooled = 1, 4, 2
+    group = pooled
+    C = out_dim * group * group
+    H = W = 8
+    x = RNG.normal(0, 1, (N, C, H, W)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    trans = RNG.normal(0, 1, (1, 2, pooled, pooled)).astype(np.float32)
+    out_t, _ = _run_single_op(
+        "deformable_psroi_pooling",
+        {"Input": x, "ROIs": rois, "Trans": trans},
+        {"no_trans": False, "spatial_scale": 1.0, "output_dim": out_dim,
+         "group_size": [group, group], "pooled_height": pooled,
+         "pooled_width": pooled, "part_size": [pooled, pooled],
+         "sample_per_part": 4, "trans_std": 0.1},
+        out_slots=("Output", "TopCount"))
+    assert out_t.shape == (1, out_dim, pooled, pooled)
+    assert np.isfinite(out_t).all()
+    # offsets actually move the sampling window: differs from no_trans
+    out_n, _ = _run_single_op(
+        "deformable_psroi_pooling", {"Input": x, "ROIs": rois},
+        {"no_trans": True, "spatial_scale": 1.0, "output_dim": out_dim,
+         "group_size": [group, group], "pooled_height": pooled,
+         "pooled_width": pooled, "part_size": [pooled, pooled],
+         "sample_per_part": 4, "trans_std": 0.1},
+        out_slots=("Output", "TopCount"))
+    assert not np.allclose(out_t, out_n)
+
+
+def test_generate_proposal_labels_small_pool():
+    """batch_size_per_im larger than the candidate pool must pad, not
+    crash (review r05 regression)."""
+    rois = np.array([[[0, 0, 10, 10], [100, 100, 120, 120]]], np.float32)
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    gt_cls = np.array([[[2]]], np.int64)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    outs = _run_single_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gt_cls, "GtBoxes": gt,
+         "ImInfo": im_info, "RpnRoisNum": np.array([2], np.int32)},
+        {"batch_size_per_im": 8, "fg_fraction": 0.25, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 4,
+         "use_random": False},
+        out_slots=("Rois", "LabelsInt32", "RoisNum"))
+    out_rois, labels, num = outs
+    assert out_rois.shape == (1, 8, 4)
+    n = int(num[0])
+    assert 1 <= n <= 3          # pool is only gt + 2 rois
+    np.testing.assert_allclose(out_rois[0, n:], 0)
