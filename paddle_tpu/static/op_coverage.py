@@ -137,7 +137,7 @@ DESCOPED = {
                             "generate_proposal_labels",
     "rpn_target_assign": None,    # registered in ops_tail6
     "retinanet_target_assign": None,  # registered in ops_tail7
-    "retinanet_detection_output": "host: per-level top-k + NMS decode; the registered multiclass_nms/matrix_nms + yolo_box-style decode cover the math",
+    "retinanet_detection_output": None,  # registered in ops_tail7
     "distribute_fpn_proposals": None,  # registered in ops_tail6
     "collect_fpn_proposals": None,     # registered in ops_tail6
     "box_decoder_and_assign": None,  # registered in ops_tail6

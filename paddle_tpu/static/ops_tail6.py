@@ -56,20 +56,28 @@ def _iou_xyxy(a, b, normalized=True):
                                1e-10)
 
 
-def _greedy_nms_mask(boxes, scores, thresh, max_out):
+def _greedy_nms_mask(boxes, scores, thresh, max_out, class_ids=None,
+                     valid=None, normalized=True):
     """Greedy NMS over score-sorted boxes: returns (order, keep_mask) with
-    at most max_out kept.  boxes (n, 4) corner form."""
+    at most max_out kept.  boxes (n, 4) corner form.  ``class_ids``
+    restricts suppression to SAME-CLASS pairs (one loop instead of one
+    per class); ``valid`` pre-drops rows."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
     b = boxes[order]
-    iou = _iou_xyxy(b, b)
+    iou = _iou_xyxy(b, b, normalized=normalized)
+    if class_ids is not None:
+        c = class_ids[order]
+        iou = jnp.where(c[:, None] == c[None, :], iou, 0.0)
+    v = None if valid is None else valid[order]
 
     def body(i, keep):
         # suppressed if any higher-ranked KEPT box overlaps > thresh
         sup = jnp.max(jnp.where(jnp.arange(n) < i,
                                 iou[i] * keep.astype(iou.dtype),
                                 0.0)) > thresh
-        return keep.at[i].set(jnp.where(sup, 0, 1))
+        drop = sup if v is None else (sup | ~v[i])
+        return keep.at[i].set(jnp.where(drop, 0, 1))
 
     keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), jnp.int32))
     # cap at max_out: rank among kept

@@ -385,6 +385,8 @@ def _generate_proposal_labels(ins, attrs, op):
     bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
     class_nums = int(attrs.get("class_nums", 81))
     use_random = bool(attrs.get("use_random", True))
+    is_cascade = bool(attrs.get("is_cascade_rcnn", False))
+    is_cls_agnostic = bool(attrs.get("is_cls_agnostic", False))
     weights = [float(v) for v in attrs.get(
         "bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])]
     N, R, _ = rpn_rois.shape
@@ -400,6 +402,12 @@ def _generate_proposal_labels(ins, attrs, op):
         rois_orig = rois_i / scale                  # back to ORIGINAL scale
         valid_roi = jnp.arange(R) < n_rois
         valid_gt = gt_i[:, 2] > gt_i[:, 0]
+        if is_cascade:
+            # cascade stage: gts are NOT re-appended, degenerate rois drop
+            valid_gt = jnp.zeros_like(valid_gt)
+            degen = (rois_orig[:, 2] - rois_orig[:, 0] + 1 <= 0) | \
+                (rois_orig[:, 3] - rois_orig[:, 1] + 1 <= 0)
+            valid_roi = valid_roi & ~degen
         allb = jnp.concatenate([gt_i, rois_orig], axis=0)      # (M, 4)
         valid = jnp.concatenate([valid_gt, valid_roi])
         iou = _iou_xyxy(allb, gt_i, normalized=False)
@@ -414,17 +422,21 @@ def _generate_proposal_labels(ins, attrs, op):
         max_iou = jnp.where(valid, max_iou, -1.0)
         fg = max_iou >= fg_th
         bg = (max_iou >= bg_lo) & (max_iou < bg_hi)
-        kf, kb = jax.random.split(key)
-        rf = jax.random.uniform(kf, (M,))
-        rb = jax.random.uniform(kb, (M,))
-        if not use_random:
-            rf = jnp.arange(M) / M
-            rb = jnp.arange(M) / M
-        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rf, 2.0)))
-        fg_sel = fg & (fg_rank < fg_cap)
-        n_fg = fg_sel.sum()
-        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rb, 2.0)))
-        bg_sel = bg & (bg_rank < batch - n_fg)
+        if is_cascade:
+            # cascade stages keep EVERY labeled roi (no subsampling)
+            fg_sel, bg_sel = fg, bg
+        else:
+            kf, kb = jax.random.split(key)
+            rf = jax.random.uniform(kf, (M,))
+            rb = jax.random.uniform(kb, (M,))
+            if not use_random:
+                rf = jnp.arange(M) / M
+                rb = jnp.arange(M) / M
+            fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rf, 2.0)))
+            fg_sel = fg & (fg_rank < fg_cap)
+            n_fg = fg_sel.sum()
+            bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rb, 2.0)))
+            bg_sel = bg & (bg_rank < batch - n_fg)
         sel = fg_sel | bg_sel
 
         # compact fg first, then bg (the reference's ordering); pad the
@@ -436,7 +448,10 @@ def _generate_proposal_labels(ins, attrs, op):
             order_full[:take])
         row_ok = jnp.arange(batch) < take
         sel_o = sel[order] & row_ok
-        rois_out = jnp.where(sel_o[:, None], allb[order], 0.0)
+        # Rois go back to the SCALED image frame (the reference's
+        # 'sampled_rois = sampled_boxes * im_scale' — downstream
+        # roi_align crops in scaled-image coordinates)
+        rois_out = jnp.where(sel_o[:, None], allb[order] * scale, 0.0)
         lbl = jnp.where(fg_sel[order] & row_ok,
                         cls_i.reshape(-1).astype(jnp.int32)[arg[order]], 0)
         lbl = jnp.where(sel_o, lbl, 0)
@@ -460,7 +475,10 @@ def _generate_proposal_labels(ins, attrs, op):
         is_fg_row = fg_sel[order] & row_ok
         tgt = jnp.zeros((batch, class_nums, 4), jnp.float32)
         bidx = jnp.arange(batch)
-        slot = jnp.where(is_fg_row, lbl, class_nums)
+        # cls-agnostic regression routes every fg target to slot 1
+        slot = jnp.where(is_fg_row,
+                         jnp.ones_like(lbl) if is_cls_agnostic else lbl,
+                         class_nums)
         tgt = tgt.at[bidx, jnp.minimum(slot, class_nums - 1)].set(
             jnp.where(is_fg_row[:, None], delta, 0.0))
         w_in = jnp.zeros((batch, class_nums, 4), jnp.float32).at[
@@ -480,3 +498,100 @@ def _generate_proposal_labels(ins, attrs, op):
     return {"Rois": [rois], "LabelsInt32": [labels],
             "BboxTargets": [tgts], "BboxInsideWeights": [w_in],
             "BboxOutsideWeights": [w_out], "RoisNum": [counts]}
+
+
+# =========================================================================
+# RetinaNet detection output
+# =========================================================================
+
+@register_op("retinanet_detection_output")
+def _retinanet_detection_output(ins, attrs, op):
+    """ref detection/retinanet_detection_output_op.cc: per FPN level,
+    keep the nms_top_k highest (anchor, class) scores above
+    score_threshold, decode their deltas against the level's anchors
+    (variance-free: dx*w + cx / exp(dw)*w, +1 widths), clip to the
+    ORIGINAL image (im_info: (h, w, scale)); across levels run per-class
+    NMS and keep keep_top_k detections overall.
+
+    Dense: BBoxes/Scores/Anchors are per-level lists —
+    BBoxes[l] (N, A_l, 4), Scores[l] (N, A_l, C), Anchors[l] (A_l, 4);
+    Out (N, keep_top_k, 6) rows [label, score, x1, y1, x2, y2]
+    zero-padded + RoisNum counts.  The per-class greedy NMS runs as ONE
+    class-aware suppression loop over the pooled candidates (suppressed
+    iff a higher-scored kept SAME-CLASS candidate overlaps beyond
+    nms_threshold) — C separate loops would trace C kernels for no
+    information gain."""
+    from .ops_tail6 import _greedy_nms_mask
+
+    bboxes = ins.get("BBoxes", [])
+    scores = ins.get("Scores", [])
+    anchors = [jnp.asarray(a, jnp.float32) for a in ins.get("Anchors", [])]
+    im_info = _one(ins, "ImInfo").astype(jnp.float32)
+    score_th = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    nms_th = float(attrs.get("nms_threshold", 0.3))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    C = scores[0].shape[-1]
+    L = len(bboxes)
+
+    def decode_level(dl, sc, anc, info, threshold):
+        A = anc.shape[0]
+        k = min(nms_top_k, A * C)
+        flat = jnp.where(sc.reshape(-1) > threshold, sc.reshape(-1),
+                         -jnp.inf)
+        top_sc, idx = jax.lax.top_k(flat, k)
+        a = idx // C
+        c = (idx % C).astype(jnp.float32)
+        anc_s = anc[a]
+        d = dl[a]
+        aw = anc_s[:, 2] - anc_s[:, 0] + 1.0
+        ah = anc_s[:, 3] - anc_s[:, 1] + 1.0
+        acx = anc_s[:, 0] + aw / 2
+        acy = anc_s[:, 1] + ah / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(d[:, 2]) * aw
+        h = jnp.exp(d[:, 3]) * ah
+        box = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1.0, cy + h / 2 - 1.0], -1)
+        box = box / info[2]
+        imh = jnp.round(info[0] / info[2])
+        imw = jnp.round(info[1] / info[2])
+        box = jnp.clip(box, 0.0, jnp.stack([imw - 1, imh - 1,
+                                            imw - 1, imh - 1]))
+        valid = jnp.isfinite(top_sc)
+        return box, jnp.where(valid, top_sc, 0.0), c, valid
+
+    def one_image(dls, scs, info):
+        # the reference keeps the HIGHEST level unthresholded
+        # (retinanet_detection_output_op.cc:409)
+        parts = [decode_level(dls[li], scs[li], anchors[li], info,
+                              score_th if li < L - 1 else 0.0)
+                 for li in range(L)]
+        box = jnp.concatenate([p[0] for p in parts], 0)
+        sc = jnp.concatenate([p[1] for p in parts], 0)
+        cls = jnp.concatenate([p[2] for p in parts], 0)
+        valid = jnp.concatenate([p[3] for p in parts], 0)
+        n = box.shape[0]
+        order, keep = _greedy_nms_mask(box, sc, nms_th, n,
+                                       class_ids=cls, valid=valid,
+                                       normalized=False)
+        b_o, s_o, c_o = box[order], sc[order], cls[order]
+        ds = jnp.where(keep, s_o, 0.0)
+        kk = min(keep_top_k, n)
+        top_sc, fidx = jax.lax.top_k(ds, kk)
+        # labels are 1-based in the output rows
+        # (retinanet_detection_output_op.cc:430, 'nmsed_out[i][0] + 1')
+        out = jnp.concatenate([c_o[fidx][:, None] + 1.0, top_sc[:, None],
+                               b_o[fidx]], axis=1)
+        ok = top_sc > 0
+        out = jnp.where(ok[:, None], out, 0.0)
+        if kk < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - kk), (0, 0)))
+            ok = jnp.pad(ok, (0, keep_top_k - kk))
+        return out, ok.sum().astype(jnp.int64)
+
+    outs, counts = jax.vmap(one_image)(
+        [b.astype(jnp.float32) for b in bboxes],
+        [s.astype(jnp.float32) for s in scores], im_info)
+    return {"Out": [outs], "RoisNum": [counts]}

@@ -307,3 +307,57 @@ def test_generate_proposal_labels_small_pool():
     n = int(num[0])
     assert 1 <= n <= 3          # pool is only gt + 2 rois
     np.testing.assert_allclose(out_rois[0, n:], 0)
+
+
+def test_retinanet_detection_output():
+    """Single level, hand-checked: decode identity deltas back to the
+    anchors, per-class NMS keeps the best of each overlapping pair."""
+    anchors = np.array([[0, 0, 10, 10], [1, 1, 11, 11],   # overlap pair
+                        [50, 50, 60, 60]], np.float32)
+    A, C = 3, 2
+    deltas = np.zeros((1, A, 4), np.float32)              # decode = anchor
+    scores = np.array([[[0.9, 0.0], [0.8, 0.0],
+                        [0.0, 0.7]]], np.float32)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    out, num = _run_single_op(
+        "retinanet_detection_output",
+        {"BBoxes": [deltas], "Scores": [scores], "Anchors": [anchors],
+         "ImInfo": im_info},
+        {"score_threshold": 0.05, "nms_top_k": 6, "nms_threshold": 0.3,
+         "keep_top_k": 5},
+        out_slots=("Out", "RoisNum"))
+    n = int(num[0])
+    # anchor 1 suppressed by anchor 0 (same class, IoU ~0.68); anchor 2
+    # survives in class 1; labels are 1-BASED in the output rows
+    # (retinanet_detection_output_op.cc:430)
+    assert n == 2
+    rows = out[0, :n]
+    assert rows[0][0] == 1 and rows[0][1] == pytest.approx(0.9)
+    np.testing.assert_allclose(rows[0][2:], [0, 0, 10, 10], atol=1e-4)
+    assert rows[1][0] == 2 and rows[1][1] == pytest.approx(0.7)
+    np.testing.assert_allclose(rows[1][2:], [50, 50, 60, 60], atol=1e-4)
+    np.testing.assert_allclose(out[0, n:], 0)
+
+
+def test_generate_proposal_labels_scale_roundtrip():
+    """Rois come back in the SCALED image frame (review r05: the
+    reference multiplies sampled boxes by im_scale)."""
+    rois = np.array([[[0, 0, 20, 20]]], np.float32)  # scaled coords
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)    # original coords
+    gt_cls = np.array([[[1]]], np.int64)
+    im_info = np.array([[200, 200, 2.0]], np.float32)
+    out_rois, labels, num = _run_single_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gt_cls, "GtBoxes": gt,
+         "ImInfo": im_info},
+        {"batch_size_per_im": 2, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 3,
+         "use_random": False},
+        out_slots=("Rois", "LabelsInt32", "RoisNum"))
+    n = int(num[0])
+    # fg cap = floor(0.5*2) = 1 and the pool has no backgrounds
+    assert n == 1
+    # the sampled fg row (the gt, use_random=False favors index 0) comes
+    # back MULTIPLIED by im_scale: [0,0,10,10] original -> [0,0,20,20]
+    np.testing.assert_allclose(out_rois[0, :n], [[0, 0, 20, 20]])
+    assert labels[0, 0, 0] == 1
