@@ -145,9 +145,7 @@ DESCOPED = {
     "locality_aware_nms": "host: OCR-specific NMS variant of the "
                           "registered multiclass_nms",
     "matrix_nms": None,           # registered in ops_tail6
-    "roi_perspective_transform": "host: OCR contrib; perspective warp of "
-                                 "rois (grid_sample is registered and "
-                                 "covers the sampling core)",
+    "roi_perspective_transform": None,  # registered in ops_tail7
     "mine_hard_examples": None,   # registered in ops_tail5
     "detection_map": "host: mAP metric with per-class ragged accumulation; "
                      "metric/metrics.py DetectionMAP is the eager "

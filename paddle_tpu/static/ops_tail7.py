@@ -595,3 +595,84 @@ def _retinanet_detection_output(ins, attrs, op):
         [b.astype(jnp.float32) for b in bboxes],
         [s.astype(jnp.float32) for s in scores], im_info)
     return {"Out": [outs], "RoisNum": [counts]}
+
+
+# =========================================================================
+# RoI perspective transform (EAST-style OCR)
+# =========================================================================
+
+@register_op("roi_perspective_transform")
+def _roi_perspective_transform(ins, attrs, op):
+    """ref detection/roi_perspective_transform_op.cc: warp each quad ROI
+    (8 coords, clockwise from top-left) into a fixed (H_t, W_t) grid via
+    the quad->rect perspective matrix (get_transform_matrix), sampling
+    the input bilinearly; out-of-range source coords produce 0 with a
+    0 mask.  ROIs (R, 9): [batch_idx, x0 y0 x1 y1 x2 y2 x3 y3], scaled
+    by spatial_scale like the reference."""
+    x = _one(ins, "X").astype(jnp.float32)
+    rois = _one(ins, "ROIs").astype(jnp.float32)
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        rx = roi[1::2] * spatial_scale            # (4,)
+        ry = roi[2::2] * spatial_scale
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        norm_h = max(2, th)
+        # max(2, min(nw, tw)) — the LOWER bound wins like the reference,
+        # so transformed_width=1 still yields a finite matrix
+        norm_w = jnp.maximum(2.0, jnp.minimum(jnp.round(
+            est_w * (norm_h - 1) / jnp.maximum(est_h, 1e-5)) + 1,
+            float(tw))).astype(jnp.float32)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+        m8 = 1.0
+        m3 = (y1 - y0 + m6 * (norm_w - 1) * y1) / (norm_w - 1)
+        m4 = (y3 - y0 + m7 * (norm_h - 1) * y3) / (norm_h - 1)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (norm_w - 1) * x1) / (norm_w - 1)
+        m1 = (x3 - x0 + m7 * (norm_h - 1) * x3) / (norm_h - 1)
+        m2 = x0
+        matrix = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8])
+        ow = jnp.arange(tw, dtype=jnp.float32)[None, :]
+        oh = jnp.arange(th, dtype=jnp.float32)[:, None]
+        u = m0 * ow + m1 * oh + m2
+        v = m3 * ow + m4 * oh + m5
+        wq = m6 * ow + m7 * oh + m8
+        in_w = u / wq
+        in_h = v / wq
+        inside = (in_w > -0.5) & (in_w < W - 0.5) & \
+            (in_h > -0.5) & (in_h < H - 0.5)
+        iw = jnp.clip(in_w, 0.0, W - 1.0)
+        ih = jnp.clip(in_h, 0.0, H - 1.0)
+        w0 = jnp.floor(iw).astype(jnp.int32)
+        h0 = jnp.floor(ih).astype(jnp.int32)
+        w1 = jnp.minimum(w0 + 1, W - 1)
+        h1 = jnp.minimum(h0 + 1, H - 1)
+        fw = iw - w0
+        fh = ih - h0
+        feat = x[b]                               # (C, H, W)
+        val = (feat[:, h0, w0] * ((1 - fh) * (1 - fw))[None]
+               + feat[:, h0, w1] * ((1 - fh) * fw)[None]
+               + feat[:, h1, w0] * (fh * (1 - fw))[None]
+               + feat[:, h1, w1] * (fh * fw)[None])
+        val = jnp.where(inside[None], val, 0.0)
+        return val, inside.astype(jnp.int32)[None], matrix
+
+    out, mask, mats = jax.vmap(one_roi)(rois)
+    return {"Out": [out], "Mask": [mask], "TransformMatrix": [mats],
+            "Out2InIdx": [jnp.zeros((1,), jnp.int32)],
+            "Out2InWeights": [jnp.zeros((1,), jnp.float32)]}

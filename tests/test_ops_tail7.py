@@ -361,3 +361,65 @@ def test_generate_proposal_labels_scale_roundtrip():
     # back MULTIPLIED by im_scale: [0,0,10,10] original -> [0,0,20,20]
     np.testing.assert_allclose(out_rois[0, :n], [[0, 0, 20, 20]])
     assert labels[0, 0, 0] == 1
+
+
+def test_roi_perspective_transform():
+    """Oracle: direct transcription of get_transform_matrix +
+    get_source_coords + bilinear_interpolate
+    (roi_perspective_transform_op.cc)."""
+    N, C, H, W = 1, 2, 10, 12
+    x = RNG.normal(0, 1, (N, C, H, W)).astype(np.float32)
+    # quad: axis-aligned rectangle, clockwise from top-left
+    roi = np.array([[0, 2, 1, 9, 1, 9, 7, 2, 7]], np.float32)
+    th, tw = 4, 6
+    out, mask, mats = _run_single_op(
+        "roi_perspective_transform", {"X": x, "ROIs": roi},
+        {"transformed_height": th, "transformed_width": tw,
+         "spatial_scale": 1.0},
+        out_slots=("Out", "Mask", "TransformMatrix"))
+    assert out.shape == (1, C, th, tw)
+
+    # oracle
+    rx = roi[0, 1::2]
+    ry = roi[0, 2::2]
+    l1 = np.hypot(rx[0] - rx[1], ry[0] - ry[1])
+    l2 = np.hypot(rx[1] - rx[2], ry[1] - ry[2])
+    l3 = np.hypot(rx[2] - rx[3], ry[2] - ry[3])
+    l4 = np.hypot(rx[3] - rx[0], ry[3] - ry[0])
+    est_h, est_w = (l2 + l4) / 2, (l1 + l3) / 2
+    nh = max(2, th)
+    nw = max(2, min(int(round(est_w * (nh - 1) / est_h)) + 1, tw))
+    dx1, dx2, dx3 = rx[1] - rx[2], rx[3] - rx[2], rx[0] - rx[1] + rx[2] - rx[3]
+    dy1, dy2, dy3 = ry[1] - ry[2], ry[3] - ry[2], ry[0] - ry[1] + ry[2] - ry[3]
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m = np.zeros(9)
+    m[6] = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m[7] = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m[8] = 1
+    m[3] = (ry[1] - ry[0] + m[6] * (nw - 1) * ry[1]) / (nw - 1)
+    m[4] = (ry[3] - ry[0] + m[7] * (nh - 1) * ry[3]) / (nh - 1)
+    m[5] = ry[0]
+    m[0] = (rx[1] - rx[0] + m[6] * (nw - 1) * rx[1]) / (nw - 1)
+    m[1] = (rx[3] - rx[0] + m[7] * (nh - 1) * rx[3]) / (nh - 1)
+    m[2] = rx[0]
+    expect = np.zeros((C, th, tw), np.float32)
+    emask = np.zeros((th, tw), np.int32)
+    for oh in range(th):
+        for ow in range(tw):
+            u = m[0] * ow + m[1] * oh + m[2]
+            v = m[3] * ow + m[4] * oh + m[5]
+            wq = m[6] * ow + m[7] * oh + m[8]
+            iw, ih = u / wq, v / wq
+            if iw <= -0.5 or iw >= W - 0.5 or ih <= -0.5 or ih >= H - 0.5:
+                continue
+            emask[oh, ow] = 1
+            iw2, ih2 = min(max(iw, 0), W - 1), min(max(ih, 0), H - 1)
+            w0, h0 = int(np.floor(iw2)), int(np.floor(ih2))
+            w1, h1 = min(w0 + 1, W - 1), min(h0 + 1, H - 1)
+            fw, fh = iw2 - w0, ih2 - h0
+            expect[:, oh, ow] = (x[0, :, h0, w0] * (1 - fh) * (1 - fw)
+                                 + x[0, :, h0, w1] * (1 - fh) * fw
+                                 + x[0, :, h1, w0] * fh * (1 - fw)
+                                 + x[0, :, h1, w1] * fh * fw)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(mask[0, 0], emask)
