@@ -1,7 +1,8 @@
 """Metrics (ref: python/paddle/metric/metrics.py — Metric ABC, Accuracy,
 Precision, Recall, Auc; fluid/metrics.py).  Accumulation is host-side numpy;
 the distributed variants allreduce host scalars (fleet/metrics/metric.py)."""
-from .metrics import Accuracy, Auc, ChunkEvaluator, Metric, Precision, Recall
+from .metrics import (Accuracy, Auc, ChunkEvaluator, DetectionMAP,
+                      Metric, Precision, Recall)
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
-           "ChunkEvaluator"]
+           "ChunkEvaluator", "DetectionMAP"]

@@ -176,3 +176,122 @@ class ChunkEvaluator(Metric):
 
     def compute(self, *args):
         return args
+
+
+class DetectionMAP(Metric):
+    """Mean average precision for detection (ref fluid/metrics.py
+    DetectionMAP + operators/detection_map_op.h).
+
+    Host-side by design: mAP accumulation is per-class RAGGED state
+    (variable detections/gts per image), so like every Metric here it
+    runs in numpy between steps — the static `detection_map` op stays
+    descoped with this class as the re-scope (op_coverage.py).
+
+    ``update(det_boxes, det_labels, det_scores, gt_boxes, gt_labels,
+    difficult=None)`` consumes ONE image: detections (D, 4)/(D,)/(D,),
+    ground truth (G, 4)/(G,); ``accumulate()`` returns mAP over classes
+    that have ground truth, with the reference's two AP algorithms
+    (``ap_version`` = "integral" or "11point") and greedy
+    highest-score-first matching STRICTLY ABOVE ``overlap_threshold``
+    (detection_map_op.h uses ``>``); difficult gts
+    are excluded exactly like the reference (matched without counting
+    when ``evaluate_difficult`` is False).
+    """
+
+    def __init__(self, overlap_threshold=0.5, evaluate_difficult=False,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point', "
+                             f"got {ap_version!r}")
+        self.overlap_threshold = float(overlap_threshold)
+        self.evaluate_difficult = bool(evaluate_difficult)
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._scores = {}   # class -> list of (score, is_tp)
+        self._npos = {}     # class -> number of non-difficult gts
+
+    def update(self, det_boxes, det_labels, det_scores, gt_boxes,
+               gt_labels, difficult=None):
+        det_boxes = np.asarray(det_boxes, np.float64).reshape(-1, 4)
+        det_labels = np.asarray(det_labels).reshape(-1).astype(int)
+        det_scores = np.asarray(det_scores, np.float64).reshape(-1)
+        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels).reshape(-1).astype(int)
+        difficult = (np.zeros(len(gt_labels), bool) if difficult is None
+                     else np.asarray(difficult).reshape(-1).astype(bool))
+        for c in np.unique(gt_labels):
+            hard = difficult[gt_labels == c]
+            self._npos[c] = self._npos.get(c, 0) + int(
+                len(hard) if self.evaluate_difficult
+                else (~hard).sum())
+        for c in np.unique(det_labels):
+            det_idx = np.where(det_labels == c)[0]
+            det_idx = det_idx[np.argsort(-det_scores[det_idx],
+                                         kind="stable")]
+            gt_idx = np.where(gt_labels == c)[0]
+            taken = np.zeros(len(gt_idx), bool)
+            rec = self._scores.setdefault(c, [])
+            # vectorized (D, G) IoU matrix (the chunk_eval precedent:
+            # host metrics stay numpy-broadcast, not python loops)
+            if len(det_idx) and len(gt_idx):
+                d = det_boxes[det_idx]
+                g = gt_boxes[gt_idx]
+                iw = np.maximum(
+                    np.minimum(d[:, None, 2], g[None, :, 2])
+                    - np.maximum(d[:, None, 0], g[None, :, 0]), 0.0)
+                ih = np.maximum(
+                    np.minimum(d[:, None, 3], g[None, :, 3])
+                    - np.maximum(d[:, None, 1], g[None, :, 1]), 0.0)
+                inter = iw * ih
+                area_d = (d[:, 2] - d[:, 0]) * (d[:, 3] - d[:, 1])
+                area_g = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1])
+                iou = inter / np.maximum(
+                    area_d[:, None] + area_g[None, :] - inter, 1e-10)
+            else:
+                iou = np.zeros((len(det_idx), len(gt_idx)))
+            for rank, di in enumerate(det_idx):
+                best_j = int(np.argmax(iou[rank])) if len(gt_idx) else -1
+                best = float(iou[rank, best_j]) if best_j >= 0 else 0.0
+                # STRICT > like the reference (detection_map_op.h)
+                if best > self.overlap_threshold and best_j >= 0:
+                    is_diff = difficult[gt_idx[best_j]]
+                    if is_diff and not self.evaluate_difficult:
+                        continue  # matched a difficult gt: ignored
+                    if not taken[best_j]:
+                        taken[best_j] = True
+                        rec.append((float(det_scores[di]), True))
+                    else:
+                        rec.append((float(det_scores[di]), False))
+                else:
+                    rec.append((float(det_scores[di]), False))
+
+    def accumulate(self):
+        aps = []
+        for c, npos in self._npos.items():
+            if npos == 0:
+                continue
+            rec = sorted(self._scores.get(c, []), key=lambda t: -t[0])
+            tp = np.cumsum([1.0 if t else 0.0 for _, t in rec]) \
+                if rec else np.zeros(0)
+            fp = np.cumsum([0.0 if t else 1.0 for _, t in rec]) \
+                if rec else np.zeros(0)
+            recall = tp / npos if len(tp) else np.zeros(0)
+            precision = tp / np.maximum(tp + fp, 1e-10) if len(tp) \
+                else np.zeros(0)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = precision[recall >= t].max() \
+                        if np.any(recall >= t) else 0.0
+                    ap += p / 11.0
+            else:
+                # integral: sum precision * delta-recall (detection_map_op)
+                ap, prev_r = 0.0, 0.0
+                for p, r in zip(precision, recall):
+                    ap += p * (r - prev_r)
+                    prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

@@ -654,7 +654,35 @@ def _roi_perspective_transform(ins, attrs, op):
         wq = m6 * ow + m7 * oh + m8
         in_w = u / wq
         in_h = v / wq
-        inside = (in_w > -0.5) & (in_w < W - 0.5) & \
+        # in_quad (roi_perspective_transform_op.cc): on-boundary OR odd
+        # ray-crossing parity, with the kernel's 1e-4 epsilon comparators
+        eps = 1e-4
+        on_edge = jnp.zeros_like(in_w, dtype=bool)
+        n_cross = jnp.zeros_like(in_w, dtype=jnp.int32)
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            horiz = jnp.abs(ys - ye) < eps
+            on_h = horiz & (jnp.abs(in_h - ys) < eps) \
+                & (jnp.abs(in_h - ye) < eps) \
+                & (in_w > jnp.minimum(xs, xe) - eps) \
+                & (in_w < jnp.maximum(xs, xe) + eps)
+            ix = (in_h - ys) * (xe - xs) \
+                / jnp.where(horiz, 1.0, ye - ys) + xs
+            on_e = ~horiz & (jnp.abs(ix - in_w) < eps) \
+                & (in_h > jnp.minimum(ys, ye) - eps) \
+                & (in_h < jnp.maximum(ys, ye) + eps)
+            on_edge = on_edge | on_h | on_e
+            in_band = ~horiz & ~(in_h < jnp.minimum(ys, ye) + eps) \
+                & ~(in_h > jnp.maximum(ys, ye) + eps)
+            n_cross = n_cross + (in_band & (ix - in_w > eps)).astype(
+                jnp.int32)
+        in_roi = on_edge | (n_cross % 2 == 1)
+        # NOTE: the image-bounds band is STRICT here because THIS
+        # reference kernel's bilinear_interpolate uses the GT_E
+        # comparators (empty when in_w <= -0.5 or >= W-0.5) — unlike
+        # deformable_psroi's inclusive band in the same file
+        inside = in_roi & (in_w > -0.5) & (in_w < W - 0.5) & \
             (in_h > -0.5) & (in_h < H - 0.5)
         iw = jnp.clip(in_w, 0.0, W - 1.0)
         ih = jnp.clip(in_h, 0.0, H - 1.0)

@@ -402,6 +402,34 @@ def test_roi_perspective_transform():
     m[0] = (rx[1] - rx[0] + m[6] * (nw - 1) * rx[1]) / (nw - 1)
     m[1] = (rx[3] - rx[0] + m[7] * (nh - 1) * rx[3]) / (nh - 1)
     m[2] = rx[0]
+    def in_quad(px, py):
+        """Transcription of in_quad (roi_perspective_transform_op.cc)."""
+        eps = 1e-4
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            if abs(ys - ye) < eps:
+                if abs(py - ys) < eps and abs(py - ye) < eps \
+                        and px > min(xs, xe) - eps and px < max(xs, xe) + eps:
+                    return True
+            else:
+                ix = (py - ys) * (xe - xs) / (ye - ys) + xs
+                if abs(ix - px) < eps and py > min(ys, ye) - eps \
+                        and py < max(ys, ye) + eps:
+                    return True
+        n_cross = 0
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            if abs(ys - ye) < eps:
+                continue
+            if py < min(ys, ye) + eps or py > max(ys, ye) + eps:
+                continue
+            ix = (py - ys) * (xe - xs) / (ye - ys) + xs
+            if ix - px > eps:
+                n_cross += 1
+        return n_cross % 2 == 1
+
     expect = np.zeros((C, th, tw), np.float32)
     emask = np.zeros((th, tw), np.int32)
     for oh in range(th):
@@ -411,6 +439,8 @@ def test_roi_perspective_transform():
             wq = m[6] * ow + m[7] * oh + m[8]
             iw, ih = u / wq, v / wq
             if iw <= -0.5 or iw >= W - 0.5 or ih <= -0.5 or ih >= H - 0.5:
+                continue
+            if not in_quad(iw, ih):
                 continue
             emask[oh, ow] = 1
             iw2, ih2 = min(max(iw, 0), W - 1), min(max(ih, 0), H - 1)
@@ -423,3 +453,38 @@ def test_roi_perspective_transform():
                                  + x[0, :, h1, w1] * fh * fw)
     np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
     np.testing.assert_array_equal(mask[0, 0], emask)
+
+
+def test_detection_map_metric():
+    """DetectionMAP (metric/metrics.py — the detection_map op's host
+    re-scope): hand-checked single-class case + difficult-gt exclusion."""
+    from paddle_tpu.metric import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # one image: 2 gts, 3 detections: best hits gt0, dup hits gt0 again
+    # (fp), third misses
+    m.update(det_boxes=[[0, 0, 10, 10], [1, 1, 10, 10], [50, 50, 60, 60]],
+             det_labels=[1, 1, 1], det_scores=[0.9, 0.8, 0.7],
+             gt_boxes=[[0, 0, 10, 10], [20, 20, 30, 30]],
+             gt_labels=[1, 1])
+    # ranked: tp, fp, fp; npos=2 -> precision [1, .5, 1/3], recall
+    # [.5, .5, .5]; integral AP = 1*0.5 = 0.5
+    assert m.accumulate() == pytest.approx(0.5)
+
+    # 11-point on the same state: max precision at recall<=0.5 is 1.0
+    m2 = DetectionMAP(overlap_threshold=0.5, ap_version="11point")
+    m2.update([[0, 0, 10, 10], [1, 1, 10, 10], [50, 50, 60, 60]],
+              [1, 1, 1], [0.9, 0.8, 0.7],
+              [[0, 0, 10, 10], [20, 20, 30, 30]], [1, 1])
+    assert m2.accumulate() == pytest.approx(6 / 11)
+
+    # difficult gts: excluded from npos, matches ignored
+    m3 = DetectionMAP()
+    m3.update([[0, 0, 10, 10]], [2], [0.9],
+              [[0, 0, 10, 10], [20, 20, 30, 30]], [2, 2],
+              difficult=[True, False])
+    # the only det matched a DIFFICULT gt -> ignored; npos=1, no tp
+    assert m3.accumulate() == pytest.approx(0.0)
+    # reset clears state
+    m3.reset()
+    assert m3.accumulate() == 0.0
