@@ -22,7 +22,8 @@ __all__ = [
     "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
     "PreconditionNotMetError", "PermissionDeniedError",
     "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
-    "FatalError", "ExternalError",
+    "FatalError", "ExternalError", "ProgramVerificationError",
+    "render_diagnostics",
 ]
 
 
@@ -76,6 +77,36 @@ class FatalError(EnforceNotMet, RuntimeError):
 
 class ExternalError(EnforceNotMet, RuntimeError):
     """EXTERNAL — an error surfaced from an external library (XLA/PJRT)."""
+
+
+class ProgramVerificationError(InvalidArgumentError):
+    """Raised by the static program verifier (static/analysis.py) when a
+    Program fails its pre-trace checks.  Carries the structured findings on
+    ``.diagnostics`` (objects with code/severity/block/op_index/op_type/
+    var/message/hint) so tooling can consume them without parsing text."""
+
+    def __init__(self, message: str = "", diagnostics=()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
+
+
+def render_diagnostics(diags) -> str:
+    """Render verifier diagnostics one per line:
+
+        PV001 error   [block 0 op 3 mul] message (hint: ...)
+    """
+    lines = []
+    for d in diags:
+        loc = f"block {d.block}"
+        if d.op_index is not None:
+            loc += f" op {d.op_index}"
+        if d.op_type:
+            loc += f" {d.op_type}"
+        line = f"{d.code} {d.severity:<7} [{loc}] {d.message}"
+        if d.hint:
+            line += f" (hint: {d.hint})"
+        lines.append(line)
+    return "\n".join(lines)
 
 
 def enforce(cond, error_cls=InvalidArgumentError, message="enforce failed"):

@@ -18,18 +18,31 @@ _DEFS: Dict[str, tuple] = {}  # name -> (default, type, help)
 
 _ENV_PREFIX = "PDTPU_FLAGS_"
 
+# the single truthy set for string→bool flag parsing, shared by the env
+# passthrough and set_flags (bool("false") is True — gflags semantics want
+# string spellings instead)
+_TRUE_STRINGS = frozenset(("1", "true", "yes", "on"))
+_FALSE_STRINGS = frozenset(("0", "false", "no", "off", ""))
+
+
+def _coerce(name: str, value, type_: Callable):
+    if type_ is bool and isinstance(value, str):
+        low = value.lower()
+        if low in _TRUE_STRINGS:
+            return True
+        if low in _FALSE_STRINGS:
+            return False
+        raise ValueError(
+            f"flag {name!r}: cannot parse {value!r} as bool (use one of "
+            f"{sorted(_TRUE_STRINGS | _FALSE_STRINGS)})")
+    return type_(value)
+
 
 def define_flag(name: str, default, help: str = "", type_: Callable = None):
     type_ = type_ or type(default)
     _DEFS[name] = (default, type_, help)
     env = os.environ.get(_ENV_PREFIX + name)
-    if env is not None:
-        if type_ is bool:
-            value = env.lower() in ("1", "true", "yes", "on")
-        else:
-            value = type_(env)
-    else:
-        value = default
+    value = default if env is None else _coerce(name, env, type_)
     _FLAGS[name] = value
 
 
@@ -47,7 +60,7 @@ def set_flags(flags: Dict[str, Any]):
                 raise KeyError(f"Unknown flag {name!r}; known: {sorted(_FLAGS)}")
             default, type_, _ = _DEFS[name]
             if type_ is not None and not isinstance(value, type_) and value is not None:
-                value = type_(value)
+                value = _coerce(name, value, type_)
             _FLAGS[name] = value
 
 
@@ -76,3 +89,8 @@ define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "
             "profiler is enabled (ref: platform/profiler.h:208).")
 define_flag("eager_log_level", 0, "VLOG-style verbosity for framework logging "
             "(ref: glog VLOG levels).")
+define_flag("check_program", True, "Statically verify Programs before the "
+            "Executor traces them (static/analysis.py): dataflow, registry, "
+            "structure, and shape/dtype plausibility checks with typed "
+            "diagnostics (ref: the framework/ir + inference/analysis "
+            "pre-execution pass stage).")

@@ -52,6 +52,7 @@ from .io import (
     save_persistables,
 )
 from . import nets
+from .analysis import Diagnostic, check_program, verify_program
 from .registry import register_op, registered_ops
 from . import op_version
 

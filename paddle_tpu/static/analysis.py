@@ -1,0 +1,584 @@
+"""Program verifier: static analysis over Program/Block/Operator IR.
+
+Reference parity: the reference runs an entire pass ecosystem over
+ProgramDesc before execution — `framework/ir/` graph passes,
+`inference/analysis/` (analyzer.cc → ir_pass_manager.cc), and every
+`PADDLE_ENFORCE*` site in `platform/enforce.h` carrying a typed error code.
+Our TPU-native Executor traces a Program straight into jax.jit, so a
+malformed program used to surface as an opaque JAX tracer error deep inside
+a lowering rule.  This module is the missing compilation stage: it walks
+every Block (descending through ``SUB_BLOCK_ATTRS``) *before any tracing*
+and reports structured diagnostics.
+
+Checks (diagnostic codes):
+
+- ``PV001`` dataflow: an op input is not produced by an earlier op, a feed,
+  a persistable, or a parameter (the trace would KeyError in the env dict).
+- ``PV002`` dataflow (warning): a non-persistable temporary is written but
+  never read or fetched — it silently inflates the trace.
+- ``PV003`` registry: op type has no registered lowering and no DESCOPED
+  rationale; a difflib nearest-name suggestion is attached.
+- ``PV004`` registry: op type is DESCOPED (rationale attached) — it can
+  never lower here.
+- ``PV005`` structure: a sub-block index is out of range / not an int, or a
+  known control-flow op is missing its block attr.
+- ``PV006`` structure: an op carries a block-reference attr that is NOT in
+  ``SUB_BLOCK_ATTRS`` — dataflow walkers (backward._effective_io, the
+  Executor's _first_access scan) would go blind to reads inside its body
+  (the hazard documented at framework.SUB_BLOCK_ATTRS).
+- ``PV007`` structure: a ``@GRAD`` variable has no primal counterpart.
+- ``PV008`` structure: a persistable read by the main program is never
+  initialized by the startup program (only checked when a startup program
+  is supplied).
+- ``PV009`` shape/dtype: a per-op-type inference table propagates shapes
+  through the block and flags statically-certain rank/dim/dtype
+  mismatches (-1 / unknown dims are wildcards — never flagged).
+
+Severity ``error`` aborts ``Executor.run`` (flag ``check_program``, default
+on; ``PDTPU_FLAGS_check_program=0`` or ``set_flags({"check_program":
+False})`` to skip); ``warning`` never does.  Diagnostics render through
+``core.errors.render_diagnostics`` and raise
+``core.errors.ProgramVerificationError``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core import errors as _errors
+from .backward import GRAD_SUFFIX
+from .framework import SUB_BLOCK_ATTRS, Parameter, Program
+
+__all__ = ["Diagnostic", "verify_program", "check_program"]
+
+
+# Op types realized by the Executor itself (trace-time dispatch in
+# executor._trace_ops) — they have no registry entry by design.
+EXECUTOR_OPS = frozenset({
+    "feed", "fetch", "backward_region", "conditional_block", "while",
+    "static_rnn",
+})
+
+# Control-flow ops and the SUB_BLOCK_ATTRS attrs each must carry, plus the
+# names their lowering injects into the sub-block env before tracing it
+# (executor._lower_cond/_lower_while/_lower_static_rnn).
+_BLOCK_OP_REQUIRED_ATTRS = {
+    "conditional_block": ("true_block", "false_block"),
+    "while": ("cond_block", "body_block"),
+    "static_rnn": ("rnn_block",),
+}
+
+# Attrs whose values are *variable names read by the executor's lowering*
+# (branch outputs, loop carries...) — they count as reads for PV002.
+_NAME_LIST_ATTRS = ("true_outs", "false_outs", "body_outs", "mem_next",
+                    "out_names")
+_NAME_ATTRS = ("cond_out",)
+
+
+@dataclass
+class Diagnostic:
+    """One structured finding (code, severity, location, fix-hint)."""
+
+    code: str
+    severity: str                 # "error" | "warning"
+    message: str
+    block: int = 0
+    op_index: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    hint: Optional[str] = None
+
+    def __str__(self):
+        return _errors.render_diagnostics([self])
+
+
+class _Verifier:
+    def __init__(self, program: Program, startup: Optional[Program],
+                 feed_names: Optional[Sequence[str]],
+                 fetch_names: Optional[Sequence[str]]):
+        self.program = program
+        self.startup = startup
+        # feed_names=None means "verifying without a concrete run": any
+        # is_data var is assumed feedable.  A concrete feed dict narrows
+        # that to the names actually fed.
+        self.feed_names = None if feed_names is None else set(feed_names)
+        self.fetch_names = set(fetch_names or ())
+        self.diags: List[Diagnostic] = []
+        self.reads: Set[str] = set()
+        self.writes: Dict[str, Tuple[int, int, str]] = {}  # name -> site
+
+    # -- reporting -----------------------------------------------------------
+    def _emit(self, code, severity, message, block=0, op_index=None,
+              op_type=None, var=None, hint=None):
+        self.diags.append(Diagnostic(code, severity, message, block,
+                                     op_index, op_type, var, hint))
+
+    # -- entry ---------------------------------------------------------------
+    def run(self) -> List[Diagnostic]:
+        self._check_grad_pairing()
+        if self.startup is not None:
+            self._check_startup_init()
+        defined = self._initial_defined(self.program.global_block())
+        self._walk_block(0, defined, set())
+        self._check_dead_temps()
+        return self.diags
+
+    # -- initial environment -------------------------------------------------
+    def _initial_defined(self, block) -> Set[str]:
+        """Names bound into the env before any op runs: feeds + persistable
+        state (executor.run seeds env from `state` then `feeds`)."""
+        defined = set()
+        for v in self.program.list_vars():
+            if v.persistable or isinstance(v, Parameter):
+                defined.add(v.name)
+            elif v.is_data:
+                if self.feed_names is None or v.name in self.feed_names:
+                    defined.add(v.name)
+        if self.feed_names:
+            defined |= self.feed_names
+        return defined
+
+    # -- block walk ----------------------------------------------------------
+    def _walk_block(self, block_idx: int, defined: Set[str],
+                    visiting: Set[int]) -> Set[str]:
+        """Walk one block in execution order, growing `defined`; returns the
+        defined-set after the last op (used for sub-block out checks)."""
+        if block_idx in visiting:        # cyclic sub-block reference
+            return defined
+        visiting = visiting | {block_idx}
+        block = self.program.blocks[block_idx]
+        for op_idx, op in enumerate(block.ops):
+            self._check_registry(block_idx, op_idx, op)
+            self._check_structure(block_idx, op_idx, op)
+            if op.type in ("feed", "fetch"):
+                # executor skips these; feed outputs are env-bound by name
+                defined |= set(op.output_names())
+                continue
+            # dataflow: every input must already be defined
+            for name in op.input_names():
+                self.reads.add(name)
+                if name not in defined:
+                    self._emit(
+                        "PV001", "error",
+                        f"op {op.type!r} reads {name!r} which is not "
+                        "produced by any earlier op, feed, persistable, or "
+                        "parameter",
+                        block_idx, op_idx, op.type, name,
+                        hint=self._pv001_hint(block, name))
+            for attr in _NAME_LIST_ATTRS:
+                for name in op.attrs.get(attr, ()) or ():
+                    if isinstance(name, str):
+                        self.reads.add(name)
+            for attr in _NAME_ATTRS:
+                name = op.attrs.get(attr)
+                if isinstance(name, str):
+                    self.reads.add(name)
+            # descend into sub-blocks with the defined-set AT this op (the
+            # lowering snapshots the env here: executor._arrays_only)
+            for attr, sub_idx in self._sub_blocks(op):
+                if not self._valid_block_idx(sub_idx):
+                    continue            # PV005 already emitted
+                injected = self._injected_names(op, attr)
+                sub_defined = set(defined) | injected
+                self._walk_block(int(sub_idx), sub_defined, visiting)
+            self._check_shapes(block_idx, op_idx, op)
+            for name in op.output_names():
+                defined.add(name)
+                self.writes.setdefault(name, (block_idx, op_idx, op.type))
+        return defined
+
+    def _pv001_hint(self, block, name) -> str:
+        if not block.has_var(name):
+            return (f"{name!r} is not declared in block {block.idx} or any "
+                    "ancestor — check the op's input names")
+        v = block.var(name)
+        if v.is_data:
+            return (f"{name!r} is a data var but was not fed — add it to "
+                    "the feed dict")
+        return (f"declare {name!r} persistable, feed it, or reorder the "
+                "producing op before this one")
+
+    @staticmethod
+    def _sub_blocks(op):
+        return op.sub_block_indices()
+
+    def _valid_block_idx(self, idx) -> bool:
+        return (isinstance(idx, (int, np.integer))
+                and not isinstance(idx, bool)
+                and 0 <= int(idx) < len(self.program.blocks))
+
+    def _injected_names(self, op, attr) -> Set[str]:
+        """Names the executor binds into a sub-block env before tracing it."""
+        if op.type == "while":
+            return set(op.inputs.get("X", ()))
+        if op.type == "static_rnn":
+            return (set(op.attrs.get("mem_names", ()))
+                    | set(op.attrs.get("step_in_names", ())))
+        return set()
+
+    # -- registry soundness --------------------------------------------------
+    def _check_registry(self, block_idx, op_idx, op):
+        from . import ops as _ops  # noqa: F401 — populate the registry
+        from .op_coverage import DESCOPED
+        from .registry import is_registered, suggest_names
+
+        if op.type in EXECUTOR_OPS or is_registered(op.type):
+            return
+        if op.type in DESCOPED:
+            self._emit(
+                "PV004", "error",
+                f"op type {op.type!r} is descoped and can never lower here",
+                block_idx, op_idx, op.type,
+                hint=f"rationale: {DESCOPED[op.type]}")
+            return
+        suggestion = suggest_names(op.type)
+        self._emit(
+            "PV003", "error",
+            f"op type {op.type!r} has no registered lowering",
+            block_idx, op_idx, op.type,
+            hint=suggestion or "register one with static.register_op")
+
+    # -- structural soundness ------------------------------------------------
+    def _check_structure(self, block_idx, op_idx, op):
+        n_blocks = len(self.program.blocks)
+        for attr in _BLOCK_OP_REQUIRED_ATTRS.get(op.type, ()):
+            if attr not in op.attrs:
+                self._emit(
+                    "PV005", "error",
+                    f"control-flow op {op.type!r} is missing its "
+                    f"{attr!r} sub-block attr",
+                    block_idx, op_idx, op.type,
+                    hint="build it through static.cond/while_loop/StaticRNN")
+        for attr, sub_idx in self._sub_blocks(op):
+            if not self._valid_block_idx(sub_idx):
+                self._emit(
+                    "PV005", "error",
+                    f"op {op.type!r} attr {attr!r} references block "
+                    f"{sub_idx!r} but the program has {n_blocks} blocks",
+                    block_idx, op_idx, op.type,
+                    hint="sub-block attrs hold an index into program.blocks")
+        # block-reference attrs the walkers cannot see (the framework.py
+        # "walkers go blind" hazard): an int attr named *_block outside
+        # SUB_BLOCK_ATTRS almost certainly references a block
+        for attr, value in op.attrs.items():
+            if (attr.endswith("_block") and attr not in SUB_BLOCK_ATTRS
+                    and isinstance(value, (int, np.integer))
+                    and not isinstance(value, bool)):
+                self._emit(
+                    "PV006", "error",
+                    f"op {op.type!r} attr {attr!r} looks like a sub-block "
+                    "reference but is not listed in "
+                    "framework.SUB_BLOCK_ATTRS — dataflow walkers will not "
+                    "descend into that block",
+                    block_idx, op_idx, op.type,
+                    hint="add the attr name to framework.SUB_BLOCK_ATTRS")
+
+    # -- grad pairing --------------------------------------------------------
+    def _check_grad_pairing(self):
+        # program-wide primal pool: append_backward puts param grads in
+        # block 0 even when the primal was created inside a sub-block
+        # (StaticRNN parameters), so block-scoped lookup would false-flag
+        all_names = {n for b in self.program.blocks for n in b.vars}
+        for block in self.program.blocks:
+            for name, v in block.vars.items():
+                if not name.endswith(GRAD_SUFFIX):
+                    continue
+                primal = name[: -len(GRAD_SUFFIX)]
+                if not block.has_var(primal) and primal not in all_names:
+                    self._emit(
+                        "PV007", "error",
+                        f"grad var {name!r} has no primal {primal!r} "
+                        "anywhere in the program",
+                        block.idx, var=name,
+                        hint="grad vars are created by append_backward/"
+                             "gradients next to their primal")
+
+    # -- startup coverage ----------------------------------------------------
+    def _check_startup_init(self):
+        initialized = set()
+        for block in self.startup.blocks:
+            for op in block.ops:
+                initialized |= set(op.output_names())
+        # a persistable the main program READS before any main-program op
+        # writes it must come from startup (executor._needs_value semantics)
+        for v in self.program.list_vars():
+            if not v.persistable or v.name in initialized:
+                continue
+            if self._first_access(self.program.global_block(), v.name) == "read":
+                self._emit(
+                    "PV008", "error",
+                    f"persistable {v.name!r} is read by the main program "
+                    "but never initialized by the startup program",
+                    var=v.name,
+                    hint="append an init op for it to the startup program "
+                         "(layers.create_parameter does this automatically)")
+
+    def _first_access(self, block, name):
+        for op in block.ops:
+            if name in op.input_names():
+                return "read"
+            for _attr, sub_idx in self._sub_blocks(op):
+                if self._valid_block_idx(sub_idx):
+                    sub = self._first_access(self.program.blocks[sub_idx],
+                                             name)
+                    if sub == "read":
+                        return "read"
+            if name in op.output_names():
+                return "write"
+        return None
+
+    # -- dead temporaries ----------------------------------------------------
+    def _check_dead_temps(self):
+        for name, (block_idx, op_idx, op_type) in self.writes.items():
+            if name in self.reads or name in self.fetch_names:
+                continue
+            block = self.program.blocks[block_idx]
+            try:
+                v = block.var(name)
+            except KeyError:
+                v = None
+            if v is not None and (v.persistable or v.is_data):
+                continue
+            self._emit(
+                "PV002", "warning",
+                f"temporary {name!r} (written by op {op_type!r}) is never "
+                "read or fetched — it inflates the trace for nothing",
+                block_idx, op_idx, op_type, name,
+                hint="drop the op or fetch the value")
+
+    # -- shape / dtype plausibility ------------------------------------------
+    def _var_shape(self, block, name) -> Optional[Tuple[int, ...]]:
+        try:
+            v = block.var(name)
+        except KeyError:
+            return None
+        shape = tuple(v.shape)
+        return shape if shape else None   # () is "undeclared" in this IR
+
+    def _var_dtype(self, block, name):
+        try:
+            return np.dtype(block.var(name).dtype)
+        except KeyError:
+            return None
+
+    def _check_shapes(self, block_idx, op_idx, op):
+        checker = _SHAPE_CHECKERS.get(op.type)
+        if checker is None:
+            return
+        block = self.program.blocks[block_idx]
+
+        def shape(slot, i=0):
+            names = op.inputs.get(slot, ())
+            return (self._var_shape(block, names[i])
+                    if i < len(names) else None)
+
+        def dtype(slot, i=0):
+            names = op.inputs.get(slot, ())
+            return (self._var_dtype(block, names[i])
+                    if i < len(names) else None)
+
+        for message, hint in checker(op, shape, dtype):
+            self._emit("PV009", "error", message, block_idx, op_idx,
+                       op.type, hint=hint)
+
+
+# ---------------------------------------------------------------------------
+# Shape/dtype inference table.  Each checker yields (message, hint) pairs;
+# -1 and undeclared shapes are wildcards — only statically-certain
+# mismatches are flagged.
+# ---------------------------------------------------------------------------
+
+def _dims_clash(a: int, b: int) -> bool:
+    return a != -1 and b != -1 and a != b
+
+
+def _broadcast_clash(x, y, axis):
+    """Reference elementwise broadcasting (ops._bcast_axis): y aligns to x
+    starting at `axis`; equal ranks and axis in (None, -1) fall back to
+    numpy trailing alignment.  Dims clash only when both are known, neither
+    is 1, and they differ."""
+    if x is None or y is None:
+        return None
+    if len(y) > len(x):
+        return None                      # x broadcasts into y; jnp handles it
+    if len(y) == len(x) or axis in (None, -1):
+        for i in range(1, len(y) + 1):
+            dx, dy = x[-i], y[-i]
+            if dx != 1 and dy != 1 and _dims_clash(dx, dy):
+                return (f"trailing dim -{i}: x has {dx}, y has {dy} "
+                        "(not broadcastable)")
+        return None
+    start = axis
+    if start < 0 or start + len(y) > len(x):
+        return f"y rank {len(y)} does not fit into x rank {len(x)} at axis {axis}"
+    for i, dy in enumerate(y):
+        dx = x[start + i]
+        if dx != 1 and dy != 1 and _dims_clash(dx, dy):
+            return (f"dim {start + i}: x has {dx}, y has {dy} "
+                    "(not broadcastable)")
+    return None
+
+
+def _chk_elementwise(op, shape, dtype):
+    clash = _broadcast_clash(shape("X"), shape("Y"),
+                             op.attrs.get("axis", -1))
+    if clash:
+        yield (f"elementwise {op.type!r}: {clash}",
+               "shapes must broadcast under the reference axis rule")
+
+
+def _chk_mul(op, shape, dtype):
+    x, y = shape("X"), shape("Y")
+    if x is None or y is None:
+        return
+    xn = op.attrs.get("x_num_col_dims", 1)
+    yn = op.attrs.get("y_num_col_dims", 1)
+    xin = x[xn:]
+    yin = y[:yn]
+    if any(d == -1 for d in xin) or any(d == -1 for d in yin):
+        return
+    a, b = int(np.prod(xin or (1,))), int(np.prod(yin or (1,)))
+    if a != b:
+        yield (f"mul: x flattens to inner dim {a} (shape {x} at "
+               f"x_num_col_dims={xn}) but y provides {b} (shape {y})",
+               "inner dimensions must match")
+
+
+def _chk_matmul(op, shape, dtype):
+    x, y = shape("X"), shape("Y")
+    if x is None or y is None or len(x) < 1 or len(y) < 1:
+        return
+    kx = x[-2] if (op.attrs.get("transpose_X") and len(x) >= 2) else x[-1]
+    if len(y) == 1:
+        ky = y[0]
+    else:
+        ky = y[-1] if op.attrs.get("transpose_Y") else y[-2]
+    if _dims_clash(kx, ky):
+        yield (f"matmul: contraction dims differ — x contributes {kx} "
+               f"(shape {x}), y contributes {ky} (shape {y})",
+               "check transpose_X/transpose_Y and operand shapes")
+
+
+def _chk_cast(op, shape, dtype):
+    if "out_dtype" not in op.attrs:
+        yield ("cast: missing required attr 'out_dtype'",
+               "set attrs={'out_dtype': <dtype>}")
+
+
+def _chk_fill_constant(op, shape, dtype):
+    if "shape" not in op.attrs:
+        yield ("fill_constant: missing required attr 'shape'",
+               "set attrs={'shape': (...), 'value': v}")
+
+
+def _chk_concat(op, shape, dtype):
+    ranks = set()
+    for i, _ in enumerate(op.inputs.get("X", ())):
+        s = shape("X", i)
+        if s is not None:
+            ranks.add(len(s))
+    if len(ranks) > 1:
+        yield (f"concat: inputs have differing ranks {sorted(ranks)}",
+               "all concat inputs must share a rank")
+
+
+def _chk_softmax_ce(op, shape, dtype):
+    if op.attrs.get("soft_label", False):
+        return
+    dt = dtype("Label")
+    if dt is not None and dt.kind not in ("i", "u"):
+        yield (f"softmax_with_cross_entropy: hard labels must be integer, "
+               f"got {dt.name}",
+               "cast the label to int64 or set soft_label=True")
+    lx, ll = shape("Logits"), shape("Label")
+    if lx is not None and ll is not None and len(ll) == len(lx):
+        if _dims_clash(ll[-1], 1):
+            yield (f"softmax_with_cross_entropy: hard label last dim must "
+                   f"be 1, got {ll}",
+                   "labels carry one class index per row")
+
+
+def _chk_lookup_table(op, shape, dtype):
+    dt = dtype("Ids")
+    if dt is not None and dt.kind not in ("i", "u"):
+        yield (f"{op.type}: Ids must be integer, got {dt.name}",
+               "cast the ids to int64")
+
+
+def _chk_conv2d(op, shape, dtype):
+    x, w = shape("Input"), shape("Filter")
+    if x is None or w is None or len(x) != 4 or len(w) != 4:
+        return
+    groups = op.attrs.get("groups", 1) or 1
+    cin = x[1] if op.attrs.get("data_format", "NCHW") == "NCHW" else x[-1]
+    if _dims_clash(cin, w[1] * groups):
+        yield (f"conv2d: input channels {cin} != filter in-channels "
+               f"{w[1]} * groups {groups}",
+               "filter shape is (out_c, in_c/groups, kh, kw)")
+
+
+def _chk_reshape(op, shape, dtype):
+    x = shape("X")
+    tgt = op.attrs.get("shape")
+    if x is None or not tgt or any(d == -1 for d in x):
+        return
+    tgt = tuple(int(d) for d in tgt)
+    if any(d == -1 for d in tgt) or 0 in tgt:
+        return
+    if int(np.prod(x)) != int(np.prod(tgt)):
+        yield (f"reshape: cannot reshape {x} ({int(np.prod(x))} elements) "
+               f"to {tgt} ({int(np.prod(tgt))} elements)",
+               "element counts must match (use -1 for one inferred dim)")
+
+
+_SHAPE_CHECKERS = {
+    "mul": _chk_mul,
+    "matmul": _chk_matmul,
+    "cast": _chk_cast,
+    "fill_constant": _chk_fill_constant,
+    "concat": _chk_concat,
+    "softmax_with_cross_entropy": _chk_softmax_ce,
+    "lookup_table": _chk_lookup_table,
+    "embedding": _chk_lookup_table,
+    "conv2d": _chk_conv2d,
+    "reshape": _chk_reshape,
+    "reshape2": _chk_reshape,
+}
+for _name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+              "elementwise_div", "elementwise_max", "elementwise_min",
+              "elementwise_pow", "elementwise_mod", "elementwise_floordiv"):
+    _SHAPE_CHECKERS[_name] = _chk_elementwise
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def verify_program(program: Program, startup: Optional[Program] = None,
+                   feed_names: Optional[Sequence[str]] = None,
+                   fetch_names: Optional[Sequence[str]] = None
+                   ) -> List[Diagnostic]:
+    """Statically verify `program`; returns all diagnostics (errors and
+    warnings).  Supplying `startup` additionally checks persistable
+    initialization coverage (PV008); supplying `feed_names`/`fetch_names`
+    narrows the feed assumption / marks fetches as reads."""
+    return _Verifier(program, startup, feed_names, fetch_names).run()
+
+
+def check_program(program: Program, startup: Optional[Program] = None,
+                  feed_names: Optional[Sequence[str]] = None,
+                  fetch_names: Optional[Sequence[str]] = None
+                  ) -> List[Diagnostic]:
+    """verify_program + raise ``ProgramVerificationError`` carrying the
+    structured diagnostics when any error-severity finding exists.  Returns
+    the (warning-only) diagnostics otherwise."""
+    diags = verify_program(program, startup, feed_names, fetch_names)
+    errs = [d for d in diags if d.severity == "error"]
+    if errs:
+        raise _errors.ProgramVerificationError(
+            "program verification failed (set "
+            "PDTPU_FLAGS_check_program=0 to bypass):\n"
+            + _errors.render_diagnostics(errs), diagnostics=errs)
+    return diags

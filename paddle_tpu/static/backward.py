@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from .framework import (SUB_BLOCK_ATTRS, Parameter, Program, Variable,
+from .framework import (Parameter, Program, Variable,
                         default_main_program)
 
 GRAD_SUFFIX = "@GRAD"
@@ -28,9 +28,8 @@ def _effective_io(program, op):
     (closure capture in the Executor's lowering)."""
     ins = set(op.input_names())
     outs = set(op.output_names())
-    blk_attrs = [a for a in SUB_BLOCK_ATTRS if a in op.attrs]
-    for a in blk_attrs:
-        blk = program.blocks[op.attrs[a]]
+    for _a, blk_idx in op.sub_block_indices():
+        blk = program.blocks[blk_idx]
         defined = set()
         for sub in blk.ops:
             si, so = _effective_io(program, sub)
@@ -54,9 +53,8 @@ def _reject_while_ops(program, loss_names, param_names, api_name: str) -> None:
         if op.type == "while":
             return True
         return any(contains_while(sub)
-                   for a in SUB_BLOCK_ATTRS
-                   if a in op.attrs
-                   for sub in program.blocks[op.attrs[a]].ops)
+                   for _a, blk_idx in op.sub_block_indices()
+                   for sub in program.blocks[blk_idx].ops)
 
     block = program.global_block()
     suspects = []  # (ins, outs) of ops containing a while, in program order

@@ -28,8 +28,7 @@ import numpy as np
 from ..core import random as _random
 from . import ops as _ops  # registers lowerings
 from .backward import GRAD_SUFFIX
-from .framework import (SUB_BLOCK_ATTRS, Program, Variable,
-                        default_main_program)
+from .framework import Program, Variable, default_main_program
 from .registry import get_lowering
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
@@ -336,6 +335,16 @@ class Executor:
                tuple(id(d) for d in devices) if devices else None)
         compiled = self._cache.get(key)
         if compiled is None:
+            from ..core import flags as _flags
+
+            if _flags.get_flag("check_program"):
+                # pre-trace static analysis (SURVEY §7: fail fast and
+                # legibly before jit) — once per compile-cache entry, so
+                # steady-state steps never re-verify
+                from .analysis import check_program as _check_program
+
+                _check_program(program, feed_names=set(feed_arrays),
+                               fetch_names=fetch_names)
             compiled = self._build(program, list(feed_arrays), fetch_names,
                                    state_names, devices=devices,
                                    feed_arrays=feed_arrays)
@@ -423,17 +432,15 @@ class Executor:
         for op in block.ops:
             if name in op.input_names():
                 return "read"
-            attrs = getattr(op, "attrs", None) or {}
-            for a in SUB_BLOCK_ATTRS:
-                if a in attrs:
-                    sub = self._first_access(
-                        program, program.blocks[attrs[a]], name)
-                    if sub == "read":
-                        return "read"
-                    # sub == 'write': local to that branch trace; a
-                    # write-then-read inside the sub-block was already
-                    # resolved locally (the recursion returned at the
-                    # write), so keep scanning the parent.
+            for _a, sub_idx in op.sub_block_indices():
+                sub = self._first_access(
+                    program, program.blocks[sub_idx], name)
+                if sub == "read":
+                    return "read"
+                # sub == 'write': local to that branch trace; a
+                # write-then-read inside the sub-block was already
+                # resolved locally (the recursion returned at the
+                # write), so keep scanning the parent.
             if name in op.output_names():
                 return "write"
         return None

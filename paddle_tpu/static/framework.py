@@ -134,6 +134,14 @@ class Operator:
     def output_names(self) -> List[str]:
         return [n for vs in self.outputs.values() for n in vs]
 
+    def sub_block_indices(self) -> List[tuple]:
+        """(attr_name, block_index) for every sub-block this op references —
+        the one sanctioned way for dataflow walkers (backward._effective_io,
+        Executor._first_access, static/analysis.py) to descend, so a new
+        block-carrying op only has to extend SUB_BLOCK_ATTRS."""
+        return [(a, self.attrs[a]) for a in SUB_BLOCK_ATTRS
+                if a in self.attrs]
+
     def __repr__(self):
         return f"Operator({self.type}, in={self.inputs}, out={self.outputs})"
 

@@ -10,7 +10,8 @@ generation for every backend (SURVEY.md §7 design stance).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+import difflib
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 Lowering = Callable[..., Dict[str, List[Any]]]
 
@@ -35,9 +36,28 @@ def get_lowering(type_name: str) -> Lowering:
     except KeyError:
         from ..core.errors import UnimplementedError
 
+        suggestion = suggest_names(type_name)
         raise UnimplementedError(
-            f"no lowering registered for op type {type_name!r}; known: "
-            f"{sorted(_REGISTRY)}") from None
+            f"no lowering registered for op type {type_name!r} "
+            f"({len(_REGISTRY)} ops registered)"
+            + (f"; {suggestion}" if suggestion else "")) from None
+
+
+def is_registered(type_name: str) -> bool:
+    return type_name in _REGISTRY
+
+
+def suggest_names(name: str, candidates: Optional[Sequence[str]] = None,
+                  n: int = 3) -> Optional[str]:
+    """Nearest-name hint for a miss against `candidates` (default: the
+    registry).  Shared by get_lowering and the program verifier
+    (static/analysis.py) so both render the same 'did you mean' text
+    instead of dumping hundreds of registry entries."""
+    pool = list(_REGISTRY) if candidates is None else list(candidates)
+    close = difflib.get_close_matches(name, pool, n=n, cutoff=0.6)
+    if not close:
+        return None
+    return "did you mean " + " / ".join(repr(c) for c in close) + "?"
 
 
 def registered_ops() -> List[str]:

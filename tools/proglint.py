@@ -1,0 +1,240 @@
+"""proglint — repo-level static lint for op lowering modules.
+
+The runtime program verifier (paddle_tpu/static/analysis.py) checks
+*Programs*; this tool checks the *lowering rules themselves* at the source
+level, AST-based, so violations gate tier-1 through
+tests/test_analysis.py::test_proglint_clean_on_repo instead of surfacing as
+trace-time heisenbugs.  Checks:
+
+- ``PL001`` host-side nondeterminism inside a lowering module: calls
+  through ``numpy.random`` / stdlib ``random`` / ``time`` / ``datetime``.
+  Lowering rules run under jax.jit tracing — host randomness is baked into
+  the compiled executable once and silently replayed every step (the
+  sanctioned path is ``core.random.next_key()``, which folds per-op PRNG
+  scopes; see executor._run_op_traced).
+- ``PL002`` return-contract violations in a registered lowering: the
+  registry contract is ``{slot: [arrays]}`` (static/registry.py) — a dict
+  literal return with a non-string key or a non-list/tuple value, or a
+  bare/None return, is flagged.  Returns of names/calls are not provable
+  statically and are skipped.
+- ``PL003`` a ``register_op`` name that collides with
+  ``op_coverage.DESCOPED``: the op is simultaneously claimed descoped and
+  registered — one of the two claims is stale.
+- ``PL004`` the same op name registered twice across the scanned files
+  (the runtime registry raises at import; the lint catches it without
+  importing).
+
+CLI:  ``python -m tools.proglint [files...]`` — defaults to every
+``paddle_tpu/static/ops*.py`` in the repo; exits 0 when clean, 1 when any
+violation is found.  Dependency-free: op_coverage.py is exec'd standalone
+(it is a pure data module) rather than imported through the package, so
+the lint runs without jax.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+OPS_GLOB = "paddle_tpu/static/ops*.py"
+
+# modules whose use inside a lowering module means host-side nondeterminism
+_FORBIDDEN_MODULES = {
+    "random": "stdlib random",
+    "time": "time",
+    "datetime": "datetime",
+}
+# attributes of numpy that are forbidden (np.random.*)
+_FORBIDDEN_NUMPY_ATTRS = {"random"}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _load_descoped() -> Dict[str, str]:
+    """Exec op_coverage.py standalone — it is a pure-data module with no
+    package-relative imports, so this avoids importing jax."""
+    path = REPO_ROOT / "paddle_tpu" / "static" / "op_coverage.py"
+    ns: Dict = {}
+    exec(compile(path.read_text(), str(path), "exec"), ns)
+    return ns["DESCOPED"]
+
+
+def _module_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local-name -> canonical module for numpy + forbidden stdlib modules."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root == "numpy" or root in _FORBIDDEN_MODULES:
+                    aliases[a.asname or root] = root
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            root = node.module.split(".")[0]
+            if root == "numpy":
+                for a in node.names:
+                    if a.name in _FORBIDDEN_NUMPY_ATTRS:
+                        aliases[a.asname or a.name] = "numpy.random"
+            elif root in _FORBIDDEN_MODULES and node.level == 0:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{root}.{a.name}"
+    return aliases
+
+
+def _register_op_name(dec: ast.expr) -> Optional[str]:
+    """The constant op name of a `@register_op("x")` decorator / call."""
+    if (isinstance(dec, ast.Call) and dec.args
+            and isinstance(dec.func, ast.Name)
+            and dec.func.id == "register_op"
+            and isinstance(dec.args[0], ast.Constant)
+            and isinstance(dec.args[0].value, str)):
+        return dec.args[0].value
+    return None
+
+
+def _check_forbidden_idioms(path: str, tree: ast.Module,
+                            out: List[Violation]) -> None:
+    aliases = _module_aliases(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            base = aliases.get(node.value.id)
+            if base == "numpy" and node.attr in _FORBIDDEN_NUMPY_ATTRS:
+                out.append(Violation(
+                    path, node.lineno, "PL001",
+                    f"numpy.random used in a lowering module (as "
+                    f"{node.value.id}.{node.attr}) — host randomness is "
+                    "baked into the trace; use core.random.next_key()"))
+            elif base in _FORBIDDEN_MODULES:
+                out.append(Violation(
+                    path, node.lineno, "PL001",
+                    f"{base}.{node.attr} used in a lowering module — "
+                    "host-side nondeterminism is baked into the trace"))
+        elif isinstance(node, ast.Name) and aliases.get(
+                node.id, "").startswith(("numpy.random", "random.",
+                                         "time.", "datetime.")):
+            out.append(Violation(
+                path, node.lineno, "PL001",
+                f"{aliases[node.id]} (bound as {node.id!r}) used in a "
+                "lowering module — host-side nondeterminism is baked "
+                "into the trace"))
+
+
+def _check_return_contract(path: str, fn: ast.FunctionDef, op_name: str,
+                           out: List[Violation]) -> None:
+    """Flag provably-wrong returns in a registered lowering: the registry
+    contract is {slot: [arrays]}."""
+    for node in _own_statements(fn):
+        if not isinstance(node, ast.Return):
+            continue
+        value = node.value
+        if value is None or (isinstance(value, ast.Constant)
+                             and value.value is None):
+            out.append(Violation(
+                path, node.lineno, "PL002",
+                f"lowering {op_name!r} returns None — the registry "
+                "contract is {slot: [arrays]}"))
+        elif isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if k is None:
+                    continue                      # **spread: not provable
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    out.append(Violation(
+                        path, k.lineno, "PL002",
+                        f"lowering {op_name!r} returns a dict with a "
+                        "non-string slot key"))
+                if isinstance(v, ast.Constant) or isinstance(v, ast.Dict):
+                    out.append(Violation(
+                        path, v.lineno, "PL002",
+                        f"lowering {op_name!r} returns a slot value that "
+                        "is not a list of arrays — the contract is "
+                        "{'Out': [value]}"))
+        elif isinstance(value, (ast.List, ast.Tuple, ast.Constant)):
+            out.append(Violation(
+                path, node.lineno, "PL002",
+                f"lowering {op_name!r} returns "
+                f"{type(value).__name__} — the registry contract is a "
+                "dict {slot: [arrays]}"))
+
+
+def _own_statements(fn: ast.FunctionDef):
+    """Walk fn's statements WITHOUT descending into nested function defs
+    (a nested helper's returns are not the lowering's returns)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def lint_file(path, descoped: Optional[Dict[str, str]] = None,
+              seen_names: Optional[Dict[str, str]] = None
+              ) -> List[Violation]:
+    """Lint one lowering module; returns its violations."""
+    path = Path(path)
+    rel = str(path)
+    descoped = _load_descoped() if descoped is None else descoped
+    seen_names = {} if seen_names is None else seen_names
+    tree = ast.parse(path.read_text(), filename=rel)
+    out: List[Violation] = []
+    _check_forbidden_idioms(rel, tree, out)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                name = _register_op_name(dec)
+                if name is None:
+                    continue
+                if name in descoped:
+                    out.append(Violation(
+                        rel, node.lineno, "PL003",
+                        f"register_op({name!r}) collides with "
+                        "op_coverage.DESCOPED — drop the stale rationale "
+                        f"(currently: {descoped[name][:60]}...)"))
+                prev = seen_names.setdefault(name, f"{rel}:{node.lineno}")
+                if prev != f"{rel}:{node.lineno}":
+                    out.append(Violation(
+                        rel, node.lineno, "PL004",
+                        f"op {name!r} registered twice (first at {prev})"))
+                _check_return_contract(rel, node, name, out)
+    return out
+
+
+def lint_paths(paths: Sequence) -> List[Violation]:
+    descoped = _load_descoped()
+    seen: Dict[str, str] = {}
+    out: List[Violation] = []
+    for p in paths:
+        out.extend(lint_file(p, descoped, seen))
+    return out
+
+
+def default_targets() -> List[Path]:
+    return sorted(REPO_ROOT.glob(OPS_GLOB))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    targets = [Path(a) for a in argv] or default_targets()
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    print(f"proglint: {len(targets)} file(s), {len(violations)} "
+          f"violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
