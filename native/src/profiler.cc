@@ -126,8 +126,10 @@ class Profiler {
     return static_cast<int>(events_.size());
   }
 
-  // Aggregated text table sorted by total time (profiler_helper.h style).
-  std::string Summary() {
+  // Aggregated text table (profiler_helper.h style), sorted descending by
+  // `sorted_key`: one of total (default), calls, max, min, ave — the
+  // fluid stop_profiler(sorted_key=...) contract.
+  std::string Summary(const char* sorted_key) {
     std::lock_guard<std::mutex> lk(mu_);
     struct Agg {
       long long total = 0, mn = 0, mx = 0;
@@ -142,10 +144,20 @@ class Profiler {
       a.mx = std::max(a.mx, d);
       a.calls++;
     }
+    const std::string key = sorted_key ? sorted_key : "total";
+    auto rank = [&key](const Agg& a) -> double {
+      if (key == "calls") return static_cast<double>(a.calls);
+      if (key == "max") return static_cast<double>(a.mx);
+      if (key == "min") return static_cast<double>(a.mn);
+      if (key == "ave")
+        return a.calls ? static_cast<double>(a.total) / a.calls : 0.0;
+      return static_cast<double>(a.total);
+    };
     std::vector<std::pair<std::string, Agg>> rows(agg.begin(), agg.end());
-    std::sort(rows.begin(), rows.end(), [](const auto& a, const auto& b) {
-      return a.second.total > b.second.total;
-    });
+    std::sort(rows.begin(), rows.end(),
+              [&rank](const auto& a, const auto& b) {
+                return rank(a.second) > rank(b.second);
+              });
     char line[512];
     std::string out =
         "Event                            Calls    Total(ms)    Avg(ms)    "
@@ -186,8 +198,7 @@ void pt_prof_add_span(const char* name, long long start_ns, long long end_ns) {
 int pt_prof_export_chrome(const char* path) {
   return pt::Profiler::Instance().ExportChrome(path);
 }
-int pt_prof_summary(char* buf, int buflen) {
-  std::string s = pt::Profiler::Instance().Summary();
+static int FillSummary(const std::string& s, char* buf, int buflen) {
   int need = static_cast<int>(s.size());
   if (buf && buflen > 0) {
     int n = need < buflen - 1 ? need : buflen - 1;
@@ -195,6 +206,15 @@ int pt_prof_summary(char* buf, int buflen) {
     buf[n] = '\0';
   }
   return need;
+}
+
+int pt_prof_summary(char* buf, int buflen) {
+  return FillSummary(pt::Profiler::Instance().Summary("total"), buf, buflen);
+}
+
+int pt_prof_summary_sorted(const char* sorted_key, char* buf, int buflen) {
+  return FillSummary(pt::Profiler::Instance().Summary(sorted_key), buf,
+                     buflen);
 }
 
 }  // extern "C"

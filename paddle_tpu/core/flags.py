@@ -89,6 +89,12 @@ define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "
             "profiler is enabled (ref: platform/profiler.h:208).")
 define_flag("eager_log_level", 0, "VLOG-style verbosity for framework logging "
             "(ref: glog VLOG levels).")
+define_flag("metrics", True, "Collect runtime telemetry into the metrics "
+            "registry (utils/monitor.py): executor compile-cache and timing, "
+            "op-lowering counts, PS RPC stats, train-loop throughput.  Off "
+            "(PDTPU_FLAGS_metrics=0): instrumented paths still run but "
+            "record nothing (ref: platform/monitor.h StatRegistry, always-on "
+            "in the reference).")
 define_flag("check_program", True, "Statically verify Programs before the "
             "Executor traces them (static/analysis.py): dataflow, registry, "
             "structure, and shape/dtype plausibility checks with typed "

@@ -66,6 +66,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_prof_export_chrome.restype = c.c_int
     lib.pt_prof_summary.argtypes = [c.c_char_p, c.c_int]
     lib.pt_prof_summary.restype = c.c_int
+    try:
+        # newer symbol; a stale prebuilt .so may lack it — prof_summary
+        # falls back to the unsorted export in that case
+        lib.pt_prof_summary_sorted.argtypes = [c.c_char_p, c.c_char_p,
+                                               c.c_int]
+        lib.pt_prof_summary_sorted.restype = c.c_int
+    except AttributeError:
+        pass
 
     lib.pd_aes_ctr_crypt.argtypes = [c.c_char_p, c.c_int, c.c_char_p,
                                      c.POINTER(c.c_uint8), c.c_longlong]
@@ -227,16 +235,22 @@ def prof_export_chrome(path: str) -> int:
     return int(lib.pt_prof_export_chrome(path.encode()))
 
 
-def prof_summary() -> str:
+def prof_summary(sorted_key: Optional[str] = None) -> str:
     lib = get_lib()
     if lib is None:
         return ""
+    sorter = getattr(lib, "pt_prof_summary_sorted", None)
+    if sorter is not None:
+        key = (sorted_key or "total").encode()
+        fill = lambda buf, n: sorter(key, buf, n)  # noqa: E731
+    else:  # stale .so without the sorted entry point
+        fill = lib.pt_prof_summary
     # Same grow-and-retry as stat_list: events can land between the size
     # query and the fill.
-    need = lib.pt_prof_summary(None, 0)
+    need = fill(None, 0)
     while True:
         buf = ctypes.create_string_buffer(need + 256)
-        got = lib.pt_prof_summary(buf, need + 256)
+        got = fill(buf, need + 256)
         if got <= need + 255:
             return buf.value.decode()
         need = got

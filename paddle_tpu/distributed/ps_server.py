@@ -24,10 +24,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils import monitor as _monitor
 from .ps import SparseTable
 
 __all__ = ["PSServer", "RemoteSparseTable", "serve_forever"]
@@ -45,6 +47,28 @@ _OP_OK = 100
 _OP_ERR = 101
 
 _STATE_KEYS = ("ids", "rows", "accum", "accum2", "steps")
+
+_OP_NAMES = {
+    _OP_PULL: "pull", _OP_PUSH: "push", _OP_DELTA: "delta",
+    _OP_NUM_ROWS: "num_rows", _OP_STATE: "state", _OP_LOAD: "load",
+    _OP_SHUTDOWN: "shutdown", _OP_BARRIER: "barrier", _OP_BEAT: "beat",
+}
+
+# -- telemetry (utils/monitor.py; ref: the reference's brpc/gRPC server
+# exposes per-method counts + latency through brpc's builtin /vars) ---------
+_m_rpc_count = _monitor.counter(
+    "ps.rpc_count", "PS server requests handled, per opcode.",
+    labelnames=("op",))
+_m_rpc_ms = _monitor.histogram(
+    "ps.rpc_latency_ms", "PS server request handling latency (ms), per "
+    "opcode (recv-to-reply, host wall time).", labelnames=("op",))
+_m_rpc_errors = _monitor.counter(
+    "ps.rpc_errors", "PS server requests that raised and returned an error "
+    "frame, per opcode.", labelnames=("op",))
+_m_beat_age = _monitor.gauge(
+    "ps.heartbeat_age_seconds", "Seconds since the stalest worker's last "
+    "heartbeat on this server (-1 before any beat; ref "
+    "heart_beat_monitor.h).", labelnames=("server",))
 
 
 def _send_msg(sock: socket.socket, op: int, arrays: Sequence[np.ndarray]):
@@ -128,6 +152,19 @@ class PSServer:
         self._inflight: set = set()
         self._applied_lock = threading.Lock()
         self._applied_cv = threading.Condition(self._applied_lock)
+        # heartbeat-age telemetry: last beat per worker, surfaced as a
+        # collect-time gauge (beats also feed the optional HeartBeatMonitor
+        # above, which owns dead/revive callbacks)
+        self._last_beats: Dict[int, float] = {}
+        self._beats_lock = threading.Lock()
+        _m_beat_age.set_function(self._heartbeat_age, server=str(self.port))
+
+    def _heartbeat_age(self) -> float:
+        with self._beats_lock:
+            beats = list(self._last_beats.values())
+        if not beats:
+            return -1.0
+        return max(0.0, time.monotonic() - min(beats))
 
     # -- exactly-once for mutating ops ------------------------------------
     # `_Conn` retries are at-least-once; push/delta carry a trailing
@@ -222,6 +259,8 @@ class PSServer:
                     op, arrays = _recv_msg(conn)
                 except (ConnectionError, OSError):
                     return
+                opname = _OP_NAMES.get(op, f"op{op}")
+                t0 = time.perf_counter()
                 try:
                     if op == _OP_PULL:
                         rows = self.table.pull(arrays[0])
@@ -282,8 +321,11 @@ class PSServer:
                             continue
                         _send_msg(conn, _OP_OK, [])
                     elif op == _OP_BEAT:
+                        worker = int(arrays[0][0])
+                        with self._beats_lock:
+                            self._last_beats[worker] = time.monotonic()
                         if self.monitor is not None:
-                            self.monitor.beat(int(arrays[0][0]))
+                            self.monitor.beat(worker)
                         _send_msg(conn, _OP_OK, [])
                     elif op == _OP_SHUTDOWN:
                         _send_msg(conn, _OP_OK, [])
@@ -294,14 +336,22 @@ class PSServer:
                                   [np.frombuffer(f"bad op {op}".encode(),
                                                  np.uint8)])
                 except Exception as e:  # noqa: BLE001 — report to client
+                    _m_rpc_errors.inc(op=opname)
                     try:
                         _send_msg(conn, _OP_ERR, [np.frombuffer(
                             f"{type(e).__name__}: {e}".encode(), np.uint8)])
                     except OSError:
                         return
+                finally:
+                    # runs on every exit path (continue/return included):
+                    # one count + one latency sample per request
+                    _m_rpc_count.inc(op=opname)
+                    _m_rpc_ms.observe((time.perf_counter() - t0) * 1000.0,
+                                      op=opname)
 
     def stop(self):
         self._running = False
+        _m_beat_age.remove(server=str(self.port))
         try:
             self._sock.close()
         except OSError:

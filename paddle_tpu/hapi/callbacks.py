@@ -145,6 +145,54 @@ class EarlyStopping(Callback):
                     print(f"Early stopping at epoch {epoch + 1}")
 
 
+class MetricsLogger(Callback):
+    """Publishes train-loop telemetry into the metrics registry
+    (`utils.monitor`): `train.steps` / `train.epochs` counters, a
+    `train.step_time_ms` histogram, and a `train.samples_per_sec` gauge
+    computed from the `batch_size` fit parameter (or a `batch_size` entry
+    in the step logs).  Collection obeys the `metrics` flag; pass a
+    `MetricRegistry` to publish somewhere other than the process default."""
+
+    def __init__(self, registry=None):
+        super().__init__()
+        from ..utils import monitor as _monitor
+
+        reg = registry or _monitor.default_registry()
+        self._steps = reg.counter(
+            "train.steps", "Completed training steps (hapi Model.fit).")
+        self._epochs = reg.counter(
+            "train.epochs", "Completed training epochs (hapi Model.fit).")
+        self._step_ms = reg.histogram(
+            "train.step_time_ms", "Wall time per training step (ms).")
+        self._sps = reg.gauge(
+            "train.samples_per_sec", "Training throughput over the last "
+            "step (needs batch_size in fit params or step logs).")
+        self._t0 = None
+
+    def on_train_begin(self, logs=None):
+        self._t0 = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        now = time.perf_counter()
+        if self._t0 is None:
+            # no batch_begin seen (custom loop): chain end-to-end instead
+            self._t0 = now
+            return
+        dt = now - self._t0
+        self._t0 = now
+        self._steps.inc()
+        self._step_ms.observe(dt * 1000.0)
+        batch = (logs or {}).get("batch_size") or self.params.get("batch_size")
+        if batch and dt > 0:
+            self._sps.set(float(batch) / dt)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epochs.inc()
+
+
 class LRSchedulerCallback(Callback):
     """Steps an LRScheduler once per epoch (ref: callbacks.py LRScheduler)."""
 

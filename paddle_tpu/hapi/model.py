@@ -127,6 +127,7 @@ class Model:
         cbs = cb_mod.CallbackList(callbacks, model=self,
                                   params={"epochs": epochs, "verbose": verbose,
                                           "steps": _safe_len(loader),
+                                          "batch_size": batch_size,
                                           "log_freq": log_freq})
         cbs.on_train_begin()
         self.stop_training = False
@@ -138,6 +139,7 @@ class Model:
             logs = {}
             from ..core import tape as _tape
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 if _tape.enabled():
                     loss, metric_outs = self._tape_fit_step(inputs, labels)

@@ -19,6 +19,7 @@ bit-identical to the primal's, so AD is exact.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import random as _random
+from ..utils import monitor as _monitor
+from ..utils import profiler as _profiler
 from . import ops as _ops  # registers lowerings
 from .backward import GRAD_SUFFIX
 from .framework import Program, Variable, default_main_program
@@ -278,6 +281,26 @@ def _lower_backward(program, block_idx, ops, bw_idx, env, base_key):
         env[gname] = grads[pname]
 
 
+# -- telemetry (utils/monitor.py; SURVEY §5.1) -------------------------------
+# Registered at import so metricsdump lists them even before any run; every
+# mutation is gated on the `metrics` flag inside the metric objects.
+_m_cache_hit = _monitor.counter(
+    "executor.cache_hit", "Executor.run compile-cache hits.")
+_m_cache_miss = _monitor.counter(
+    "executor.cache_miss", "Executor.run compile-cache misses (trace+compile).")
+_m_compile_ms = _monitor.histogram(
+    "executor.compile_time_ms",
+    "Wall time of a cache-miss step: trace + XLA compile + first run (ms).")
+_m_run_ms = _monitor.histogram(
+    "executor.run_time_ms", "Wall time of a cache-hit (steady-state) step (ms).")
+_m_prog_ops = _monitor.gauge(
+    "executor.program_ops", "Op count of the last-compiled program "
+    "(all blocks).", labelnames=("program",))
+_m_state_bytes = _monitor.gauge(
+    "executor.state_size_bytes", "Bytes of persistable state round-tripped "
+    "through the last step.", labelnames=("program",))
+
+
 _prog_tokens = iter(range(1, 1 << 62))
 
 
@@ -334,28 +357,51 @@ class Executor:
                             for k, v in feed_arrays.items())),
                tuple(id(d) for d in devices) if devices else None)
         compiled = self._cache.get(key)
-        if compiled is None:
+        cache_miss = compiled is None
+        t_compile0 = time.perf_counter()
+        if cache_miss:
+            _m_cache_miss.inc()
             from ..core import flags as _flags
 
-            if _flags.get_flag("check_program"):
-                # pre-trace static analysis (SURVEY §7: fail fast and
-                # legibly before jit) — once per compile-cache entry, so
-                # steady-state steps never re-verify
-                from .analysis import check_program as _check_program
+            with _profiler.RecordEvent("executor::trace_compile"):
+                if _flags.get_flag("check_program"):
+                    # pre-trace static analysis (SURVEY §7: fail fast and
+                    # legibly before jit) — once per compile-cache entry, so
+                    # steady-state steps never re-verify
+                    from .analysis import check_program as _check_program
 
-                _check_program(program, feed_names=set(feed_arrays),
-                               fetch_names=fetch_names)
-            compiled = self._build(program, list(feed_arrays), fetch_names,
-                                   state_names, devices=devices,
-                                   feed_arrays=feed_arrays)
+                    _check_program(program, feed_names=set(feed_arrays),
+                                   fetch_names=fetch_names)
+                compiled = self._build(program, list(feed_arrays),
+                                       fetch_names, state_names,
+                                       devices=devices,
+                                       feed_arrays=feed_arrays)
             self._cache[key] = compiled
+            if _monitor.enabled():
+                _m_prog_ops.set(sum(len(b.ops) for b in program.blocks),
+                                program=str(key[0]))
+        else:
+            _m_cache_hit.inc()
 
         state = {n: scope.find_var(n) for n in state_names
                  if scope.find_var(n) is not None}
+        if _monitor.enabled():
+            _m_state_bytes.set(
+                sum(getattr(v, "nbytes", 0) or 0 for v in state.values()),
+                program=str(key[0]))
         base_key = jax.random.PRNGKey(
             (program.random_seed or _random_seed()) + self._step)
         self._step += 1
-        fetches, new_state = compiled(feed_arrays, state, base_key)
+        t_run0 = time.perf_counter()
+        with _profiler.RecordEvent("executor::run"):
+            fetches, new_state = compiled(feed_arrays, state, base_key)
+        now = time.perf_counter()
+        # a miss's timing spans trace+compile+first run (XLA compiles on the
+        # first jitted call); steady-state hits time only the run
+        if cache_miss:
+            _m_compile_ms.observe((now - t_compile0) * 1000.0)
+        else:
+            _m_run_ms.observe((now - t_run0) * 1000.0)
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:

@@ -13,9 +13,17 @@ from __future__ import annotations
 import difflib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..utils import monitor as _monitor
+
 Lowering = Callable[..., Dict[str, List[Any]]]
 
 _REGISTRY: Dict[str, Lowering] = {}
+
+_lowering_calls = _monitor.counter(
+    "registry.lowering_calls",
+    "get_lowering resolutions per op type (trace-time only: a resolution "
+    "happens once per op per compile-cache miss, not per step).",
+    labelnames=("op",))
 
 
 def register_op(type_name: str):
@@ -32,7 +40,9 @@ def register_op(type_name: str):
 
 def get_lowering(type_name: str) -> Lowering:
     try:
-        return _REGISTRY[type_name]
+        rule = _REGISTRY[type_name]
+        _lowering_calls.inc(op=type_name)
+        return rule
     except KeyError:
         from ..core.errors import UnimplementedError
 

@@ -1,18 +1,464 @@
-"""Runtime stats monitor (Python face of the native StatRegistry).
+"""Runtime telemetry: typed metrics registry + the native StatRegistry shim.
 
 Reference parity: platform/monitor.h — `StatValue` (:43), `StatRegistry`
-(:84) and the STAT_ADD/STAT_RESET macros; values flow into the same
-process-wide native registry the C++ subsystems (datafeed) publish to, so
-`stats()` shows framework and native counters together.
+(:84) and the STAT_ADD/STAT_RESET macros.  The reference keeps a flat
+process-wide int registry that C++ subsystems (datafeed) publish to; that
+face survives here as the `stat_add`/`stat_set`/`stat_get`/`stat_reset`/
+`stats` compat shim over the ctypes bridge.
+
+TPU-native design (SURVEY §5.1): on top of the flat int store this module
+grows a real telemetry subsystem — thread-safe `Counter`/`Gauge`/`Histogram`
+metric types with optional labels, collected in a `MetricRegistry` with
+Prometheus-text and JSON exporters.  The Executor, the op-lowering registry,
+the PS server, and the hapi train loop publish into the process-wide
+`default_registry()`; `python -m tools.metricsdump` runs a small workload
+and dumps it.  Collection is gated behind the `metrics` flag
+(`PDTPU_FLAGS_metrics`, default on): with the flag off every instrumented
+path still runs but records nothing (one dict lookup of overhead per
+would-be sample).
+
+Metric names must match ``^[a-z0-9_.]+$`` (dots become underscores in the
+Prometheus rendering) so exporter output stays Prometheus-legal.
 """
 from __future__ import annotations
 
-from typing import Dict
+import math
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ..core import flags as _flags
 from ..core import native as _native
 
-__all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "stats"]
+__all__ = [
+    # metric types + registry
+    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "default_registry", "counter", "gauge", "histogram", "enabled",
+    "parse_prometheus_text", "TIME_MS_BUCKETS",
+    # native StatRegistry compat shim
+    "stat_add", "stat_set", "stat_get", "stat_reset", "stats",
+]
 
+_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+# Bucket ladder for wall-time histograms in milliseconds: sub-ms host work
+# up through multi-second XLA compiles.
+TIME_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                   100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+def enabled() -> bool:
+    """True when metric collection is on (the `metrics` flag)."""
+    return bool(_flags.get_flag("metrics"))
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else repr(float(bound))
+
+
+class Metric:
+    """Base: a named family of samples keyed by label values.
+
+    Mutators are no-ops while the `metrics` flag is off; reads and
+    registration always work, so exporters list every declared metric even
+    when collection never ran."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must match {_NAME_RE.pattern} "
+                "(lowercase, digits, '_', '.') to stay Prometheus-legal")
+        self.name = name
+        self.description = description
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if tuple(sorted(labels)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _labels_dict(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+    def samples(self) -> List[Tuple[Dict[str, str], Any]]:
+        """Snapshot [(labels, value)] — safe to iterate while writers run."""
+        with self._lock:
+            items = list(self._cells.items())
+        return [(self._labels_dict(k), v) for k, v in items]
+
+
+class Counter(Metric):
+    """Monotonically increasing count (ref StatValue::increase)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1, **labels) -> None:
+        if value < 0:
+            raise ValueError(f"counter {self.name!r}: cannot inc by {value}")
+        key = self._key(labels)
+        if not enabled():
+            return
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0) + value
+
+    def value(self, **labels):
+        with self._lock:
+            return self._cells.get(self._key(labels), 0)
+
+
+class Gauge(Metric):
+    """Last-written value; optionally computed at collect time via
+    `set_function` (the Prometheus callback-gauge pattern — used for
+    ages/sizes that are cheaper to compute on demand)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = "",
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, description, labelnames)
+        self._functions: Dict[Tuple[str, ...], Callable[[], float]] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        if not enabled():
+            return
+        with self._lock:
+            self._cells[key] = value
+
+    def inc(self, value: float = 1, **labels) -> None:
+        key = self._key(labels)
+        if not enabled():
+            return
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0) + value
+
+    def dec(self, value: float = 1, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def set_function(self, fn: Callable[[], float], **labels) -> None:
+        """Register `fn` to produce this sample's value at collect time.
+        Registration is independent of the `metrics` flag; the flag gates
+        whether collect evaluates it."""
+        key = self._key(labels)
+        with self._lock:
+            self._functions[key] = fn
+
+    def remove(self, **labels) -> None:
+        """Drop the sample (and any collect-time function) for `labels`."""
+        key = self._key(labels)
+        with self._lock:
+            self._cells.pop(key, None)
+            self._functions.pop(key, None)
+
+    def value(self, **labels):
+        key = self._key(labels)
+        with self._lock:
+            fn = self._functions.get(key)
+            if fn is None:
+                return self._cells.get(key, 0)
+        return fn()
+
+    def samples(self) -> List[Tuple[Dict[str, str], Any]]:
+        with self._lock:
+            items = dict(self._cells)
+            fns = list(self._functions.items())
+        if fns and enabled():
+            # evaluate callbacks outside the lock: a function touching other
+            # metrics (or this one) must not deadlock collection
+            for key, fn in fns:
+                items[key] = fn()
+        return [(self._labels_dict(k), v) for k, v in items.items()]
+
+
+class _HistCell:
+    __slots__ = ("count", "total", "mn", "mx", "bucket_counts")
+
+    def __init__(self, nbuckets: int):
+        self.count = 0
+        self.total = 0.0
+        self.mn = math.inf
+        self.mx = -math.inf
+        self.bucket_counts = [0] * nbuckets
+
+
+class Histogram(Metric):
+    """Bucketed distribution with count/sum/min/max (the per-event Agg of
+    profiler_helper.h, generalized to arbitrary observations)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, description, labelnames)
+        bounds = tuple(sorted(float(b) for b in (buckets or TIME_MS_BUCKETS)))
+        if not bounds or not math.isinf(bounds[-1]):
+            bounds = bounds + (math.inf,)
+        self.buckets = bounds
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        if not enabled():
+            return
+        v = float(value)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell(len(self.buckets))
+            cell.count += 1
+            cell.total += v
+            cell.mn = min(cell.mn, v)
+            cell.mx = max(cell.mx, v)
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    cell.bucket_counts[i] += 1
+                    break
+
+    class _Timer:
+        def __init__(self, hist: "Histogram", labels):
+            self._hist, self._labels = hist, labels
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._hist.observe((time.perf_counter() - self._t0) * 1000.0,
+                               **self._labels)
+            return False
+
+    def time(self, **labels) -> "Histogram._Timer":
+        """Context manager observing the block's wall time in ms."""
+        return Histogram._Timer(self, labels)
+
+    def _stat(self, cell: _HistCell) -> Dict[str, Any]:
+        cum, out = 0, {}
+        for bound, n in zip(self.buckets, cell.bucket_counts):
+            cum += n
+            out[_fmt_le(bound)] = cum
+        return {"count": cell.count, "sum": cell.total,
+                "min": cell.mn if cell.count else 0.0,
+                "max": cell.mx if cell.count else 0.0,
+                "buckets": out}
+
+    def samples(self) -> List[Tuple[Dict[str, str], Dict[str, Any]]]:
+        with self._lock:
+            items = [(k, self._stat(c)) for k, c in self._cells.items()]
+        return [(self._labels_dict(k), stat) for k, stat in items]
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            cell = self._cells.get(self._key(labels))
+            return cell.count if cell else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            cell = self._cells.get(self._key(labels))
+            return cell.total if cell else 0.0
+
+
+_KIND_TO_CLS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricRegistry:
+    """Process-wide set of named metrics with get-or-create registration
+    (registering the same (name, type, labelnames) twice returns the same
+    object — modules instrument at import without ownership fights)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.RLock()
+
+    # -- registration --------------------------------------------------------
+    def _get_or_create(self, cls, name: str, description: str,
+                       labelnames: Sequence[str], **kwargs) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}; cannot "
+                        f"re-register as {cls.kind} with labels "
+                        f"{tuple(labelnames)}")
+                return m
+            m = cls(name, description, labelnames, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, description: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, description, labelnames)
+
+    def gauge(self, name: str, description: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, description, labelnames)
+
+    def histogram(self, name: str, description: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, description, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def metrics(self) -> List[Metric]:
+        """Snapshot list — stable under concurrent registration."""
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self) -> None:
+        """Zero every metric's samples; registrations stay."""
+        for m in self.metrics():
+            with m._lock:
+                m._cells.clear()
+
+    # -- export --------------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot: `json.loads(json.dumps(x)) == x`."""
+        out: Dict[str, Any] = {}
+        for m in self.metrics():
+            entries = []
+            for labels, value in m.samples():
+                if m.kind == "histogram":
+                    entries.append({"labels": labels, **value})
+                else:
+                    entries.append({"labels": labels, "value": float(value)})
+            out[m.name] = {"type": m.kind, "description": m.description,
+                          "labelnames": list(m.labelnames),
+                          "samples": entries}
+        return {"metrics": out}
+
+    def prom_samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """Flat (prometheus_name, labels, value) triples — the exact sample
+        set `to_prometheus_text` renders (histograms expand to
+        `_bucket`/`_sum`/`_count`)."""
+        flat: List[Tuple[str, Dict[str, str], float]] = []
+        for m in self.metrics():
+            flat.extend(_samples_of(m, m.name.replace(".", "_")))
+        return flat
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition (text/plain; version 0.0.4)."""
+        lines: List[str] = []
+        for m in self.metrics():
+            pname = m.name.replace(".", "_")
+            if m.description:
+                lines.append(f"# HELP {pname} " + _escape_help(m.description))
+            lines.append(f"# TYPE {pname} {m.kind}")
+            for sname, labels, value in _samples_of(m, pname):
+                lines.append(_prom_line(sname, labels, value))
+        return "\n".join(lines) + "\n"
+
+
+def _samples_of(m: Metric, pname: str):
+    for labels, value in m.samples():
+        if m.kind == "histogram":
+            for le, n in value["buckets"].items():
+                yield pname + "_bucket", {**labels, "le": le}, float(n)
+            yield pname + "_sum", labels, float(value["sum"])
+            yield pname + "_count", labels, float(value["count"])
+        else:
+            yield pname, labels, float(value)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_line(name: str, labels: Dict[str, str], value: float) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_escape_label(str(labels[k]))}"'
+                        for k in sorted(labels))
+        return f"{name}{{{body}}} {repr(float(value))}"
+    return f"{name} {repr(float(value))}"
+
+
+_PROM_LINE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_PROM_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r'\\(.)')
+
+
+def _unescape_label(value: str) -> str:
+    return _UNESCAPE_RE.sub(
+        lambda m: {"n": "\n", '"': '"', "\\": "\\"}.get(m.group(1),
+                                                        m.group(1)), value)
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Parse Prometheus text exposition back to {(name, labelitems): value}
+    — the inverse of `to_prometheus_text` over `prom_samples` (used by the
+    round-trip tests and metricsdump consumers)."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable prometheus line: {line!r}")
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            for lm in _PROM_LABEL_RE.finditer(labelstr):
+                labels[lm.group(1)] = _unescape_label(lm.group(2))
+        out[(name, tuple(sorted(labels.items())))] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default registry + module-level conveniences.
+# ---------------------------------------------------------------------------
+_default = MetricRegistry()
+
+
+def default_registry() -> MetricRegistry:
+    return _default
+
+
+def counter(name: str, description: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return _default.counter(name, description, labelnames)
+
+
+def gauge(name: str, description: str = "",
+          labelnames: Sequence[str] = ()) -> Gauge:
+    return _default.gauge(name, description, labelnames)
+
+
+def histogram(name: str, description: str = "",
+              labelnames: Sequence[str] = (),
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return _default.histogram(name, description, labelnames, buckets)
+
+
+# ---------------------------------------------------------------------------
+# Native StatRegistry compat shim (ref platform/monitor.h).
+# ---------------------------------------------------------------------------
 stat_add = _native.stat_add
 stat_set = _native.stat_set
 stat_get = _native.stat_get
@@ -20,5 +466,19 @@ stat_reset = _native.stat_reset
 
 
 def stats() -> Dict[str, int]:
-    """All registered gauges, name -> value."""
-    return _native.stat_list()
+    """Flat int snapshot: native StatRegistry gauges merged with the default
+    registry's counters and gauges (labeled samples render as
+    ``name{k=v,...}``).  Always a fresh dict — PS-server/worker threads keep
+    mutating the live stores while the caller iterates this copy."""
+    out = dict(_native.stat_list())
+    for m in _default.metrics():
+        if m.kind not in ("counter", "gauge"):
+            continue
+        for labels, value in m.samples():
+            if labels:
+                body = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+                key = f"{m.name}{{{body}}}"
+            else:
+                key = m.name
+            out[key] = int(value)
+    return out

@@ -13,10 +13,14 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import json
 import time
 from typing import Optional
 
 from ..core import native as _native
+from . import monitor as _monitor
+
+_SORTED_KEYS = (None, "total", "calls", "max", "min", "ave")
 
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
@@ -65,13 +69,14 @@ def start_profiler(state: str = "All") -> None:
 
 def stop_profiler(sorted_key: Optional[str] = None,
                   profile_path: Optional[str] = None) -> None:
-    """Stop recording; print the summary table and optionally dump a
+    """Stop recording; print the summary table (sorted per `sorted_key`:
+    total|calls|max|min|ave, ref fluid stop_profiler) and optionally dump a
     chrome-trace timeline to `profile_path` (ref stop_profiler's
     profile_path dumps a proto; here it is directly chrome-trace JSON)."""
     _native.prof_disable()
     if profile_path:
-        _native.prof_export_chrome(profile_path)
-    s = _native.prof_summary()
+        export_chrome_tracing(profile_path)
+    s = summary(sorted_key)
     if s:
         print(s)
 
@@ -91,16 +96,43 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
         stop_profiler(sorted_key, profile_path)
 
 
-def export_chrome_tracing(path: str) -> int:
+def export_chrome_tracing(path: str, registry=None) -> int:
     """Dump all recorded host events as chrome://tracing JSON
-    (ref tools/timeline.py). Returns number of events written."""
-    return _native.prof_export_chrome(path)
+    (ref tools/timeline.py), merging the metric registry's counter samples
+    as chrome counter-track (`ph:"C"`) events so the trace viewer shows
+    cache-hit/RPC/step counts alongside the spans.  Returns the number of
+    events written."""
+    n = _native.prof_export_chrome(path)
+    if n >= 0:
+        with open(path) as f:
+            data = json.load(f)
+    else:  # native runtime unavailable: counters-only trace
+        data = {"traceEvents": []}
+    events = data.setdefault("traceEvents", [])
+    ts_us = time.time() * 1e6
+    reg = registry if registry is not None else _monitor.default_registry()
+    for m in reg.metrics():
+        if m.kind != "counter":
+            continue
+        for labels, value in m.samples():
+            name = m.name
+            if labels:
+                name += "{" + ",".join(f"{k}={labels[k]}"
+                                       for k in sorted(labels)) + "}"
+            events.append({"name": name, "ph": "C", "pid": 0, "ts": ts_us,
+                           "args": {"value": float(value)}})
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return len(events)
 
 
-def summary() -> str:
-    """Aggregated per-event table sorted by total time
-    (ref profiler_helper.h table)."""
-    return _native.prof_summary()
+def summary(sorted_key: Optional[str] = None) -> str:
+    """Aggregated per-event table, sorted descending by `sorted_key`
+    (total|calls|max|min|ave; default total — ref profiler_helper.h)."""
+    if sorted_key not in _SORTED_KEYS:
+        raise ValueError(
+            f"sorted_key must be one of {_SORTED_KEYS}, got {sorted_key!r}")
+    return _native.prof_summary(sorted_key)
 
 
 # ---------------------------------------------------------------- devices --

@@ -1,0 +1,461 @@
+"""Runtime telemetry layer: metric types, registry, exporters, flag gating,
+and the instrumented Executor / op registry / PS server / hapi loop
+(utils/monitor.py; ref platform/monitor.h StatRegistry + SURVEY §5.1)."""
+import json
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core import flags
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import monitor
+
+
+# ---------------------------------------------------------------------------
+# metric types + registry
+# ---------------------------------------------------------------------------
+def test_counter_inc_and_value():
+    r = monitor.MetricRegistry()
+    c = r.counter("t.count", "a counter")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_labeled_counter_and_label_validation():
+    r = monitor.MetricRegistry()
+    c = r.counter("t.rpc", "per-op", labelnames=("op",))
+    c.inc(op="pull")
+    c.inc(2, op="push")
+    assert c.value(op="pull") == 1
+    assert c.value(op="push") == 2
+    assert c.value(op="absent") == 0
+    with pytest.raises(ValueError):
+        c.inc(wrong_label="x")
+    with pytest.raises(ValueError):
+        c.inc()  # missing required label
+
+
+def test_gauge_set_inc_dec_and_function():
+    r = monitor.MetricRegistry()
+    g = r.gauge("t.gauge")
+    g.set(10)
+    g.inc(5)
+    g.dec(3)
+    assert g.value() == 12
+    fg = r.gauge("t.fn_gauge")
+    fg.set_function(lambda: 42.5)
+    assert fg.value() == 42.5
+    assert dict((tuple(l.items()), v) for l, v in fg.samples()) == {(): 42.5}
+    fg.remove()
+    assert fg.value() == 0
+
+
+def test_histogram_observe_stats_and_buckets():
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.lat", buckets=(1, 10, 100))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(555.5)
+    ((labels, stat),) = h.samples()
+    assert labels == {}
+    assert stat["min"] == 0.5 and stat["max"] == 500.0
+    # cumulative bucket counts, +Inf catches the overflow
+    assert stat["buckets"] == {"1.0": 1, "10.0": 2, "100.0": 3, "+Inf": 4}
+
+
+def test_histogram_time_context_manager():
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.timer")
+    with h.time():
+        pass
+    assert h.count() == 1
+    assert h.sum() >= 0.0
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    r = monitor.MetricRegistry()
+    c1 = r.counter("t.same", "first")
+    c2 = r.counter("t.same", "second wording ignored")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        r.gauge("t.same")  # same name, different type
+    with pytest.raises(ValueError):
+        r.counter("t.same", labelnames=("op",))  # different labels
+
+
+def test_illegal_metric_names_rejected():
+    r = monitor.MetricRegistry()
+    for bad in ("Upper.case", "has space", "dash-ed", "semi;colon", ""):
+        with pytest.raises(ValueError):
+            r.counter(bad)
+
+
+def test_counter_thread_safety_exact_total():
+    r = monitor.MetricRegistry()
+    c = r.counter("t.contended")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+# ---------------------------------------------------------------------------
+# exporters round-trip
+# ---------------------------------------------------------------------------
+def _populated_registry():
+    r = monitor.MetricRegistry()
+    r.counter("t.hits", "hits").inc(3)
+    g = r.gauge("t.size_bytes", "sz", labelnames=("program",))
+    g.set(1024, program="1")
+    g.set(2048, program="2")
+    h = r.histogram("t.ms", "lat", labelnames=("op",), buckets=(1, 10))
+    h.observe(0.5, op='pu"ll\\x')  # exercises label escaping
+    h.observe(99.0, op='pu"ll\\x')
+    return r
+
+
+def test_prometheus_text_round_trip():
+    r = _populated_registry()
+    text = r.to_prometheus_text()
+    parsed = monitor.parse_prometheus_text(text)
+    flat = {(name, tuple(sorted(labels.items()))): value
+            for name, labels, value in r.prom_samples()}
+    assert parsed == flat
+    assert parsed[("t_hits", ())] == 3.0
+    assert parsed[("t_ms_count", (("op", 'pu"ll\\x'),))] == 2.0
+    # dots became underscores: prometheus-legal names only
+    for name, _ in parsed:
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), name
+
+
+def test_json_export_round_trips_and_matches():
+    r = _populated_registry()
+    doc = r.to_json()
+    assert json.loads(json.dumps(doc)) == doc
+    m = doc["metrics"]
+    assert m["t.hits"]["type"] == "counter"
+    assert m["t.hits"]["samples"][0]["value"] == 3.0
+    sizes = {s["labels"]["program"]: s["value"]
+             for s in m["t.size_bytes"]["samples"]}
+    assert sizes == {"1": 1024.0, "2": 2048.0}
+    hist = m["t.ms"]["samples"][0]
+    assert hist["count"] == 2 and hist["min"] == 0.5 and hist["max"] == 99.0
+
+
+def test_registry_reset_keeps_registrations():
+    r = _populated_registry()
+    r.reset()
+    assert "t.hits" in r.names()
+    assert r.get("t.hits").value() == 0
+
+
+# ---------------------------------------------------------------------------
+# flag gating: PDTPU_FLAGS_metrics=0 must record nothing but never break
+# ---------------------------------------------------------------------------
+def test_metrics_flag_off_records_nothing():
+    r = monitor.MetricRegistry()
+    c, g, h = r.counter("t.c"), r.gauge("t.g"), r.histogram("t.h")
+    flags.set_flags({"metrics": False})
+    try:
+        assert not monitor.enabled()
+        c.inc()
+        g.set(9)
+        h.observe(1.0)
+        fg = r.gauge("t.fg")
+        fg.set_function(lambda: 1 / 0)  # collect must not evaluate when off
+        assert c.value() == 0 and g.value() == 0 and h.count() == 0
+        assert fg.samples() == []  # function not called -> no ZeroDivision
+    finally:
+        flags.set_flags({"metrics": True})
+
+
+def test_executor_runs_fine_with_metrics_off(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    out = L.fc(x, 2)
+    flags.set_flags({"metrics": False})
+    try:
+        reg = monitor.default_registry()
+        miss0 = reg.get("executor.cache_miss").value()
+        exe = static.Executor()
+        exe.run(startup)
+        res, = exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                       fetch_list=[out])
+        assert res.shape == (3, 2)
+        assert reg.get("executor.cache_miss").value() == miss0
+    finally:
+        flags.set_flags({"metrics": True})
+
+
+# ---------------------------------------------------------------------------
+# instrumented executor: the cache-behavior contract (satellite)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+def _tiny_net():
+    x = L.data("x", [8])
+    y = L.data("y", [1])
+    pred = L.fc(L.fc(x, 16, act="relu"), 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss, pred
+
+
+def test_executor_cache_one_compile_n_hits(_fresh_programs):
+    main, startup = _fresh_programs
+    loss, _pred = _tiny_net()
+    reg = monitor.default_registry()
+    exe = static.Executor()
+    exe.run(startup)
+
+    miss0 = reg.get("executor.cache_miss").value()
+    hit0 = reg.get("executor.cache_hit").value()
+    compile0 = reg.get("executor.compile_time_ms").count()
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.normal(size=(16, 8)).astype(np.float32),
+            "y": rng.normal(size=(16, 1)).astype(np.float32)}
+    n = 5
+    for _ in range(n):
+        exe.run(main, feed=feed, fetch_list=[loss])
+
+    # same program + same feed signature + same fetch list = ONE compile
+    assert reg.get("executor.cache_miss").value() - miss0 == 1
+    assert reg.get("executor.cache_hit").value() - hit0 == n - 1
+    assert reg.get("executor.compile_time_ms").count() - compile0 == 1
+    assert reg.get("executor.compile_time_ms").sum() > 0.0
+    assert reg.get("executor.run_time_ms").count() >= n - 1
+
+
+def test_executor_changed_fetch_list_recompiles(_fresh_programs):
+    main, startup = _fresh_programs
+    loss, pred = _tiny_net()
+    reg = monitor.default_registry()
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    miss0 = reg.get("executor.cache_miss").value()
+    exe.run(main, feed=feed, fetch_list=[loss, pred])  # new fetch signature
+    assert reg.get("executor.cache_miss").value() - miss0 == 1
+    exe.run(main, feed=feed, fetch_list=[loss, pred])  # cached again
+    assert reg.get("executor.cache_miss").value() - miss0 == 1
+
+
+def test_executor_gauges_and_lowering_counter(_fresh_programs):
+    main, startup = _fresh_programs
+    loss, _ = _tiny_net()
+    reg = monitor.default_registry()
+    mul0 = reg.get("registry.lowering_calls").value(op="mul")
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    # two fc layers -> >= 2 mul lowerings traced (+ backward replay)
+    assert reg.get("registry.lowering_calls").value(op="mul") - mul0 >= 2
+    # per-program gauges landed for this program's token
+    ops_samples = dict((l["program"], v)
+                       for l, v in reg.get("executor.program_ops").samples())
+    state_samples = dict(
+        (l["program"], v)
+        for l, v in reg.get("executor.state_size_bytes").samples())
+    assert any(v > 0 for v in ops_samples.values())
+    assert any(v > 0 for v in state_samples.values())
+
+
+# ---------------------------------------------------------------------------
+# stats() compat shim: snapshot semantics (satellite)
+# ---------------------------------------------------------------------------
+def test_stats_merges_native_and_registry():
+    monitor.stat_reset("t.native_side")
+    monitor.stat_add("t.native_side", 7)
+    c = monitor.counter("t.python_side", "merged into stats()")
+    c.inc(3)
+    snap = monitor.stats()
+    assert snap["t.native_side"] == 7
+    assert snap["t.python_side"] >= 3
+
+
+def test_stats_returns_snapshot_safe_to_iterate():
+    stop = threading.Event()
+
+    def mutator():
+        i = 0
+        while not stop.is_set():
+            monitor.stat_add(f"t.churn{i % 50}", 1)
+            monitor.counter(f"t.pychurn{i % 50}").inc()
+            i += 1
+
+    t = threading.Thread(target=mutator, daemon=True)
+    t.start()
+    try:
+        for _ in range(30):
+            snap = monitor.stats()
+            for k, v in snap.items():  # must not raise RuntimeError
+                assert isinstance(k, str)
+            snap["t.injected"] = 1  # caller-owned copy, not the live store
+    finally:
+        stop.set()
+        t.join()
+    assert "t.injected" not in monitor.stats()
+
+
+# ---------------------------------------------------------------------------
+# PS server RPC metrics + heartbeat-age gauge
+# ---------------------------------------------------------------------------
+def test_ps_server_rpc_metrics_and_heartbeat_age():
+    from paddle_tpu.distributed.ps import SparseTable
+    from paddle_tpu.distributed.ps_server import PSServer, RemoteSparseTable
+
+    reg = monitor.default_registry()
+    rpc = reg.get("ps.rpc_count")
+    lat = reg.get("ps.rpc_latency_ms")
+    pull0, push0 = rpc.value(op="pull"), rpc.value(op="push")
+    latency0 = lat.count(op="pull")
+
+    srv = PSServer(SparseTable(dim=8, num_shards=2, optimizer="sgd",
+                               seed=3)).start()
+    try:
+        age = reg.get("ps.heartbeat_age_seconds")
+        assert age.value(server=str(srv.port)) == -1.0  # no beats yet
+        remote = RemoteSparseTable([srv.endpoint], dim=8)
+        ids = np.array([1, 2, 3], np.int64)
+        rows = remote.pull(ids)
+        remote.push(ids, np.ones_like(rows), lr=0.1)
+        remote.beat(0)
+        assert rpc.value(op="pull") - pull0 == 1
+        assert rpc.value(op="push") - push0 == 1
+        assert lat.count(op="pull") - latency0 == 1
+        beat_age = age.value(server=str(srv.port))
+        assert 0.0 <= beat_age < 30.0
+        # the gauge shows up in a collect pass too
+        sampled = dict((l["server"], v) for l, v in age.samples())
+        assert str(srv.port) in sampled
+        remote.close()
+    finally:
+        srv.stop()
+    # stop() retires this server's sample so dead servers don't linger
+    sampled = dict(
+        (l["server"], v)
+        for l, v in reg.get("ps.heartbeat_age_seconds").samples())
+    assert str(srv.port) not in sampled
+
+
+# ---------------------------------------------------------------------------
+# hapi MetricsLogger
+# ---------------------------------------------------------------------------
+def test_metrics_logger_records_steps_and_throughput():
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+
+    reg = monitor.MetricRegistry()
+    cb = MetricsLogger(registry=reg)
+    cb.set_params({"batch_size": 32})
+    cb.on_train_begin()
+    for epoch in range(2):
+        for step in range(3):
+            cb.on_train_batch_begin(step)
+            cb.on_train_batch_end(step)
+        cb.on_epoch_end(epoch)
+    assert reg.get("train.steps").value() == 6
+    assert reg.get("train.epochs").value() == 2
+    assert reg.get("train.step_time_ms").count() == 6
+    assert reg.get("train.samples_per_sec").value() > 0
+
+
+def test_metrics_logger_in_model_fit():
+    import paddle_tpu as pd
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+    from paddle_tpu.hapi.model import Model
+
+    reg = monitor.MetricRegistry()
+    net = pd.nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(optimizer=pd.optimizer.SGD(learning_rate=0.1),
+                  loss=pd.nn.CrossEntropyLoss())
+    xs = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    ys = np.random.default_rng(1).integers(0, 2, (8, 1)).astype(np.int64)
+
+    class _Toy(pd.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    data = _Toy()
+    model.fit(data, batch_size=4, epochs=1, verbose=0,
+              callbacks=[MetricsLogger(registry=reg)])
+    assert reg.get("train.steps").value() == 2  # 8 samples / batch 4
+    assert reg.get("train.epochs").value() == 1
+    assert reg.get("train.step_time_ms").count() == 2
+    assert reg.get("train.samples_per_sec").value() > 0
+
+
+# ---------------------------------------------------------------------------
+# metric-name lint + metricsdump CLI (satellites)
+# ---------------------------------------------------------------------------
+def test_all_registered_metric_names_are_legal():
+    # import every instrumented layer, then lint the default registry
+    import paddle_tpu.distributed.ps_server  # noqa: F401
+    import paddle_tpu.static.executor  # noqa: F401
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+
+    MetricsLogger()
+    from tools.metricsdump import lint_names
+
+    assert lint_names(monitor.default_registry()) == []
+    assert len(monitor.default_registry().names()) >= 12
+
+
+def test_metricsdump_cli_smoke(tmp_path):
+    out = tmp_path / "metrics.json"
+    chrome = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.metricsdump", "--format", "json",
+         "--steps", "2", "--out", str(out), "--chrome", str(chrome)],
+        capture_output=True, text=True, timeout=300, cwd=_repo_root())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out.read_text())["metrics"]
+    # startup program + main program: one compile each; the second main
+    # step is the only cache hit
+    assert doc["executor.cache_miss"]["samples"][0]["value"] == 2.0
+    assert doc["executor.cache_hit"]["samples"][0]["value"] == 1.0
+    assert doc["executor.compile_time_ms"]["samples"][0]["count"] == 2
+    assert doc["executor.compile_time_ms"]["samples"][0]["sum"] > 0
+    # chrome trace carries the counter track alongside profiler spans
+    events = json.loads(chrome.read_text())["traceEvents"]
+    counter_names = {e["name"] for e in events if e.get("ph") == "C"}
+    assert "executor.cache_miss" in counter_names
+
+    lint = subprocess.run(
+        [sys.executable, "-m", "tools.metricsdump", "--lint"],
+        capture_output=True, text=True, timeout=300, cwd=_repo_root())
+    assert lint.returncode == 0, lint.stderr[-2000:]
+
+
+def _repo_root():
+    import os
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

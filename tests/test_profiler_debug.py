@@ -39,6 +39,71 @@ def test_record_event_decorator(tmp_path):
     assert "decorated_fn" in profiler.summary()
 
 
+def test_summary_sorted_key_orders_rows():
+    """Regression: sorted_key was accepted and ignored (fluid API contract:
+    total|calls|max|min|ave, descending)."""
+    from paddle_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    ms = 1_000_000
+    for _ in range(3):
+        native.prof_add_span("many_short", 0, 1 * ms)
+    native.prof_add_span("one_long", 0, 500 * ms)
+    try:
+        def first_row(key):
+            return profiler.summary(key).splitlines()[1].split()[0]
+
+        assert first_row("total") == "one_long"
+        assert first_row("max") == "one_long"
+        assert first_row(None) == "one_long"  # default stays total-sorted
+        assert first_row("calls") == "many_short"
+        assert first_row("min") == "one_long"  # descending: largest min first
+        with pytest.raises(ValueError, match="sorted_key"):
+            profiler.summary("bogus")
+    finally:
+        profiler.stop_profiler(sorted_key="calls")
+
+
+def test_stop_profiler_prints_sorted_table(capsys):
+    from paddle_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    ms = 1_000_000
+    for _ in range(5):
+        native.prof_add_span("frequent", 0, 1 * ms)
+    native.prof_add_span("slow", 0, 900 * ms)
+    profiler.stop_profiler(sorted_key="calls")
+    lines = capsys.readouterr().out.splitlines()
+    assert lines[1].startswith("frequent"), lines[:3]
+
+
+def test_chrome_trace_merges_counter_samples(tmp_path):
+    from paddle_tpu.utils import monitor
+
+    profiler.reset_profiler()
+    monitor.counter("pytest.chrome_counter", "merged into traces").inc(4)
+    profiler.start_profiler()
+    with profiler.RecordEvent("span_for_chrome"):
+        pass
+    profiler.stop_profiler()
+    path = str(tmp_path / "merged.json")
+    profiler.export_chrome_tracing(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    counters = {e["name"]: e["args"]["value"]
+                for e in events if e.get("ph") == "C"}
+    assert counters.get("pytest.chrome_counter", 0) >= 4
+    spans = {e["name"] for e in events if e.get("ph") == "X"}
+    if spans:  # native side present: spans and counters share one timeline
+        assert "span_for_chrome" in spans
+
+
 def test_monitor_stats():
     monitor.stat_reset("pytest.gauge")
     monitor.stat_add("pytest.gauge", 5)

@@ -1,0 +1,128 @@
+"""Run a small static-graph workload and dump the runtime metric registry.
+
+The observability analogue of proglint: a one-command answer to "is the
+telemetry layer wired up, and what does it report?"  Builds a tiny fc
+regression program, runs the Executor a few steps (one compile + N cached
+runs), then prints the process-wide `MetricRegistry` as Prometheus text or
+JSON — so `executor.cache_miss/.cache_hit`, the compile/run histograms,
+`registry.lowering_calls{op=...}` and friends are all populated.
+
+Usage::
+
+    python -m tools.metricsdump                    # prometheus text
+    python -m tools.metricsdump --format json
+    python -m tools.metricsdump --steps 10 --out metrics.prom
+    python -m tools.metricsdump --chrome trace.json   # spans + counter track
+    python -m tools.metricsdump --lint             # metric-name lint only
+
+`--lint` checks every registered metric name against ``^[a-z0-9_.]+$``
+(the registry enforces this at registration; the lint is the CI backstop
+that keeps exporter output Prometheus-legal) and exits non-zero on any
+violation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+def run_workload(steps: int = 3) -> None:
+    """One compile + (steps - 1) cached Executor runs of a tiny fc model."""
+    import numpy as np
+
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+    from paddle_tpu.utils import profiler
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = L.data("x", [8])
+        y = L.data("y", [1])
+        hidden = L.fc(x, 16, act="relu")
+        pred = L.fc(hidden, 1)
+        loss = L.mean(L.square_error_cost(pred, y))
+        static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 8)).astype(np.float32)
+    yv = rng.normal(size=(16, 1)).astype(np.float32)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(max(1, steps)):
+            with profiler.RecordEvent("metricsdump::step"):
+                exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+
+def _register_instrumented_modules() -> None:
+    """Import every instrumented layer so its metrics are registered even
+    when the workload doesn't exercise it (PS server, hapi loop)."""
+    import paddle_tpu.distributed.ps_server  # noqa: F401
+    import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+
+    MetricsLogger()  # registers the train.* family
+
+
+def lint_names(registry) -> list:
+    return [n for n in registry.names() if not _NAME_RE.match(n)]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.metricsdump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--format", choices=("prom", "json"), default="prom",
+                        help="export format (default: prometheus text)")
+    parser.add_argument("--steps", type=int, default=3,
+                        help="Executor.run steps (first one compiles)")
+    parser.add_argument("--out", default=None,
+                        help="write to this file instead of stdout")
+    parser.add_argument("--chrome", default=None,
+                        help="also export a chrome trace (spans + counter "
+                        "track) to this path")
+    parser.add_argument("--lint", action="store_true",
+                        help="lint registered metric names instead of "
+                        "running the workload dump")
+    args = parser.parse_args(argv)
+
+    from paddle_tpu.utils import monitor, profiler
+
+    registry = monitor.default_registry()
+    _register_instrumented_modules()
+
+    if args.lint:
+        bad = lint_names(registry)
+        if bad:
+            for name in bad:
+                print(f"metricsdump: illegal metric name {name!r} "
+                      f"(must match {_NAME_RE.pattern})", file=sys.stderr)
+            return 1
+        print(f"metricsdump: {len(registry.names())} metric names OK")
+        return 0
+
+    profiler.start_profiler()
+    run_workload(args.steps)
+    if args.chrome:
+        profiler.export_chrome_tracing(args.chrome)
+
+    if args.format == "json":
+        text = json.dumps(registry.to_json(), indent=2, sort_keys=True)
+    else:
+        text = registry.to_prometheus_text()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
