@@ -60,6 +60,16 @@ del _n
 
 __version__ = "0.1.0"
 
+# Launch-worker tracing bootstrap: under `distributed.launch --trace_dir`
+# every worker has PDTPU_TRACE_DIR set; arm the per-rank chrome-trace dump
+# and the flight-recorder post-mortem (utils/trace.py) before user code runs.
+import os as _os
+
+if _os.environ.get("PDTPU_TRACE_DIR"):
+    from .utils import trace as _trace
+
+    _trace.arm_from_env()
+
 
 def is_tensor(x) -> bool:
     import jax

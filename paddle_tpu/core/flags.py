@@ -95,6 +95,12 @@ define_flag("metrics", True, "Collect runtime telemetry into the metrics "
             "(PDTPU_FLAGS_metrics=0): instrumented paths still run but "
             "record nothing (ref: platform/monitor.h StatRegistry, always-on "
             "in the reference).")
+define_flag("flight_recorder_size", 512, "Ring-buffer capacity of the "
+            "in-memory flight recorder (utils/trace.py): the last N "
+            "structured events (spans, RPCs, executor runs, heartbeats, NaN "
+            "hits, exceptions) dumped to JSON post-mortem when a worker "
+            "dies (no reference analogue — a crashed trainer there leaves "
+            "only an exit code).")
 define_flag("check_program", True, "Statically verify Programs before the "
             "Executor traces them (static/analysis.py): dataflow, registry, "
             "structure, and shape/dtype plausibility checks with typed "

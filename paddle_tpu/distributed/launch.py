@@ -28,6 +28,7 @@ import signal
 import subprocess
 import sys
 import time
+import uuid
 from typing import List, Optional
 
 __all__ = ["launch", "main"]
@@ -42,13 +43,25 @@ def _free_port() -> int:
 
 def launch(training_script: str, script_args: List[str],
            nproc: int = 1, started_port: Optional[int] = None,
-           log_dir: Optional[str] = None, backend_env: str = "") -> int:
+           log_dir: Optional[str] = None, backend_env: str = "",
+           trace_dir: Optional[str] = None) -> int:
     """Spawn `nproc` worker processes with the trainer-env contract.
-    Returns the first nonzero exit code, or 0."""
+    Returns the first nonzero exit code, or 0.
+
+    Every job mints one trace_id (PDTPU_TRACE_ID) that all ranks share, so
+    spans across workers and PS RPCs correlate into a single distributed
+    trace (utils/trace.py).  With `trace_dir`, workers additionally get
+    PDTPU_TRACE_DIR: each rank atexit-dumps a chrome trace
+    (trace.rank<r>.json, mergeable via `python -m tools.tracecat`) and arms
+    a flight-recorder post-mortem (flight.rank<r>.json) on crash/SIGTERM —
+    a dead rank leaves more than an exit code."""
     base_port = started_port or _free_port()
     endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
+    job_trace_id = uuid.uuid4().hex
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
     procs: List[subprocess.Popen] = []
     logs = []
     exit_code = 0
@@ -63,7 +76,10 @@ def launch(training_script: str, script_args: List[str],
                 "PADDLE_TRAINER_ENDPOINTS": endpoints,
                 "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
                 "PADDLE_COORDINATOR": f"127.0.0.1:{base_port}",
+                "PDTPU_TRACE_ID": job_trace_id,
             })
+            if trace_dir:
+                env["PDTPU_TRACE_DIR"] = trace_dir
             for kv in backend_env.split(","):
                 if "=" in kv:
                     k, v = kv.split("=", 1)
@@ -122,11 +138,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("--backend_env", type=str, default="",
                         help="extra env as k=v,k=v passed to workers")
+    parser.add_argument("--trace_dir", type=str, default=None,
+                        help="directory for per-rank chrome traces + "
+                        "flight-recorder post-mortems (merge with "
+                        "`python -m tools.tracecat`)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.training_script, args.script_args, args.nproc,
-                  args.started_port, args.log_dir, args.backend_env)
+                  args.started_port, args.log_dir, args.backend_env,
+                  args.trace_dir)
 
 
 if __name__ == "__main__":

@@ -490,8 +490,13 @@ class HeartBeatMonitor:
                 revived = True
             self._beats[worker_id] = time.monotonic()
             self._reported.discard(worker_id)
-        if revived and self.on_revive is not None:
-            self.on_revive(worker_id)
+        if revived:
+            from ..utils import trace as _trace
+
+            _trace.flight_recorder().record(
+                "worker_revive", name=f"worker{worker_id}", worker=worker_id)
+            if self.on_revive is not None:
+                self.on_revive(worker_id)
 
     def dead_workers(self) -> List[int]:
         now = time.monotonic()
@@ -515,6 +520,11 @@ class HeartBeatMonitor:
                             self._reported.add(w)
                             to_report.append(w)
                 for w in to_report:
+                    from ..utils import trace as _trace
+
+                    _trace.flight_recorder().record(
+                        "worker_dead", name=f"worker{w}", worker=w,
+                        timeout_s=self.timeout_s)
                     if self.on_dead is not None:
                         try:
                             self.on_dead(w)

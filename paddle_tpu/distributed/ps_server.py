@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..utils import monitor as _monitor
+from ..utils import trace as _trace
 from .ps import SparseTable
 
 __all__ = ["PSServer", "RemoteSparseTable", "serve_forever"]
@@ -71,7 +72,12 @@ _m_beat_age = _monitor.gauge(
     "heart_beat_monitor.h).", labelnames=("server",))
 
 
-def _send_msg(sock: socket.socket, op: int, arrays: Sequence[np.ndarray]):
+def _send_msg(sock: socket.socket, op: int, arrays: Sequence[np.ndarray],
+              traceparent: Optional[str] = None):
+    """Frame = op byte + array count + per-array blocks + an optional
+    trailing W3C traceparent (trace context rides the RPC payload, so
+    server-side handling is correlated to the calling trainer's span —
+    the cross-process analogue of the reference's per-process timelines)."""
     parts = [struct.pack("<BI", op, len(arrays))]
     for a in arrays:
         a = np.ascontiguousarray(a)
@@ -83,6 +89,8 @@ def _send_msg(sock: socket.socket, op: int, arrays: Sequence[np.ndarray]):
             parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
         parts.append(struct.pack("<Q", a.nbytes))
         parts.append(a.tobytes())
+    if traceparent:
+        parts.append(traceparent.encode("ascii"))
     payload = b"".join(parts)
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
@@ -120,7 +128,9 @@ def _recv_msg(sock: socket.socket):
                             offset=off).reshape(shape).copy()
         off += nbytes
         arrays.append(arr)
-    return op, arrays
+    # trailing bytes (absent in pre-trace frames) are the traceparent
+    traceparent = buf[off:].decode("ascii", errors="replace") or None
+    return op, arrays, traceparent
 
 
 class PSServer:
@@ -256,98 +266,110 @@ class PSServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
                 try:
-                    op, arrays = _recv_msg(conn)
+                    op, arrays, tp = _recv_msg(conn)
                 except (ConnectionError, OSError):
                     return
                 opname = _OP_NAMES.get(op, f"op{op}")
+                # parent this request's span under the calling trainer's
+                # context (the traceparent rides the frame) so client and
+                # server spans share one trace_id across the process gap
+                caller = _trace.extract({"traceparent": tp})
                 t0 = time.perf_counter()
-                try:
-                    if op == _OP_PULL:
-                        rows = self.table.pull(arrays[0])
-                        _send_msg(conn, _OP_OK, [rows])
-                    elif op == _OP_PUSH:
-                        ids, grads, lr = arrays[:3]
-                        if not self._begin_apply(arrays[3:]):
-                            _send_msg(conn, _OP_OK, [])
-                            continue
-                        try:
-                            self.table.push(ids, grads, float(lr[0]))
-                        except BaseException:
-                            self._abort_apply(arrays[3:])
-                            raise
-                        self._record_applied(arrays[3:])
-                        _send_msg(conn, _OP_OK, [])
-                    elif op == _OP_DELTA:
-                        if not self._begin_apply(arrays[2:]):
-                            _send_msg(conn, _OP_OK, [])
-                            continue
-                        try:
-                            self.table.apply_delta(arrays[0], arrays[1])
-                        except BaseException:
-                            self._abort_apply(arrays[2:])
-                            raise
-                        self._record_applied(arrays[2:])
-                        _send_msg(conn, _OP_OK, [])
-                    elif op == _OP_NUM_ROWS:
-                        _send_msg(conn, _OP_OK,
-                                  [np.asarray([self.table.num_rows],
-                                              np.int64)])
-                    elif op == _OP_STATE:
-                        st = self.table.state_dict()
-                        _send_msg(conn, _OP_OK,
-                                  [st[k] for k in _STATE_KEYS])
-                    elif op == _OP_LOAD:
-                        self.table.load_state_dict(
-                            dict(zip(_STATE_KEYS, arrays)))
-                        _send_msg(conn, _OP_OK, [])
-                    elif op == _OP_BARRIER:
-                        name = bytes(arrays[0]).decode()
-                        n = int(arrays[1][0])
-                        b = self._get_barrier(name.encode(), n)
-                        try:
-                            idx = b.wait(timeout=self.barrier_timeout_s)
-                            if idx == 0:
-                                # all parties released; step-named barriers
-                                # are never reused — drop the entry so a
-                                # long run doesn't leak one per step
-                                with self._barrier_lock:
-                                    self._barriers.pop(name.encode(), None)
-                        except threading.BrokenBarrierError:
-                            _send_msg(conn, _OP_ERR, [np.frombuffer(
-                                f"barrier {name!r} broken (a worker "
-                                "missed the rendezvous within "
-                                f"{self.barrier_timeout_s}s)".encode(),
-                                np.uint8)])
-                            continue
-                        _send_msg(conn, _OP_OK, [])
-                    elif op == _OP_BEAT:
-                        worker = int(arrays[0][0])
-                        with self._beats_lock:
-                            self._last_beats[worker] = time.monotonic()
-                        if self.monitor is not None:
-                            self.monitor.beat(worker)
-                        _send_msg(conn, _OP_OK, [])
-                    elif op == _OP_SHUTDOWN:
-                        _send_msg(conn, _OP_OK, [])
-                        self.stop()
-                        return
-                    else:
-                        _send_msg(conn, _OP_ERR,
-                                  [np.frombuffer(f"bad op {op}".encode(),
-                                                 np.uint8)])
-                except Exception as e:  # noqa: BLE001 — report to client
-                    _m_rpc_errors.inc(op=opname)
+                with _trace.span(f"ps::{opname}", parent=caller,
+                                 server=str(self.port)):
                     try:
-                        _send_msg(conn, _OP_ERR, [np.frombuffer(
-                            f"{type(e).__name__}: {e}".encode(), np.uint8)])
-                    except OSError:
-                        return
-                finally:
-                    # runs on every exit path (continue/return included):
-                    # one count + one latency sample per request
-                    _m_rpc_count.inc(op=opname)
-                    _m_rpc_ms.observe((time.perf_counter() - t0) * 1000.0,
-                                      op=opname)
+                        if op == _OP_PULL:
+                            rows = self.table.pull(arrays[0])
+                            _send_msg(conn, _OP_OK, [rows])
+                        elif op == _OP_PUSH:
+                            ids, grads, lr = arrays[:3]
+                            if not self._begin_apply(arrays[3:]):
+                                _send_msg(conn, _OP_OK, [])
+                                continue
+                            try:
+                                self.table.push(ids, grads, float(lr[0]))
+                            except BaseException:
+                                self._abort_apply(arrays[3:])
+                                raise
+                            self._record_applied(arrays[3:])
+                            _send_msg(conn, _OP_OK, [])
+                        elif op == _OP_DELTA:
+                            if not self._begin_apply(arrays[2:]):
+                                _send_msg(conn, _OP_OK, [])
+                                continue
+                            try:
+                                self.table.apply_delta(arrays[0], arrays[1])
+                            except BaseException:
+                                self._abort_apply(arrays[2:])
+                                raise
+                            self._record_applied(arrays[2:])
+                            _send_msg(conn, _OP_OK, [])
+                        elif op == _OP_NUM_ROWS:
+                            _send_msg(conn, _OP_OK,
+                                      [np.asarray([self.table.num_rows],
+                                                  np.int64)])
+                        elif op == _OP_STATE:
+                            st = self.table.state_dict()
+                            _send_msg(conn, _OP_OK,
+                                      [st[k] for k in _STATE_KEYS])
+                        elif op == _OP_LOAD:
+                            self.table.load_state_dict(
+                                dict(zip(_STATE_KEYS, arrays)))
+                            _send_msg(conn, _OP_OK, [])
+                        elif op == _OP_BARRIER:
+                            name = bytes(arrays[0]).decode()
+                            n = int(arrays[1][0])
+                            b = self._get_barrier(name.encode(), n)
+                            try:
+                                idx = b.wait(timeout=self.barrier_timeout_s)
+                                if idx == 0:
+                                    # all parties released; step-named
+                                    # barriers are never reused — drop the
+                                    # entry so a long run doesn't leak one
+                                    # per step
+                                    with self._barrier_lock:
+                                        self._barriers.pop(name.encode(),
+                                                           None)
+                            except threading.BrokenBarrierError:
+                                _send_msg(conn, _OP_ERR, [np.frombuffer(
+                                    f"barrier {name!r} broken (a worker "
+                                    "missed the rendezvous within "
+                                    f"{self.barrier_timeout_s}s)".encode(),
+                                    np.uint8)])
+                                continue
+                            _send_msg(conn, _OP_OK, [])
+                        elif op == _OP_BEAT:
+                            worker = int(arrays[0][0])
+                            with self._beats_lock:
+                                self._last_beats[worker] = time.monotonic()
+                            if self.monitor is not None:
+                                self.monitor.beat(worker)
+                            _trace.flight_recorder().record(
+                                "heartbeat", name=f"worker{worker}",
+                                server=self.port, worker=worker)
+                            _send_msg(conn, _OP_OK, [])
+                        elif op == _OP_SHUTDOWN:
+                            _send_msg(conn, _OP_OK, [])
+                            self.stop()
+                            return
+                        else:
+                            _send_msg(conn, _OP_ERR,
+                                      [np.frombuffer(f"bad op {op}".encode(),
+                                                     np.uint8)])
+                    except Exception as e:  # noqa: BLE001 — report to client
+                        _m_rpc_errors.inc(op=opname)
+                        try:
+                            _send_msg(conn, _OP_ERR, [np.frombuffer(
+                                f"{type(e).__name__}: {e}".encode(),
+                                np.uint8)])
+                        except OSError:
+                            return
+                    finally:
+                        # runs on every exit path (continue/return included):
+                        # one count + one latency sample per request
+                        _m_rpc_count.inc(op=opname)
+                        _m_rpc_ms.observe(
+                            (time.perf_counter() - t0) * 1000.0, op=opname)
 
     def stop(self):
         self._running = False
@@ -412,34 +434,44 @@ class _Conn:
              retryable: bool = True, mutating: bool = False):
         import time as _time
 
-        with self.lock:
-            if mutating:
-                # allocate seq inside the SAME lock hold as the send:
-                # per-client arrival order then equals seq order, which the
-                # server's high-water dedupe relies on
-                self._seq += 1
-                arrays = list(arrays) + [
-                    np.asarray([self._client_id, self._seq], np.int64)]
-            delay = self.backoff_s
-            retries = self.max_retries if retryable else 0
-            for attempt in range(retries + 1):
-                try:
-                    if self.sock is None:
-                        self._connect()
-                    _send_msg(self.sock, op, arrays)
-                    rop, out = _recv_msg(self.sock)
-                    break
-                except (ConnectionError, OSError, socket.timeout):
+        opname = _OP_NAMES.get(op, f"op{op}")
+        endpoint = f"{self._addr[0]}:{self._addr[1]}"
+        # client-side RPC span: its context is injected into the frame, so
+        # the server's handler span is a child — one trace_id across the
+        # trainer/pserver boundary
+        with _trace.span(f"ps.rpc::{opname}", endpoint=endpoint) as sp:
+            tp = sp.context.to_traceparent()
+            with self.lock:
+                if mutating:
+                    # allocate seq inside the SAME lock hold as the send:
+                    # per-client arrival order then equals seq order, which
+                    # the server's high-water dedupe relies on
+                    self._seq += 1
+                    arrays = list(arrays) + [
+                        np.asarray([self._client_id, self._seq], np.int64)]
+                delay = self.backoff_s
+                retries = self.max_retries if retryable else 0
+                for attempt in range(retries + 1):
                     try:
-                        if self.sock is not None:
-                            self.sock.close()
-                    except OSError:
-                        pass
-                    self.sock = None
-                    if attempt == retries:
-                        raise
-                    _time.sleep(delay)
-                    delay = min(delay * 2, 5.0)
+                        if self.sock is None:
+                            self._connect()
+                        _send_msg(self.sock, op, arrays, traceparent=tp)
+                        rop, out, _ = _recv_msg(self.sock)
+                        break
+                    except (ConnectionError, OSError, socket.timeout):
+                        try:
+                            if self.sock is not None:
+                                self.sock.close()
+                        except OSError:
+                            pass
+                        self.sock = None
+                        _trace.flight_recorder().record(
+                            "rpc_retry", name=opname, endpoint=endpoint,
+                            attempt=attempt)
+                        if attempt == retries:
+                            raise
+                        _time.sleep(delay)
+                        delay = min(delay * 2, 5.0)
         if rop == _OP_ERR:
             raise RuntimeError(
                 "PS server error: " + bytes(out[0]).decode(errors="replace"))

@@ -188,6 +188,10 @@ class MetricsLogger(Callback):
         batch = (logs or {}).get("batch_size") or self.params.get("batch_size")
         if batch and dt > 0:
             self._sps.set(float(batch) / dt)
+        from ..utils import trace as _trace
+
+        _trace.flight_recorder().record(
+            "train_step", name=f"step{step}", dur_ms=dt * 1000.0)
 
     def on_epoch_end(self, epoch, logs=None):
         self._epochs.inc()

@@ -29,6 +29,7 @@ import numpy as np
 from ..core import random as _random
 from ..utils import monitor as _monitor
 from ..utils import profiler as _profiler
+from ..utils import trace as _trace
 from . import ops as _ops  # registers lowerings
 from .backward import GRAD_SUFFIX
 from .framework import Program, Variable, default_main_program
@@ -299,6 +300,13 @@ _m_prog_ops = _monitor.gauge(
 _m_state_bytes = _monitor.gauge(
     "executor.state_size_bytes", "Bytes of persistable state round-tripped "
     "through the last step.", labelnames=("program",))
+_m_cost_flops = _monitor.gauge(
+    "executor.cost_flops", "XLA cost_analysis() flop estimate of the "
+    "last-compiled executable (absent when the backend exposes no cost "
+    "model).", labelnames=("program",))
+_m_cost_bytes = _monitor.gauge(
+    "executor.cost_bytes_accessed", "XLA cost_analysis() bytes-accessed "
+    "estimate of the last-compiled executable.", labelnames=("program",))
 
 
 _prog_tokens = iter(range(1, 1 << 62))
@@ -356,6 +364,14 @@ class Executor:
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in feed_arrays.items())),
                tuple(id(d) for d in devices) if devices else None)
+        # program fingerprint carried on spans/flight events: cache token +
+        # program version identify the exact compiled artifact
+        fingerprint = f"{key[0]}v{program._version}"
+        op_count = sum(len(b.ops) for b in program.blocks)
+        state = {n: scope.find_var(n) for n in state_names
+                 if scope.find_var(n) is not None}
+        base_key = jax.random.PRNGKey(
+            (program.random_seed or _random_seed()) + self._step)
         compiled = self._cache.get(key)
         cache_miss = compiled is None
         t_compile0 = time.perf_counter()
@@ -363,7 +379,8 @@ class Executor:
             _m_cache_miss.inc()
             from ..core import flags as _flags
 
-            with _profiler.RecordEvent("executor::trace_compile"):
+            with _trace.span("executor::trace_compile",
+                             program=fingerprint, ops=op_count) as sp:
                 if _flags.get_flag("check_program"):
                     # pre-trace static analysis (SURVEY §7: fail fast and
                     # legibly before jit) — once per compile-cache entry, so
@@ -375,25 +392,34 @@ class Executor:
                 compiled = self._build(program, list(feed_arrays),
                                        fetch_names, state_names,
                                        devices=devices,
-                                       feed_arrays=feed_arrays)
+                                       feed_arrays=feed_arrays,
+                                       example=(feed_arrays, state, base_key))
+                cost = getattr(compiled, "xla_cost", None)
+                if cost:
+                    # XLA cost_analysis() of the compiled artifact:
+                    # flops/bytes land on the compile span and as gauges
+                    flops = cost.get("flops")
+                    nbytes = cost.get("bytes accessed")
+                    if flops is not None:
+                        sp.set_attr("flops", float(flops))
+                        _m_cost_flops.set(float(flops), program=str(key[0]))
+                    if nbytes is not None:
+                        sp.set_attr("bytes_accessed", float(nbytes))
+                        _m_cost_bytes.set(float(nbytes), program=str(key[0]))
             self._cache[key] = compiled
             if _monitor.enabled():
-                _m_prog_ops.set(sum(len(b.ops) for b in program.blocks),
-                                program=str(key[0]))
+                _m_prog_ops.set(op_count, program=str(key[0]))
         else:
             _m_cache_hit.inc()
 
-        state = {n: scope.find_var(n) for n in state_names
-                 if scope.find_var(n) is not None}
         if _monitor.enabled():
             _m_state_bytes.set(
                 sum(getattr(v, "nbytes", 0) or 0 for v in state.values()),
                 program=str(key[0]))
-        base_key = jax.random.PRNGKey(
-            (program.random_seed or _random_seed()) + self._step)
         self._step += 1
         t_run0 = time.perf_counter()
-        with _profiler.RecordEvent("executor::run"):
+        with _trace.span("executor::run", program=fingerprint,
+                         cache="miss" if cache_miss else "hit"):
             fetches, new_state = compiled(feed_arrays, state, base_key)
         now = time.perf_counter()
         # a miss's timing spans trace+compile+first run (XLA compiles on the
@@ -402,6 +428,10 @@ class Executor:
             _m_compile_ms.observe((now - t_compile0) * 1000.0)
         else:
             _m_run_ms.observe((now - t_run0) * 1000.0)
+        _trace.flight_recorder().record(
+            "executor_run", name=fingerprint,
+            cache="miss" if cache_miss else "hit", ops=op_count,
+            dur_ms=round((now - t_run0) * 1000.0, 3))
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:
@@ -492,7 +522,7 @@ class Executor:
         return None
 
     def _build(self, program: Program, feed_names, fetch_names, state_names,
-               devices=None, feed_arrays=None):
+               devices=None, feed_arrays=None, example=None):
         def raw(feeds, state, base_key):
             env: Dict[str, Any] = {}
             env.update({k: jnp.asarray(v) for k, v in state.items()})
@@ -503,8 +533,43 @@ class Executor:
             return fetches, new_state
 
         if not devices or len(devices) == 1:
-            return jax.jit(raw)
+            return self._build_single(raw, example)
         return self._build_data_parallel(raw, devices, feed_arrays)
+
+    @staticmethod
+    def _build_single(raw, example):
+        """jit the traced step; when telemetry is on, AOT-compile against the
+        example args instead so the compiled artifact's `cost_analysis()`
+        (flops / bytes accessed — XLA's replacement for the reference's
+        per-op cost model) is observable.  The AOT executable is pinned to
+        the example's arg structure; a later call with a different state
+        pytree (a program that grows persistables) falls back to the jitted
+        path, which retraces as usual."""
+        jitted = jax.jit(raw)
+        if example is None or not _monitor.enabled():
+            return jitted
+        try:
+            aot = jitted.lower(*example).compile()
+        except Exception:
+            return jitted
+        cost = None
+        try:
+            ca = aot.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                cost = ca
+        except Exception:
+            pass
+
+        def call(feeds, state, base_key):
+            try:
+                return aot(feeds, state, base_key)
+            except Exception:
+                return jitted(feeds, state, base_key)
+
+        call.xla_cost = cost
+        return call
 
     @staticmethod
     def _build_data_parallel(raw, devices, feed_arrays):

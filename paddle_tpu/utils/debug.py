@@ -17,9 +17,15 @@ import jax
 import jax.numpy as jnp
 
 from ..core import flags as _flags
+from . import monitor as _monitor
 
 __all__ = ["check_numerics", "enable_nan_check", "disable_nan_check",
            "nan_check_enabled"]
+
+_m_nan_events = _monitor.counter(
+    "debug.nan_events", "NaN/Inf detections raised by check_numerics, per "
+    "check-point tag (ref FLAGS_check_nan_inf post-checks).",
+    labelnames=("tag",))
 
 
 def enable_nan_check(eager_also: bool = True) -> None:
@@ -40,6 +46,12 @@ def nan_check_enabled() -> bool:
 
 def _report(bad_names, tag):
     names = [n for n in bad_names if n]
+    # count + flight-record the hit BEFORE raising: the post-mortem dump of
+    # a run that died on NaN shows which tensor tripped first
+    _m_nan_events.inc(tag=str(tag))
+    from . import trace as _trace
+
+    _trace.flight_recorder().record("nan", name=str(tag), leaves=names)
     raise FloatingPointError(
         f"NaN/Inf detected in {tag!r}: {names}"
         if names else f"NaN/Inf detected in {tag!r}")

@@ -68,17 +68,31 @@ def start_profiler(state: str = "All") -> None:
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
-                  profile_path: Optional[str] = None) -> None:
-    """Stop recording; print the summary table (sorted per `sorted_key`:
+                  profile_path: Optional[str] = None,
+                  stream=None) -> None:
+    """Stop recording; emit the summary table (sorted per `sorted_key`:
     total|calls|max|min|ave, ref fluid stop_profiler) and optionally dump a
     chrome-trace timeline to `profile_path` (ref stop_profiler's
-    profile_path dumps a proto; here it is directly chrome-trace JSON)."""
+    profile_path dumps a proto; here it is directly chrome-trace JSON).
+
+    `stream` routes the summary: None → stdout (the fluid behavior), a
+    file-like object → `.write()`, a logger → `.info()` — so library users
+    can capture or silence the table instead of eating a bare print."""
     _native.prof_disable()
     if profile_path:
         export_chrome_tracing(profile_path)
     s = summary(sorted_key)
-    if s:
+    if not s:
+        return
+    if stream is None:
         print(s)
+    elif hasattr(stream, "write"):
+        stream.write(s if s.endswith("\n") else s + "\n")
+    elif hasattr(stream, "info"):
+        stream.info(s)
+    else:
+        raise TypeError(f"stream must be None, file-like, or a logger; "
+                        f"got {type(stream).__name__}")
 
 
 def reset_profiler() -> None:
@@ -100,8 +114,20 @@ def export_chrome_tracing(path: str, registry=None) -> int:
     """Dump all recorded host events as chrome://tracing JSON
     (ref tools/timeline.py), merging the metric registry's counter samples
     as chrome counter-track (`ph:"C"`) events so the trace viewer shows
-    cache-hit/RPC/step counts alongside the spans.  Returns the number of
-    events written."""
+    cache-hit/RPC/step counts alongside the spans.
+
+    Multi-rank aware: every event's pid is this worker's rank (from
+    `PADDLE_TRAINER_ID`; the native store writes pid 0) and `ph:"M"`
+    `process_name`/`process_sort_index` metadata events label the process —
+    so traces from a `distributed.launch` job merge into one readable
+    timeline (`python -m tools.tracecat`).  Returns the number of events
+    written."""
+    import os
+
+    try:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        rank = 0
     n = _native.prof_export_chrome(path)
     if n >= 0:
         with open(path) as f:
@@ -109,6 +135,8 @@ def export_chrome_tracing(path: str, registry=None) -> int:
     else:  # native runtime unavailable: counters-only trace
         data = {"traceEvents": []}
     events = data.setdefault("traceEvents", [])
+    for e in events:
+        e["pid"] = rank
     ts_us = time.time() * 1e6
     reg = registry if registry is not None else _monitor.default_registry()
     for m in reg.metrics():
@@ -119,11 +147,17 @@ def export_chrome_tracing(path: str, registry=None) -> int:
             if labels:
                 name += "{" + ",".join(f"{k}={labels[k]}"
                                        for k in sorted(labels)) + "}"
-            events.append({"name": name, "ph": "C", "pid": 0, "ts": ts_us,
+            events.append({"name": name, "ph": "C", "pid": rank, "ts": ts_us,
                            "args": {"value": float(value)}})
+    data["traceEvents"] = [
+        {"name": "process_name", "ph": "M", "pid": rank,
+         "args": {"name": f"paddle_tpu rank {rank}"}},
+        {"name": "process_sort_index", "ph": "M", "pid": rank,
+         "args": {"sort_index": rank}},
+    ] + events
     with open(path, "w") as f:
         json.dump(data, f)
-    return len(events)
+    return len(data["traceEvents"])
 
 
 def summary(sorted_key: Optional[str] = None) -> str:

@@ -1,0 +1,388 @@
+"""Distributed tracing + flight recorder (utils/trace.py, tools/tracecat.py):
+span context propagation across PS RPCs and launch ranks, post-mortem dumps,
+and the trace-merging CLI.  Ref: the reference's tools/timeline.py merges
+per-process CUPTI timelines offline; here correlation is by shared trace_id."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import monitor, profiler, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# span context / propagation primitives
+# ---------------------------------------------------------------------------
+
+def test_traceparent_roundtrip_and_malformed():
+    ctx = trace.SpanContext()
+    tp = ctx.to_traceparent()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    back = trace.SpanContext.from_traceparent(tp)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    for bad in ("", "junk", "00-zz-11-01", "01-" + "a" * 32 + "-" + "b" * 16):
+        assert trace.SpanContext.from_traceparent(bad) is None
+    assert trace.extract(None) is None
+    assert trace.extract({}) is None
+    assert trace.extract({"traceparent": tp}).span_id == ctx.span_id
+
+
+def test_span_nesting_and_inject():
+    assert trace.current_span() is None
+    with trace.span("outer") as a:
+        assert trace.current_span() is a
+        with trace.span("inner") as b:
+            assert b.context.trace_id == a.context.trace_id
+            assert b.context.parent_id == a.context.span_id
+            carrier = trace.inject({})
+            assert carrier["traceparent"] == b.context.to_traceparent()
+        assert trace.current_span() is a
+    assert trace.current_span() is None
+    # no current span: inject leaves the carrier untouched
+    assert trace.inject({}) == {}
+
+
+def test_explicit_parent_wins_over_current():
+    remote = trace.SpanContext()
+    with trace.span("local"):
+        with trace.span("handler", parent=remote) as h:
+            assert h.context.trace_id == remote.trace_id
+            assert h.context.parent_id == remote.span_id
+
+
+def test_span_lands_in_native_event_store():
+    profiler.start_profiler()
+    with trace.span("trace_test::probe"):
+        time.sleep(0.001)
+    assert "trace_test::probe" in profiler.summary()
+
+
+def test_span_as_decorator():
+    @trace.span("trace_test::deco")
+    def f(x):
+        assert trace.current_span() is not None
+        return x + 1
+
+    assert f(1) == 2
+    assert trace.current_span() is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_ring_is_bounded(tmp_path):
+    fr = trace.FlightRecorder(size=5)
+    for i in range(20):
+        fr.record("tick", name=f"n{i}", i=i)
+    evs = fr.events()
+    assert len(evs) == 5
+    assert [e["i"] for e in evs] == [15, 16, 17, 18, 19]
+    # dump is valid JSON with meta + events
+    path = str(tmp_path / "flight.json")
+    assert fr.dump(path) == 5
+    doc = json.load(open(path))
+    assert doc["meta"]["size"] == 5 and len(doc["events"]) == 5
+
+
+def test_flight_recorder_stamps_span_context():
+    fr = trace.FlightRecorder(size=8)
+    with trace.span("ctx_holder") as sp:
+        fr.record("probe", name="p")
+    ev = fr.events()[-1]
+    assert ev["trace_id"] == sp.context.trace_id
+    assert ev["span_id"] == sp.context.span_id
+    # non-JSON fields are made safe
+    fr.record("odd", name="o", arr=np.arange(2))
+    json.dumps(fr.events()[-1])
+
+
+def test_flight_recorder_size_flag():
+    from paddle_tpu.core import flags
+    old = flags.get_flag("flight_recorder_size")
+    try:
+        flags.set_flags({"flight_recorder_size": 3})
+        assert trace.FlightRecorder().size == 3
+    finally:
+        flags.set_flags({"flight_recorder_size": old})
+
+
+# ---------------------------------------------------------------------------
+# post-mortem dumps (subprocess: excepthook and SIGTERM paths)
+# ---------------------------------------------------------------------------
+
+def _run_worker(tmp_path, body, env_extra, check=False):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(body))
+    env = dict(os.environ)
+    env.pop("PDTPU_TRACE_DIR", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    return subprocess.run([sys.executable, str(script)], env=env, cwd=REPO,
+                          capture_output=True, text=True, check=check,
+                          timeout=120)
+
+
+def test_dump_on_uncaught_exception(tmp_path):
+    tdir = tmp_path / "tr"
+    proc = _run_worker(tmp_path, """
+        import paddle_tpu
+        from paddle_tpu.utils import trace
+        with trace.span("doomed::step", step=3):
+            raise RuntimeError("boom at step 3")
+    """, {"PDTPU_TRACE_DIR": str(tdir), "PADDLE_TRAINER_ID": "0",
+          "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode != 0 and "boom at step 3" in proc.stderr
+    doc = json.load(open(tdir / "flight.rank0.json"))
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "exception" in kinds and "worker_start" in kinds
+    exc = [e for e in doc["events"] if e["kind"] == "exception"][-1]
+    assert exc["name"] == "RuntimeError" and "boom" in exc["message"]
+    # the atexit chrome trace is also present and valid
+    chrome = json.load(open(tdir / "trace.rank0.json"))
+    names = {e.get("name") for e in chrome["traceEvents"]}
+    assert "doomed::step" in names
+
+
+def test_dump_on_sigterm(tmp_path):
+    tdir = tmp_path / "tr"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import sys, time
+        import paddle_tpu
+        from paddle_tpu.utils import trace
+        trace.flight_recorder().record("phase", name="spinning")
+        print("ready", flush=True)
+        time.sleep(60)
+    """))
+    env = dict(os.environ)
+    env.update({"PDTPU_TRACE_DIR": str(tdir), "PADDLE_TRAINER_ID": "0",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                    "PYTHONPATH", "")})
+    proc = subprocess.Popen([sys.executable, str(script)], env=env, cwd=REPO,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 128 + signal.SIGTERM
+    doc = json.load(open(tdir / "flight.rank0.json"))
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "signal" in kinds and "phase" in kinds
+    sig = [e for e in doc["events"] if e["kind"] == "signal"][-1]
+    assert sig["name"] == "SIGTERM"
+
+
+# ---------------------------------------------------------------------------
+# cross-process propagation: PS RPC client span -> server handler span
+# ---------------------------------------------------------------------------
+
+def test_ps_rpc_propagates_trace_context(tmp_path):
+    from paddle_tpu.distributed.ps_server import RemoteSparseTable
+
+    tdir = tmp_path / "tr"
+    script = tmp_path / "server.py"
+    script.write_text(textwrap.dedent("""
+        import sys, time
+        import paddle_tpu
+        from paddle_tpu.distributed.ps import SparseTable
+        from paddle_tpu.distributed.ps_server import PSServer
+        server = PSServer(SparseTable(4, 2, optimizer="sgd"), port=0)
+        server.start()
+        print(server.endpoint, flush=True)
+        while server._running:
+            time.sleep(0.05)
+        time.sleep(0.3)  # let the handler thread finish its span records
+    """))
+    env = dict(os.environ)
+    env.update({"PDTPU_TRACE_DIR": str(tdir), "PADDLE_TRAINER_ID": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                    "PYTHONPATH", "")})
+    proc = subprocess.Popen([sys.executable, str(script)], env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        endpoint = proc.stdout.readline().strip()
+        assert ":" in endpoint, proc.stderr.read()
+        table = RemoteSparseTable([endpoint], dim=4)
+        with trace.span("trainer::lookup") as sp:
+            rows = table.pull(np.asarray([1, 2, 3], np.int64))
+            client_trace = sp.context.trace_id
+            client_span = sp.context.span_id
+        assert rows.shape == (3, 4)
+        table.shutdown_servers()
+        table.close()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    doc = json.load(open(tdir / "flight.rank1.json"))
+    pulls = [e for e in doc["events"]
+             if e["kind"] == "span_begin" and e["name"] == "ps::pull"]
+    assert pulls, [e["name"] for e in doc["events"]]
+    # server handler span carries the CLIENT's trace_id (one distributed
+    # trace across the process gap), parented under the client's rpc span
+    assert pulls[-1]["trace_id"] == client_trace
+    assert pulls[-1]["parent_id"] != client_span  # parent is the rpc span,
+    assert "parent_id" in pulls[-1]               # not the outer one
+
+
+# ---------------------------------------------------------------------------
+# launch-level: shared job trace_id + per-rank traces merge via tracecat
+# ---------------------------------------------------------------------------
+
+def test_launch_shares_job_trace_id_and_tracecat_merges(tmp_path):
+    from paddle_tpu.distributed.launch import launch
+    from tools.tracecat import merge_traces
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    tdir = tmp_path / "traces"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, os, time
+        import paddle_tpu
+        from paddle_tpu.utils import trace
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        with trace.span("worker::step", rank=int(rank)):
+            time.sleep(0.01)
+        info = {{"trace_id": os.environ["PDTPU_TRACE_ID"],
+                 "job_id": trace.job_trace_id()}}
+        with open(os.path.join({str(out_dir)!r}, f"r{{rank}}.json"), "w") as f:
+            json.dump(info, f)
+    """))
+    rc = launch(str(script), [], nproc=2, trace_dir=str(tdir),
+                backend_env=f"JAX_PLATFORMS=cpu,PYTHONPATH={REPO}")
+    assert rc == 0
+    infos = [json.load(open(out_dir / f"r{r}.json")) for r in range(2)]
+    # one job-level trace_id, shared by both ranks and adopted in-process
+    assert infos[0]["trace_id"] == infos[1]["trace_id"]
+    assert all(i["job_id"] == i["trace_id"] for i in infos)
+
+    rank_traces = [str(tdir / f"trace.rank{r}.json") for r in range(2)]
+    assert all(os.path.exists(p) for p in rank_traces)
+    merged = merge_traces(rank_traces)
+    events = merged["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert any(e["name"] == "worker::step" for e in xs)
+    metas = [e for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert {m["pid"] for m in metas} == {0, 1}
+    # both ranks' flight dumps carry the SAME job trace_id in their meta
+    flights = [json.load(open(tdir / f"flight.rank{r}.json"))
+               for r in range(2)]
+    assert flights[0]["meta"]["trace_id"] == flights[1]["meta"]["trace_id"]
+    assert flights[0]["meta"]["trace_id"] == infos[0]["trace_id"]
+
+
+def test_tracecat_selfcheck_cli():
+    proc = subprocess.run([sys.executable, "-m", "tools.tracecat",
+                           "--selfcheck"], cwd=REPO, capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_tracecat_merge_and_flight_cli(tmp_path):
+    t = {"traceEvents": [
+        {"name": "s", "ph": "X", "pid": 999, "tid": 1, "ts": 0, "dur": 10}]}
+    p0 = tmp_path / "trace.rank0.json"
+    p0.write_text(json.dumps(t))
+    out = tmp_path / "merged.json"
+    proc = subprocess.run([sys.executable, "-m", "tools.tracecat", "merge",
+                           str(p0), "--out", str(out)], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(out))
+    assert all(e["pid"] == 0 for e in doc["traceEvents"])
+
+    fl = tmp_path / "flight.rank0.json"
+    fl.write_text(json.dumps({"meta": {"rank": 0}, "events": [
+        {"ts": 1.0, "kind": "nan", "name": "grads",
+         "trace_id": "ab" * 16, "span_id": "cd" * 8}]}))
+    proc = subprocess.run([sys.executable, "-m", "tools.tracecat", "flight",
+                           str(fl)], cwd=REPO, capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0 and "nan" in proc.stdout
+    assert "abababab" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellites: nan counter + flight event, stop_profiler stream, rank-aware
+# chrome export
+# ---------------------------------------------------------------------------
+
+def test_check_numerics_counts_and_flight_records():
+    from paddle_tpu.utils import debug
+
+    reg = monitor.default_registry()
+    c = reg.get("debug.nan_events")
+    before = c.value(tag="trace_test_grads")
+    trace.flight_recorder().clear()
+    with pytest.raises(FloatingPointError, match="trace_test_grads"):
+        debug.check_numerics({"w": np.asarray([1.0, np.nan])},
+                             tag="trace_test_grads", force=True)
+    assert c.value(tag="trace_test_grads") == before + 1
+    nans = [e for e in trace.flight_recorder().events()
+            if e["kind"] == "nan" and e["name"] == "trace_test_grads"]
+    assert nans and any("w" in leaf for leaf in nans[-1]["leaves"])
+
+
+def test_stop_profiler_accepts_stream_and_logger():
+    import io
+
+    profiler.start_profiler()
+    with profiler.RecordEvent("trace_test::summary"):
+        pass
+    buf = io.StringIO()
+    profiler.stop_profiler(sorted_key="total", stream=buf)
+    assert "trace_test::summary" in buf.getvalue()
+
+    class FakeLogger:
+        def __init__(self):
+            self.lines = []
+
+        def info(self, msg):
+            self.lines.append(msg)
+
+    profiler.start_profiler()
+    with profiler.RecordEvent("trace_test::summary2"):
+        pass
+    lg = FakeLogger()
+    profiler.stop_profiler(stream=lg)
+    assert any("trace_test::summary2" in ln for ln in lg.lines)
+    with pytest.raises(TypeError):
+        profiler.stop_profiler(stream=object())
+
+
+def test_export_chrome_tracing_rank_aware(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    profiler.start_profiler()
+    with profiler.RecordEvent("trace_test::ranked"):
+        pass
+    path = str(tmp_path / "chrome.json")
+    profiler.export_chrome_tracing(path)
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs and all(e["pid"] == 3 for e in xs)
+    metas = {e["name"]: e for e in events if e.get("ph") == "M"}
+    assert metas["process_name"]["args"]["name"] == "paddle_tpu rank 3"
+    assert metas["process_sort_index"]["args"]["sort_index"] == 3
+    assert metas["process_name"]["pid"] == 3

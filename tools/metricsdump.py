@@ -111,6 +111,8 @@ def main(argv=None) -> int:
     run_workload(args.steps)
     if args.chrome:
         profiler.export_chrome_tracing(args.chrome)
+    # event summary goes to stderr so stdout stays pure prom/json payload
+    profiler.stop_profiler(sorted_key="total", stream=sys.stderr)
 
     if args.format == "json":
         text = json.dumps(registry.to_json(), indent=2, sort_keys=True)

@@ -1,0 +1,294 @@
+"""Merge, inspect, and pretty-print paddle_tpu trace artifacts.
+
+A distributed run (``python -m paddle_tpu.distributed.launch --trace_dir d``)
+leaves one chrome trace per rank (``trace.rank<r>.json``) and, on crash or
+SIGTERM, a flight-recorder dump (``flight.rank<r>.json``).  tracecat is the
+one-command consumer of those artifacts:
+
+merge
+    stitch per-rank chrome traces into a single chrome://tracing /
+    Perfetto-loadable timeline.  Each input file becomes one process row:
+    ``pid`` is rewritten to the rank (parsed from a ``rank<N>`` token in the
+    filename, else the argument position) and ``ph:"M"`` process_name /
+    process_sort_index metadata events are inserted so the UI labels and
+    orders the rows.
+
+tree
+    text rendering of the span forest (``ph:"X"`` events nested by
+    containment per pid/tid) — a poor man's trace viewer for terminals.
+
+flight
+    pretty-print one or more flight-recorder dumps, merged and sorted by
+    timestamp, with trace/span ids shortened for humans.
+
+Usage::
+
+    python -m tools.tracecat merge d/trace.rank*.json --out merged.json
+    python -m tools.tracecat tree  merged.json
+    python -m tools.tracecat flight d/flight.rank*.json
+    python -m tools.tracecat --selfcheck        # synthetic end-to-end smoke
+
+``--selfcheck`` generates two synthetic rank traces in a temp dir, merges
+them, validates the result (valid JSON, both pids present, process_name
+metadata, spans preserved) and exits 0/1 — cheap enough for tier-1 CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_RANK_RE = re.compile(r"rank(\d+)")
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+
+def _load_events(path: str) -> List[dict]:
+    """Read a chrome trace (object-with-traceEvents or bare array form)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError(f"{path}: not a chrome trace (got {type(doc).__name__})")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _rank_of(path: str, position: int) -> int:
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else position
+
+
+# ---------------------------------------------------------------------------
+# merge
+
+
+def merge_traces(paths: List[str]) -> dict:
+    """Merge per-rank chrome traces into one timeline keyed by pid=rank."""
+    merged: List[dict] = []
+    seen_ranks = set()
+    for pos, path in enumerate(paths):
+        rank = _rank_of(path, pos)
+        while rank in seen_ranks:  # duplicate rank tokens: fall back to slot
+            rank += 1
+        seen_ranks.add(rank)
+        events = _load_events(path)
+        body = []
+        for e in events:
+            if e.get("ph") == "M":
+                continue  # re-emitted below with the merged-view rank
+            e = dict(e)
+            e["pid"] = rank
+            body.append(e)
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"paddle_tpu rank {rank} "
+                                        f"({os.path.basename(path)})"}})
+        merged.append({"name": "process_sort_index", "ph": "M", "pid": rank,
+                       "args": {"sort_index": rank}})
+        merged.extend(body)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# tree view
+
+
+def _span_tree_lines(events: List[dict]) -> List[str]:
+    """Nest ph:"X" events by interval containment within each (pid, tid)."""
+    lanes: Dict[Tuple[object, object], List[dict]] = {}
+    names: Dict[object, str] = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "M" and e.get("name") == "process_name":
+            names[e.get("pid")] = e.get("args", {}).get("name", "")
+        if ph != "X":
+            continue
+        lanes.setdefault((e.get("pid", 0), e.get("tid", 0)), []).append(e)
+
+    lines: List[str] = []
+    for (pid, tid) in sorted(lanes, key=lambda k: (str(k[0]), str(k[1]))):
+        label = names.get(pid) or f"pid {pid}"
+        lines.append(f"{label} / tid {tid}")
+        stack: List[float] = []  # end timestamps of open ancestors
+        spans = sorted(lanes[(pid, tid)],
+                       key=lambda e: (e.get("ts", 0), -e.get("dur", 0)))
+        for e in spans:
+            ts = float(e.get("ts", 0))
+            dur = float(e.get("dur", 0))
+            while stack and ts >= stack[-1]:
+                stack.pop()
+            indent = "  " * (len(stack) + 1)
+            lines.append(f"{indent}{e.get('name', '?')}  "
+                         f"[{dur / 1000.0:.3f} ms @ {ts / 1000.0:.3f}]")
+            stack.append(ts + dur)
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def _short(ident: Optional[str], n: int = 8) -> str:
+    return (ident or "-")[:n]
+
+
+def _flight_lines(paths: List[str]) -> List[str]:
+    records = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        meta = doc.get("meta", {}) if isinstance(doc, dict) else {}
+        events = doc.get("events", []) if isinstance(doc, dict) else doc
+        rank = meta.get("rank", _rank_of(path, 0))
+        for e in events:
+            if isinstance(e, dict):
+                records.append((rank, e))
+    records.sort(key=lambda it: it[1].get("ts", 0.0))
+
+    lines = []
+    for rank, e in records:
+        extras = {k: v for k, v in e.items()
+                  if k not in ("ts", "kind", "name", "rank", "thread",
+                               "trace_id", "span_id", "parent_id")}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+        lines.append(f"[{e.get('ts', 0.0):.6f}] r{rank} "
+                     f"{e.get('kind', '?'):<12} {e.get('name', ''):<28} "
+                     f"trace={_short(e.get('trace_id'))} "
+                     f"span={_short(e.get('span_id'))}"
+                     f"{('  ' + extra) if extra else ''}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# selfcheck
+
+
+def _synthetic_trace(rank: int, base_ts: int) -> dict:
+    pid = os.getpid()
+    return {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": f"synthetic rank {rank}"}},
+        {"name": "executor::run", "ph": "X", "pid": pid, "tid": 1,
+         "ts": base_ts, "dur": 900, "args": {"rank": rank}},
+        {"name": "ps.rpc::pull", "ph": "X", "pid": pid, "tid": 1,
+         "ts": base_ts + 100, "dur": 300, "args": {}},
+        {"name": "executor.cache_hit", "ph": "C", "pid": pid, "tid": 0,
+         "ts": base_ts + 950, "args": {"value": rank + 1}},
+    ]}
+
+
+def selfcheck() -> int:
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="tracecat_selfcheck_")
+    paths = []
+    for rank in (0, 1):
+        p = os.path.join(tmp, f"trace.rank{rank}.json")
+        with open(p, "w") as f:
+            json.dump(_synthetic_trace(rank, 1000 + rank * 2000), f)
+        paths.append(p)
+
+    merged = merge_traces(paths)
+    out = os.path.join(tmp, "merged.json")
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    with open(out) as f:
+        doc = json.load(f)  # must round-trip as valid JSON
+
+    events = doc["traceEvents"]
+    pids = {e.get("pid") for e in events if e.get("ph") == "X"}
+    ok = True
+    if pids != {0, 1}:
+        print(f"selfcheck: merged pids {pids} != {{0, 1}}", file=sys.stderr)
+        ok = False
+    name_metas = [e for e in events
+                  if e.get("ph") == "M" and e.get("name") == "process_name"]
+    if {e.get("pid") for e in name_metas} != {0, 1}:
+        print("selfcheck: missing process_name metadata", file=sys.stderr)
+        ok = False
+    spans = [e for e in events if e.get("ph") == "X"]
+    if len(spans) != 4:
+        print(f"selfcheck: expected 4 spans, got {len(spans)}",
+              file=sys.stderr)
+        ok = False
+    tree = _span_tree_lines(events)
+    if not any("ps.rpc::pull" in ln for ln in tree):
+        print("selfcheck: span tree lost ps.rpc::pull", file=sys.stderr)
+        ok = False
+    print(f"tracecat selfcheck: {'OK' if ok else 'FAILED'} "
+          f"({len(events)} merged events, {len(tree)} tree lines, {tmp})")
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tracecat", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="synthetic merge smoke test, exits 0/1")
+    sub = parser.add_subparsers(dest="cmd")
+
+    p_merge = sub.add_parser("merge", help="merge per-rank chrome traces")
+    p_merge.add_argument("traces", nargs="+")
+    p_merge.add_argument("--out", default=None,
+                         help="output path (default: stdout)")
+    p_merge.add_argument("--tree", action="store_true",
+                         help="also print the span-tree view to stderr")
+
+    p_tree = sub.add_parser("tree", help="span-tree text view of a trace")
+    p_tree.add_argument("trace")
+
+    p_flight = sub.add_parser("flight",
+                              help="pretty-print flight-recorder dumps")
+    p_flight.add_argument("dumps", nargs="+")
+
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if args.cmd is None:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    if args.cmd == "merge":
+        merged = merge_traces(args.traces)
+        text = json.dumps(merged, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(f"tracecat: wrote {len(merged['traceEvents'])} events "
+                  f"from {len(args.traces)} ranks to {args.out}")
+        else:
+            print(text)
+        if args.tree:
+            for ln in _span_tree_lines(merged["traceEvents"]):
+                print(ln, file=sys.stderr)
+        return 0
+
+    if args.cmd == "tree":
+        for ln in _span_tree_lines(_load_events(args.trace)):
+            print(ln)
+        return 0
+
+    if args.cmd == "flight":
+        for ln in _flight_lines(args.dumps):
+            print(ln)
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
