@@ -20,7 +20,8 @@ from __future__ import annotations
 __all__ = [
     "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
     "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
-    "PreconditionNotMetError", "PermissionDeniedError",
+    "PreconditionNotMetError", "StaleScopeValueError",
+    "PermissionDeniedError",
     "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
     "FatalError", "ExternalError", "ProgramVerificationError",
     "render_diagnostics",
@@ -53,6 +54,14 @@ class ResourceExhaustedError(EnforceNotMet, MemoryError):
 
 class PreconditionNotMetError(EnforceNotMet, RuntimeError):
     """PRECONDITION_NOT_MET — e.g. running before initialization."""
+
+
+class StaleScopeValueError(PreconditionNotMetError):
+    """A Scope read returned a buffer that was donated into a compiled
+    Executor step and has since been consumed by XLA (donate_state fast
+    path).  The live value is in the scope the Executor ran on — its
+    write-back replaced the donated entry there; stale aliases elsewhere
+    raise this instead of XLA's opaque deleted-buffer crash."""
 
 
 class PermissionDeniedError(EnforceNotMet, PermissionError):

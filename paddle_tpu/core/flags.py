@@ -101,6 +101,18 @@ define_flag("flight_recorder_size", 512, "Ring-buffer capacity of the "
             "hits, exceptions) dumped to JSON post-mortem when a worker "
             "dies (no reference analogue — a crashed trainer there leaves "
             "only an exit code).")
+define_flag("donate_state", True, "Donate the persistable-state pytree into "
+            "the Executor's compiled step (jax.jit donate_argnums) so XLA "
+            "updates parameters and optimizer slots in place and the scope "
+            "write-back is a pointer swap instead of a copy.  Only values "
+            "local to the run scope are donated (fall-through reads from a "
+            "parent scope keep the reference's never-clobber-the-parent "
+            "semantics).  Donation engages on TPU/GPU; XLA:CPU runs donated "
+            "computations synchronously, so on CPU the flag keeps the "
+            "device-resident async fast path but skips donate_argnums.  Off "
+            "(PDTPU_FLAGS_donate_state=0): every step round-trips a fresh "
+            "copy of the state, bit-for-bit today's behavior (ref: no "
+            "analogue — the reference mutates Scope in place per op).")
 define_flag("check_program", True, "Statically verify Programs before the "
             "Executor traces them (static/analysis.py): dataflow, registry, "
             "structure, and shape/dtype plausibility checks with typed "

@@ -33,6 +33,68 @@ def _to_tuple(x):
     return (x,)
 
 
+class _LazyLogs(dict):
+    """Step logs whose values materialize on first read.
+
+    The jitted train step returns unmaterialized ``jax.Array`` scalars;
+    forcing them to floats every batch is a device sync that serializes
+    dispatch.  Values registered via :meth:`set_lazy` stay as pending thunks
+    until a consumer (a callback, verbose logging, epoch summary) actually
+    reads them — so ``fit(verbose=0)`` with no reading callbacks keeps the
+    dispatch chain fully asynchronous."""
+
+    def __init__(self, **eager):
+        super().__init__(**eager)
+        self._lazy = {}
+
+    def set_lazy(self, key, thunk):
+        super().pop(key, None)
+        self._lazy[key] = thunk
+
+    def _force(self, key):
+        thunk = self._lazy.pop(key, None)
+        if thunk is not None:
+            super().__setitem__(key, thunk())
+
+    def materialize(self) -> "_LazyLogs":
+        for key in list(self._lazy):
+            self._force(key)
+        return self
+
+    def __getitem__(self, key):
+        self._force(key)
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        self._force(key)
+        return super().get(key, default)
+
+    def __contains__(self, key):
+        return key in self._lazy or super().__contains__(key)
+
+    def __len__(self):
+        return super().__len__() + len(self._lazy)
+
+    def __iter__(self):
+        self.materialize()
+        return super().__iter__()
+
+    def keys(self):
+        self.materialize()
+        return super().keys()
+
+    def values(self):
+        self.materialize()
+        return super().values()
+
+    def items(self):
+        self.materialize()
+        return super().items()
+
+    def copy(self):
+        return dict(self.materialize())
+
+
 class Model:
     def __init__(self, network: Layer, inputs=None, labels=None):
         del inputs, labels  # static-graph InputSpec not needed under jit
@@ -114,12 +176,20 @@ class Model:
     # -- training loop -------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            prefetch_to_device=False):
+        """``prefetch_to_device=True`` (or a device) overlaps host→device
+        transfer of batch N+1 with compute of batch N via a DeviceFeeder
+        thread (io/prefetch.py); step logs materialize lazily, so with
+        ``verbose=0`` and no value-reading callbacks the whole epoch
+        dispatches asynchronously."""
         assert self._optimizer is not None, "call prepare(optimizer, loss) first"
         from ..core import tape as _tape
 
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
+        prefetch = prefetch_to_device and not getattr(
+            loader, "prefetch_to_device", False)
         params = autograd.parameters_dict(self.network)
         if self._opt_state is None and not _tape.enabled():
             self._opt_state = self._optimizer.init(params)
@@ -138,7 +208,14 @@ class Model:
                 m.reset()
             logs = {}
             from ..core import tape as _tape
-            for step, batch in enumerate(loader):
+            batches = loader
+            if prefetch:
+                from ..io.prefetch import device_prefetch
+
+                batches = device_prefetch(
+                    loader, device=None if prefetch_to_device is True
+                    else prefetch_to_device)
+            for step, batch in enumerate(batches):
                 cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 if _tape.enabled():
@@ -149,11 +226,17 @@ class Model:
                     params, self._opt_state, loss, metric_outs = \
                         self._train_step(params, self._opt_state, rng, inputs,
                                          labels)
-                logs = {"loss": float(loss), "step": step}
+                # lazy logs: float(loss) is a device sync — defer it until a
+                # callback/verbose consumer actually reads the value so the
+                # steady-state dispatch chain stays asynchronous
+                logs = _LazyLogs(step=step)
+                logs.set_lazy("loss", lambda l=loss: float(l))
                 for m, mo in zip(self._metrics, metric_outs):
                     val = _metric_update(m, mo)
-                    logs[m.name()] = (float(np.asarray(val).ravel()[0])
-                                      if val is not None else None)
+                    logs.set_lazy(
+                        m.name(),
+                        lambda v=val: (float(np.asarray(v).ravel()[0])
+                                       if v is not None else None))
                 cbs.on_train_batch_end(step, logs)
             autograd.load_parameters(self.network, params)
             epoch_logs = {"loss": logs.get("loss")}
@@ -183,10 +266,13 @@ class Model:
         for batch in loader:
             inputs, labels = self._split_batch(batch)
             loss, metric_outs = self._eval_step(params, inputs, labels)
-            losses.append(float(loss))
+            # defer the scalar sync: batches keep dispatching while earlier
+            # losses are still on device
+            losses.append(loss)
             for m, mo in zip(self._metrics, metric_outs):
                 _metric_update(m, mo)
-        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        logs = {"loss": float(np.mean([np.asarray(l) for l in losses]))
+                if losses else 0.0}
         for m in self._metrics:
             logs[m.name()] = m.accumulate()
         self.network.train()
@@ -202,13 +288,15 @@ class Model:
             inputs, _ = self._split_batch(batch) if isinstance(batch, (tuple, list)) \
                 else ((batch,), None)
             out = self._pred_step(params, inputs)
-            outs.append(tuple(np.asarray(o) for o in _to_tuple(out)))
+            # keep batch outputs on device until the loop ends — np.asarray
+            # per batch is a sync that serializes dispatch
+            outs.append(_to_tuple(out))
         self.network.train()
         n_outputs = len(outs[0]) if outs else 0
         if stack_outputs and outs:
-            return [np.concatenate([b[i] for b in outs], axis=0)
+            return [np.concatenate([np.asarray(b[i]) for b in outs], axis=0)
                     for i in range(n_outputs)]
-        return outs
+        return [tuple(np.asarray(o) for o in b) for b in outs]
 
     def train_batch(self, inputs, labels=None):
         from ..core import tape as _tape

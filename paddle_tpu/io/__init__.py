@@ -2,6 +2,7 @@
 re-exports fluid/dataloader; C++ side ref: operators/reader/ +
 framework/data_feed.* whose role host-side numpy threading covers here)."""
 from .dataloader import DataLoader, default_collate_fn
+from .prefetch import DeviceFeeder, device_prefetch
 from .dataset import (
     ChainDataset,
     ComposeDataset,

@@ -137,14 +137,23 @@ class DataLoader:
                  batch_sampler: Optional[BatchSampler] = None,
                  collate_fn: Optional[Callable] = None, num_workers: int = 0,
                  prefetch_factor: int = 2, return_list: bool = True,
-                 use_shared_memory: bool = False, timeout: int = 0):
+                 use_shared_memory: bool = False, timeout: int = 0,
+                 prefetch_to_device=False):
         del return_list  # API-parity knob (we always return lists/dicts)
+        if prefetch_factor < 1:
+            raise ValueError(
+                f"prefetch_factor must be >= 1, got {prefetch_factor} "
+                "(1 = no worker read-ahead beyond the in-flight batch)")
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout or 60
-        self.prefetch_factor = max(2, prefetch_factor)
+        self.prefetch_factor = int(prefetch_factor)
+        # True -> stage batches on the default device from a feeder thread
+        # (io/prefetch.py DeviceFeeder); a jax.Device or 'tpu:0'-style
+        # string targets a specific device
+        self.prefetch_to_device = prefetch_to_device
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -178,6 +187,17 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        it = self._host_iter()
+        if self.prefetch_to_device:
+            from .prefetch import DeviceFeeder
+
+            dev = (None if self.prefetch_to_device is True
+                   else self.prefetch_to_device)
+            it = iter(DeviceFeeder(it, device=dev))
+        yield from it
+
+    def _host_iter(self):
+        """Host-side batch stream (worker threads/processes collate)."""
         if self.num_workers <= 0 or self._iterable:
             yield from self._batches()
             return

@@ -1,0 +1,153 @@
+"""Host→device prefetch: overlap H2D transfer of batch N+1 with compute of
+batch N.
+
+Reference parity: the DataFeed channel feeding per-thread DeviceWorkers
+(framework/data_feed.h + device_worker.h:215 HogwildWorker pulling batches
+off a shared channel) and operators/reader/buffered_reader.cc, which
+double-buffers host batches onto the device stream.  TPU-native design: a
+single background thread pulls collated host batches from any iterable,
+stages them with ``jax.device_put`` (asynchronous on TPU — the transfer
+engine runs concurrently with XLA compute), and hands them to the consumer
+through a bounded queue.  The queue depth is the double-buffer: the feeder
+blocks when it is ``depth`` batches ahead (backpressure), so device memory
+holds a bounded number of staged batches.
+
+Telemetry (SURVEY §5.1): ``io.prefetch_depth`` gauge tracks how many staged
+batches sit ahead of the consumer (0 means the consumer is data-starved —
+the feeder is the bottleneck), ``io.prefetch_batches`` counts total staged
+batches, and the feeder thread emits ``io::prefetch_feeder`` /
+``io::prefetch_put`` spans into the trace layer.
+
+Wired into ``DataLoader(prefetch_to_device=...)``, ``Model.fit`` and
+``Executor.train_from_dataset``; use directly for custom loops::
+
+    for batch in DeviceFeeder(loader):      # leaves are jax.Arrays
+        loss = exe.run(main, feed=batch, fetch_list=[loss_var],
+                       return_numpy=False)  # dispatch-async fast path
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Optional
+
+import jax
+
+from ..utils import monitor as _monitor
+from ..utils import trace as _trace
+
+__all__ = ["DeviceFeeder", "device_prefetch", "resolve_device"]
+
+_m_depth = _monitor.gauge(
+    "io.prefetch_depth", "Device-staged batches queued ahead of the consumer "
+    "(DeviceFeeder); 0 in steady state means the feeder is the bottleneck.")
+_m_batches = _monitor.counter(
+    "io.prefetch_batches", "Batches staged host->device by DeviceFeeder "
+    "threads.")
+
+
+def resolve_device(device):
+    """None -> let jax.device_put pick the default; 'tpu:1'/'cpu' style
+    strings -> the matching jax.Device; jax.Device/Sharding pass through."""
+    if device is None or not isinstance(device, str):
+        return device
+    platform, _, index = device.partition(":")
+    devs = jax.devices(platform)
+    return devs[int(index)] if index else devs[0]
+
+
+class _FeederError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DeviceFeeder:
+    """Iterate ``source`` with its batches already resident on ``device``.
+
+    One feeder = one background thread + one bounded queue.  Iterating the
+    feeder starts the thread; exhausting it, breaking out, or calling
+    ``close()`` stops the thread and drains the queue.  Exceptions raised by
+    the source (or by ``device_put``) surface in the consumer."""
+
+    _END = object()
+
+    def __init__(self, source: Iterable[Any], device=None, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"DeviceFeeder depth must be >= 1, got {depth}")
+        self._source = source
+        self._device = resolve_device(device)
+        self._depth = depth
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def _put(self, item) -> bool:
+        """Backpressured put: blocks while the queue is full, bails out when
+        the consumer shut down (abandoned iterator)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            with _trace.span("io::prefetch_feeder",
+                             device=str(self._device or "default")):
+                n = 0
+                for batch in self._source:
+                    if self._stop.is_set():
+                        return
+                    with _trace.span("io::prefetch_put", batch=n):
+                        # device_put on a pytree: async H2D on TPU — the
+                        # transfer overlaps the consumer's running step
+                        placed = jax.device_put(batch, self._device)
+                    n += 1
+                    _m_batches.inc()
+                    if not self._put(placed):
+                        return
+                    _m_depth.set(self._q.qsize())
+                self._put(self._END)
+        except BaseException as e:  # noqa: BLE001 — crosses the thread
+            self._put(_FeederError(e))
+
+    def __iter__(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name="pdtpu-device-feeder", daemon=True)
+        self._thread.start()
+        try:
+            while True:
+                item = self._q.get()
+                _m_depth.set(self._q.qsize())
+                if item is self._END:
+                    return
+                if isinstance(item, _FeederError):
+                    raise item.exc
+                yield item
+        finally:
+            self.close()
+
+    def close(self):
+        """Stop the feeder thread and release queued batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        _m_depth.set(0)
+
+
+def device_prefetch(source: Iterable[Any], device=None, depth: int = 2):
+    """Functional form of :class:`DeviceFeeder` (returns an iterator)."""
+    return iter(DeviceFeeder(source, device=device, depth=depth))

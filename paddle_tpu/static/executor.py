@@ -8,11 +8,16 @@ cache (:1272).
 
 TPU-native design (SURVEY.md §7 step 3): the op loop becomes a *trace* — the
 Executor walks the block once inside jax.jit, invoking each op's lowering
-rule to build a single fused XLA program `(feeds, state, key) -> (fetches,
-new_state)`, cached by (program version, feed signature, fetch list).  State
-= every persistable variable (parameters, optimizer slots, BN statistics,
-LR); the "write-back" the reference does through Scope mutation becomes the
-functional state round-trip.  The `backward_region` pseudo-op (see
+rule to build a single fused XLA program `(feeds, donated_state,
+carried_state, step) -> (fetches, new_state)`, cached by (program version,
+feed signature, fetch list, donation mode).  State = every persistable
+variable (parameters, optimizer slots, BN statistics, LR); the "write-back"
+the reference does through Scope mutation becomes the functional state
+round-trip — and with the `donate_state` flag on (default), the round-trip
+is a buffer donation: XLA aliases the updated state onto the input buffers
+and the Python-side write-back is a pointer swap, not a copy.  The PRNG
+base key derives inside the compiled step from a per-entry seed and the
+scalar `step` arg, so steady-state dispatch mints no host keys.  The `backward_region` pseudo-op (see
 backward.py) differentiates a replay of the forward prefix; per-op
 `fold_in`-derived PRNG scopes make the replay's random draws (dropout)
 bit-identical to the primal's, so AD is exact.
@@ -36,6 +41,50 @@ from .framework import Program, Variable, default_main_program
 from .registry import get_lowering
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
+
+# Test hook: force donation even where _donation_async_safe() says the
+# platform serializes it (tests/test_fastpath.py covers the donation guard
+# and parity paths on the CPU-only CI this way).
+_FORCE_DONATION = False
+_DONATE_PLATFORM_OK: Optional[bool] = None
+
+
+def _donation_async_safe() -> bool:
+    """Whether buffer donation keeps dispatch asynchronous on this backend.
+
+    XLA:CPU executes a computation with donated inputs synchronously — the
+    dispatch call blocks for the whole step, even when every donated buffer
+    is already materialized (measured on jaxlib CPU: donated dispatch ==
+    full step time, undonated dispatch ~10us).  Donating there would
+    serialize the steady-state pipeline the fast path exists to build, so
+    with `donate_state` on, CPU keeps device-resident state + async
+    dispatch but skips `donate_argnums`; accelerator backends (tpu, gpu,
+    and tunneled PJRT plugins) alias the buffers without giving up async
+    dispatch and donate for real — hence exclude-cpu, not include-known."""
+    global _DONATE_PLATFORM_OK
+    if _FORCE_DONATION:
+        return True
+    if _DONATE_PLATFORM_OK is None:
+        _DONATE_PLATFORM_OK = jax.default_backend() != "cpu"
+    return _DONATE_PLATFORM_OK
+
+
+def _guard_stale(name: str, value):
+    """Donation-safety guard: a scope entry whose buffer was donated into a
+    compiled step (donate_state fast path) and consumed by XLA must fail
+    legibly on read, not with XLA's 'Array has been deleted' crash.  Live
+    values (the run scope's write-back) pass through untouched."""
+    if isinstance(value, jax.Array) and value.is_deleted():
+        from ..core.errors import StaleScopeValueError
+
+        raise StaleScopeValueError(
+            f"variable {name!r} holds a stale buffer: it was donated into a "
+            "compiled Executor step (flag donate_state=1) and its device "
+            "memory has been reused for the updated state.  Read the value "
+            "from the scope the Executor ran on (the step's write-back "
+            "replaced it there), or set PDTPU_FLAGS_donate_state=0 to "
+            "restore copy semantics.")
+    return value
 
 
 class Scope:
@@ -64,13 +113,13 @@ class Scope:
         s: Optional[Scope] = self
         while s is not None:
             if name in s._vars:
-                return s._vars[name]
+                return _guard_stale(name, s._vars[name])
             s = s._parent
         return None
 
     def local_var(self, name: str):
         """Lookup without falling through to ancestors."""
-        return self._vars.get(name)
+        return _guard_stale(name, self._vars.get(name))
 
     def var(self, name: str):
         return self._vars.setdefault(name, None)
@@ -292,8 +341,22 @@ _m_cache_miss = _monitor.counter(
 _m_compile_ms = _monitor.histogram(
     "executor.compile_time_ms",
     "Wall time of a cache-miss step: trace + XLA compile + first run (ms).")
-_m_run_ms = _monitor.histogram(
-    "executor.run_time_ms", "Wall time of a cache-hit (steady-state) step (ms).")
+_m_dispatch_ms = _monitor.histogram(
+    "executor.dispatch_time_ms",
+    "Host time a cache-hit (steady-state) Executor.run spends DISPATCHING "
+    "the compiled step (ms).  Under async dispatch this returns before the "
+    "device finishes — it measures the Python rim, not the device step; see "
+    "executor.step_time_ms for the blocked wall time.")
+_m_step_ms = _monitor.histogram(
+    "executor.step_time_ms",
+    "True steady-state step wall time (ms): dispatch plus blocking on one "
+    "fetch until the device finishes.  Recorded only while the `metrics` "
+    "flag is on — the block IS the cost of measuring; set "
+    "PDTPU_FLAGS_metrics=0 to keep the fast path fully asynchronous.")
+_m_donated_bytes = _monitor.gauge(
+    "executor.donated_bytes", "Bytes of persistable state donated into the "
+    "last step (device-resident, updated in place by XLA).",
+    labelnames=("program",))
 _m_prog_ops = _monitor.gauge(
     "executor.program_ops", "Op count of the last-compiled program "
     "(all blocks).", labelnames=("program",))
@@ -322,19 +385,76 @@ def _program_token(program) -> int:
     return tok
 
 
+class _CacheEntry:
+    """One compiled steady-state step plus everything needed to re-dispatch
+    it without rebuilding signatures: the per-program key-prefix cache.  A
+    steady-state `Executor.run` finds this via one dict lookup on the
+    program's cache token and re-validates the feed shapes against
+    ``feed_sig`` in place — no sorted-tuple signature is rebuilt, no program
+    walk recomputes the persistable list."""
+
+    __slots__ = ("key", "compiled", "version", "donate", "devices_ids",
+                 "fetch_names", "feed_sig", "state_names", "needs_value",
+                 "op_count", "fingerprint")
+
+    def __init__(self, key, version, donate, devices_ids, fetch_names,
+                 feed_arrays, state_names, needs_value, op_count, fingerprint):
+        self.key = key
+        self.compiled = None
+        self.version = version
+        self.donate = donate
+        self.devices_ids = devices_ids
+        self.fetch_names = list(fetch_names)
+        self.feed_sig = {k: (tuple(v.shape), v.dtype)
+                         for k, v in feed_arrays.items()}
+        self.state_names = list(state_names)
+        self.needs_value = frozenset(needs_value)
+        self.op_count = op_count
+        self.fingerprint = fingerprint
+
+    def matches(self, version, fetch_names, feed_arrays, devices_ids,
+                donate) -> bool:
+        if (self.version != version or self.donate != donate
+                or self.devices_ids != devices_ids
+                or self.fetch_names != fetch_names
+                or len(self.feed_sig) != len(feed_arrays)):
+            return False
+        sig = self.feed_sig
+        try:
+            for k, v in feed_arrays.items():
+                shape, dtype = sig[k]
+                if v.shape != shape or v.dtype != dtype:
+                    return False
+        except KeyError:
+            return False
+        return True
+
+
 class Executor:
     """ref fluid/executor.py:474.  `place` is accepted for API parity; XLA
     owns placement (SURVEY.md L0a TPU mapping)."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache: Dict[Tuple, Any] = {}
+        self._cache: Dict[Tuple, _CacheEntry] = {}
+        self._hot: Dict[int, _CacheEntry] = {}  # program token -> last entry
         self._step = 0
 
     # -- public API ----------------------------------------------------------
     def run(self, program=None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True):
+        """Run one step of ``program``.
+
+        Steady-state fast path: with ``return_numpy=False`` the call is
+        dispatch-asynchronous — it returns unmaterialized ``jax.Array``
+        fetches as soon as XLA has enqueued the step, so host work (the next
+        batch's collate, logging) overlaps device compute.  With the
+        ``donate_state`` flag on (default), the persistable state pytree is
+        donated into the compiled step: XLA updates parameters/optimizer
+        slots in place and the scope write-back is a pointer swap, not a
+        copy.  ``jax.Array`` feed values are passed through without a host
+        round-trip (pair with ``io.DeviceFeeder`` prefetch)."""
         from .compiler import CompiledProgram
 
         devices = None
@@ -343,16 +463,43 @@ class Executor:
             program = program._program
         program = program or default_main_program()
         feed = feed or {}
-        fetch_list = list(fetch_list or [])
         scope = scope or global_scope()
 
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
-                       for v in fetch_list]
-        feed_arrays = {k: np.asarray(v) for k, v in feed.items()}
+                       for v in (fetch_list or [])]
+        # device-resident feeds (DeviceFeeder prefetch) stay on device —
+        # np.asarray on a jax.Array is a blocking D2H sync that would defeat
+        # async dispatch; only host values are normalized to numpy
+        feed_arrays = {k: v if isinstance(v, jax.Array) else np.asarray(v)
+                       for k, v in feed.items()}
 
-        state_names = self._state_names(program, scope)
-        missing = [n for n in state_names
-                   if scope.find_var(n) is None and self._needs_value(program, n)]
+        from ..core import flags as _flags
+
+        # donation is single-device only: the data-parallel path pins a
+        # place-once buffer-identity contract (tests/test_static_dp.py)
+        # that in-place donation would break
+        donate = (bool(_flags.get_flag("donate_state"))
+                  and _donation_async_safe()
+                  and not (devices and len(devices) > 1))
+        dev_ids = tuple(id(d) for d in devices) if devices else None
+
+        # hot path: one dict lookup on the program token, then an in-place
+        # feed-shape check — no sorted signature tuple, no program re-walk
+        entry = self._hot.get(getattr(program, "_exec_cache_token", None))
+        if entry is None or not entry.matches(program._version, fetch_names,
+                                              feed_arrays, dev_ids, donate):
+            entry = self._cold_lookup(program, fetch_names, feed_arrays,
+                                      dev_ids, donate)
+
+        state, missing = {}, None
+        for n in entry.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if n in entry.needs_value:
+                    missing = (missing or [])
+                    missing.append(n)
+            else:
+                state[n] = v
         if missing:
             from ..core.errors import PreconditionNotMetError
 
@@ -360,27 +507,35 @@ class Executor:
                 f"persistable variables {missing} have no value in scope — "
                 "run the startup program first (exe.run(startup_program))")
 
-        key = (_program_token(program), program._version, tuple(fetch_names),
-               tuple(sorted((k, v.shape, str(v.dtype))
-                            for k, v in feed_arrays.items())),
-               tuple(id(d) for d in devices) if devices else None)
-        # program fingerprint carried on spans/flight events: cache token +
-        # program version identify the exact compiled artifact
-        fingerprint = f"{key[0]}v{program._version}"
-        op_count = sum(len(b.ops) for b in program.blocks)
-        state = {n: scope.find_var(n) for n in state_names
-                 if scope.find_var(n) is not None}
-        base_key = jax.random.PRNGKey(
-            (program.random_seed or _random_seed()) + self._step)
-        compiled = self._cache.get(key)
-        cache_miss = compiled is None
+        # partition the state for donation: only buffers LOCAL to the run
+        # scope are donated (fall-through reads must never clobber a parent
+        # scope — ref framework/scope.h semantics), and a buffer aliased by
+        # a feed or by a second state name is carried by copy so XLA never
+        # sees the same donated buffer twice
+        if donate:
+            d_state: Dict[str, Any] = {}
+            p_state: Dict[str, Any] = {}
+            seen = {id(v) for v in feed_arrays.values()
+                    if isinstance(v, jax.Array)}
+            for n, v in state.items():
+                if (isinstance(v, jax.Array) and id(v) not in seen
+                        and scope.local_var(n) is v):
+                    seen.add(id(v))
+                    d_state[n] = v
+                else:
+                    p_state[n] = v
+        else:
+            d_state, p_state = {}, state
+
+        token = entry.key[0]
+        step_arg = np.int32(self._step)
+        cache_miss = entry.compiled is None
         t_compile0 = time.perf_counter()
         if cache_miss:
             _m_cache_miss.inc()
-            from ..core import flags as _flags
-
             with _trace.span("executor::trace_compile",
-                             program=fingerprint, ops=op_count) as sp:
+                             program=entry.fingerprint,
+                             ops=entry.op_count) as sp:
                 if _flags.get_flag("check_program"):
                     # pre-trace static analysis (SURVEY §7: fail fast and
                     # legibly before jit) — once per compile-cache entry, so
@@ -389,12 +544,12 @@ class Executor:
 
                     _check_program(program, feed_names=set(feed_arrays),
                                    fetch_names=fetch_names)
-                compiled = self._build(program, list(feed_arrays),
-                                       fetch_names, state_names,
-                                       devices=devices,
-                                       feed_arrays=feed_arrays,
-                                       example=(feed_arrays, state, base_key))
-                cost = getattr(compiled, "xla_cost", None)
+                seed = program.random_seed or _random_seed()
+                entry.compiled = self._build(
+                    program, fetch_names, entry.state_names, seed,
+                    devices=devices, feed_arrays=feed_arrays, donate=donate,
+                    example=(feed_arrays, d_state, p_state, step_arg))
+                cost = getattr(entry.compiled, "xla_cost", None)
                 if cost:
                     # XLA cost_analysis() of the compiled artifact:
                     # flops/bytes land on the compile span and as gauges
@@ -402,46 +557,87 @@ class Executor:
                     nbytes = cost.get("bytes accessed")
                     if flops is not None:
                         sp.set_attr("flops", float(flops))
-                        _m_cost_flops.set(float(flops), program=str(key[0]))
+                        _m_cost_flops.set(float(flops), program=str(token))
                     if nbytes is not None:
                         sp.set_attr("bytes_accessed", float(nbytes))
-                        _m_cost_bytes.set(float(nbytes), program=str(key[0]))
-            self._cache[key] = compiled
+                        _m_cost_bytes.set(float(nbytes), program=str(token))
             if _monitor.enabled():
-                _m_prog_ops.set(op_count, program=str(key[0]))
+                _m_prog_ops.set(entry.op_count, program=str(token))
         else:
             _m_cache_hit.inc()
 
         if _monitor.enabled():
             _m_state_bytes.set(
                 sum(getattr(v, "nbytes", 0) or 0 for v in state.values()),
-                program=str(key[0]))
+                program=str(token))
+            _m_donated_bytes.set(
+                sum(getattr(v, "nbytes", 0) or 0 for v in d_state.values()),
+                program=str(token))
         self._step += 1
         t_run0 = time.perf_counter()
-        with _trace.span("executor::run", program=fingerprint,
+        with _trace.span("executor::run", program=entry.fingerprint,
                          cache="miss" if cache_miss else "hit"):
-            fetches, new_state = compiled(feed_arrays, state, base_key)
+            fetches, new_state = entry.compiled(feed_arrays, d_state,
+                                                p_state, step_arg)
         now = time.perf_counter()
         # a miss's timing spans trace+compile+first run (XLA compiles on the
-        # first jitted call); steady-state hits time only the run
+        # first jitted call); steady-state hits time only the dispatch —
+        # under async dispatch the device may still be computing when
+        # compiled() returns, so this is the Python-rim cost, not step time
         if cache_miss:
             _m_compile_ms.observe((now - t_compile0) * 1000.0)
         else:
-            _m_run_ms.observe((now - t_run0) * 1000.0)
+            _m_dispatch_ms.observe((now - t_run0) * 1000.0)
         _trace.flight_recorder().record(
-            "executor_run", name=fingerprint,
-            cache="miss" if cache_miss else "hit", ops=op_count,
+            "executor_run", name=entry.fingerprint,
+            cache="miss" if cache_miss else "hit", ops=entry.op_count,
             dur_ms=round((now - t_run0) * 1000.0, 3))
+        # pointer-swap write-back: under donation the arrays are already
+        # device-resident and the old buffers were consumed in place
         for n, v in new_state.items():
             scope.set(n, v)
+        if not cache_miss and _monitor.enabled():
+            # true step time needs one device sync; only pay it while the
+            # metrics flag is on (PDTPU_FLAGS_metrics=0 keeps full async)
+            sync = fetches[0] if fetches else \
+                next(iter(new_state.values()), None)
+            if isinstance(sync, jax.Array):
+                sync.block_until_ready()
+                _m_step_ms.observe((time.perf_counter() - t_run0) * 1000.0)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def _cold_lookup(self, program, fetch_names, feed_arrays, dev_ids,
+                     donate) -> _CacheEntry:
+        """Full cache-key build (sorted feed signature + program walk); the
+        resulting entry is pinned on the hot map so steady-state calls skip
+        this entirely."""
+        token = _program_token(program)
+        key = (token, program._version, tuple(fetch_names),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               dev_ids, donate)
+        entry = self._cache.get(key)
+        if entry is None:
+            state_names = self._state_names(program, global_scope())
+            needs = [n for n in state_names if self._needs_value(program, n)]
+            entry = _CacheEntry(
+                key, program._version, donate, dev_ids, fetch_names,
+                feed_arrays, state_names, needs,
+                op_count=sum(len(b.ops) for b in program.blocks),
+                # cache token + program version identify the exact compiled
+                # artifact on spans/flight events
+                fingerprint=f"{token}v{program._version}")
+            self._cache[key] = entry
+        self._hot[token] = entry
+        return entry
+
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
-                           print_period: int = 100):
+                           print_period: int = 100,
+                           prefetch_to_device=False):
         """ref fluid/executor.py:1597 train_from_dataset →
         TrainerFactory/MultiTrainer/DeviceWorker (trainer.h:41,
         device_worker.h:215 HogwildWorker threads pulling from the DataFeed
@@ -452,7 +648,12 @@ class Executor:
         XLA device consumes steps in order — so the N-worker Hogwild loop
         becomes sequential jitted steps over the feed stream (`thread` is
         accepted for parity; parallel parsing is configured on the dataset
-        via set_thread)."""
+        via set_thread).
+
+        ``prefetch_to_device=True`` (or a device) stages batch N+1 on the
+        device from a background thread while batch N computes — the
+        TPU-native replacement for the reference's DataFeed channel into
+        per-thread DeviceWorkers (see io/prefetch.py)."""
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
         del thread  # parity knob; parse parallelism lives on the dataset
@@ -460,9 +661,17 @@ class Executor:
         names = [v.name if isinstance(v, Variable) else str(v)
                  for v in fetch_list]
         labels = list(fetch_info or names)
+        stream = dataset
+        if prefetch_to_device:
+            from ..io.prefetch import DeviceFeeder
+
+            stream = DeviceFeeder(
+                dataset,
+                device=None if prefetch_to_device is True
+                else prefetch_to_device)
         step = 0
         last = None
-        for batch in dataset:
+        for batch in stream:
             last = self.run(program, feed=batch, fetch_list=fetch_list,
                             scope=scope)
             step += 1
@@ -475,12 +684,13 @@ class Executor:
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
-                           print_period: int = 100):
+                           print_period: int = 100,
+                           prefetch_to_device=False):
         """ref fluid/executor.py:1476 — same loop; the program is expected
         to be an inference/test clone (no optimizer ops)."""
         return self.train_from_dataset(program, dataset, scope, thread,
                                        debug, fetch_list, fetch_info,
-                                       print_period)
+                                       print_period, prefetch_to_device)
 
     # -- internals -----------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:
@@ -521,31 +731,43 @@ class Executor:
                 return "write"
         return None
 
-    def _build(self, program: Program, feed_names, fetch_names, state_names,
-               devices=None, feed_arrays=None, example=None):
-        def raw(feeds, state, base_key):
+    def _build(self, program: Program, fetch_names, state_names, seed,
+               devices=None, feed_arrays=None, example=None, donate=False):
+        """Trace the program into `(feeds, donated, carried, step) ->
+        (fetches, new_state)`.  The PRNG base key is derived INSIDE the
+        compiled function — `fold_in(PRNGKey(seed), step)` with `step`
+        passed as a scalar arg — so steady-state calls never mint a host
+        PRNGKey (a small jit dispatch of its own) and never retrace on the
+        step counter.  `seed` is captured per compile-cache entry."""
+        def raw(feeds, donated, carried, step):
             env: Dict[str, Any] = {}
-            env.update({k: jnp.asarray(v) for k, v in state.items()})
+            env.update({k: jnp.asarray(v) for k, v in carried.items()})
+            env.update({k: jnp.asarray(v) for k, v in donated.items()})
             env.update({k: jnp.asarray(v) for k, v in feeds.items()})
+            base_key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
             _trace_block(program, env, base_key)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in state_names if n in env}
             return fetches, new_state
 
         if not devices or len(devices) == 1:
-            return self._build_single(raw, example)
+            return self._build_single(raw, example, donate)
         return self._build_data_parallel(raw, devices, feed_arrays)
 
     @staticmethod
-    def _build_single(raw, example):
-        """jit the traced step; when telemetry is on, AOT-compile against the
-        example args instead so the compiled artifact's `cost_analysis()`
-        (flops / bytes accessed — XLA's replacement for the reference's
-        per-op cost model) is observable.  The AOT executable is pinned to
-        the example's arg structure; a later call with a different state
-        pytree (a program that grows persistables) falls back to the jitted
-        path, which retraces as usual."""
-        jitted = jax.jit(raw)
+    def _build_single(raw, example, donate):
+        """jit the traced step (donating the `donated` state subtree when the
+        donate_state fast path is on); when telemetry is on, AOT-compile
+        against the example args instead so the compiled artifact's
+        `cost_analysis()` (flops / bytes accessed — XLA's replacement for
+        the reference's per-op cost model) is observable.  The AOT
+        executable is pinned to the example's arg structure; a later call
+        with a different state pytree (a program that grows persistables)
+        falls back to the jitted path, which retraces as usual."""
+        if donate:
+            jitted = jax.jit(raw, donate_argnums=(1,))
+        else:
+            jitted = jax.jit(raw)
         if example is None or not _monitor.enabled():
             return jitted
         try:
@@ -562,11 +784,13 @@ class Executor:
         except Exception:
             pass
 
-        def call(feeds, state, base_key):
+        def call(feeds, donated, carried, step):
             try:
-                return aot(feeds, state, base_key)
+                return aot(feeds, donated, carried, step)
             except Exception:
-                return jitted(feeds, state, base_key)
+                # structure mismatches raise host-side before execution, so
+                # the donated buffers are still live for the jitted retry
+                return jitted(feeds, donated, carried, step)
 
         call.xla_cost = cost
         return call
@@ -578,7 +802,9 @@ class Executor:
         GSPMD partitions the forward, and the replay-gradient summation
         against replicated params lowers to the cross-device all-reduce the
         reference's MultiDevSSAGraphBuilder inserted per gradient
-        (ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464)."""
+        (ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464).
+        No donation here: the place-once contract pins buffer identity
+        across steps (tests/test_static_dp.py)."""
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
         mesh = Mesh(np.asarray(devices), ("dp",))
@@ -598,7 +824,7 @@ class Executor:
         feed_sh = {k: feed_sharding(k, v) for k, v in feed_arrays.items()}
         jitted = jax.jit(raw)
 
-        def call(feeds, state, base_key):
+        def call(feeds, donated, carried, step):
             placed_feeds = {k: jax.device_put(np.asarray(v), feed_sh[k])
                             for k, v in feeds.items()}
             # place-once contract: after step 1 the state arrays come back
@@ -606,17 +832,19 @@ class Executor:
             # the steady-state path provably moves no persistable bytes
             # (tests/test_static_dp.py pins buffer identity); only fresh
             # host values (startup init, user scope writes) are placed
+            state = dict(donated)
+            state.update(carried)
             placed_state = {
                 k: v if isinstance(v, jax.Array) and v.sharding == repl
                 else jax.device_put(v, repl)
                 for k, v in state.items()}
-            return jitted(placed_feeds, placed_state,
-                          jax.device_put(base_key, repl))
+            return jitted(placed_feeds, {}, placed_state, step)
 
         return call
 
     def close(self):
         self._cache.clear()
+        self._hot.clear()
 
 
 def _random_seed() -> int:
