@@ -113,6 +113,19 @@ define_flag("donate_state", True, "Donate the persistable-state pytree into "
             "(PDTPU_FLAGS_donate_state=0): every step round-trips a fresh "
             "copy of the state, bit-for-bit today's behavior (ref: no "
             "analogue — the reference mutates Scope in place per op).")
+define_flag("compile_cache_dir", "", "Directory for the persistent AOT "
+            "executable cache (static/compile_cache.py).  Empty (default): "
+            "disabled.  When set, the Executor serializes each compiled "
+            "step via jax.export and reloads it on later runs — including "
+            "in a different process — keyed by program fingerprint × mesh "
+            "shape × sharding spec × jax/jaxlib/backend version, so a "
+            "multi-worker fleet or a serving replica cold-starts without "
+            "re-tracing or re-lowering.  Corrupted or mismatched entries "
+            "fall back to a normal compile.  Cross-process reuse needs a "
+            "stable PRNG seed: set program.random_seed (the derived "
+            "per-process seed is part of the key).  (ref: no analogue — "
+            "the reference recompiles its ProgramDesc per process; jax's "
+            "own compilation cache inspired the key discipline.)")
 define_flag("check_program", True, "Statically verify Programs before the "
             "Executor traces them (static/analysis.py): dataflow, registry, "
             "structure, and shape/dtype plausibility checks with typed "

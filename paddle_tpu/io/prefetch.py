@@ -48,12 +48,31 @@ _m_batches = _monitor.counter(
 
 def resolve_device(device):
     """None -> let jax.device_put pick the default; 'tpu:1'/'cpu' style
-    strings -> the matching jax.Device; jax.Device/Sharding pass through."""
+    strings -> the matching jax.Device; jax.Device/Sharding pass through; a
+    {leaf_name: device-or-Sharding} dict (e.g. `ShardingPlan.feed_shardings`)
+    resolves per entry — dict batches are then staged leaf-by-leaf, each
+    feed pre-sharded across the mesh."""
+    if isinstance(device, dict):
+        return {k: resolve_device(v) for k, v in device.items()}
     if device is None or not isinstance(device, str):
         return device
     platform, _, index = device.partition(":")
     devs = jax.devices(platform)
     return devs[int(index)] if index else devs[0]
+
+
+def _device_put(batch, device):
+    """device_put a batch; a dict target places per-leaf (leaves without an
+    entry go to the default device, like device=None)."""
+    if isinstance(device, dict):
+        if not isinstance(batch, dict):
+            raise TypeError(
+                "DeviceFeeder got a per-leaf device dict but a "
+                f"{type(batch).__name__} batch; per-leaf placement needs "
+                "dict batches ({name: array})")
+        return {k: jax.device_put(v, device.get(k))
+                for k, v in batch.items()}
+    return jax.device_put(batch, device)
 
 
 class _FeederError:
@@ -107,7 +126,7 @@ class DeviceFeeder:
                     with _trace.span("io::prefetch_put", batch=n):
                         # device_put on a pytree: async H2D on TPU — the
                         # transfer overlaps the consumer's running step
-                        placed = jax.device_put(batch, self._device)
+                        placed = _device_put(batch, self._device)
                     n += 1
                     _m_batches.inc()
                     if not self._put(placed):

@@ -362,9 +362,36 @@ def _check_body(nodes):
             "the dy2static subset")
 
 
+_REWRITABLE_BUILTINS = ("print", "int", "float", "bool", "len")
+
+
+def _shadowed_builtins(fdef) -> frozenset:
+    """Rewritable builtin names the function rebinds — via params,
+    assignments, for/with targets, imports, or nested definitions.  A call
+    through a rebound name is the user's object, not the builtin, so the
+    cast/print/len rewrite must not fire on it.  Collection is
+    whole-function conservative: python scoping makes a name assigned
+    anywhere in a scope local everywhere in it, and nested defs are folded
+    in too (the transformer rewrites inside them as well)."""
+    bound = set()
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            bound.add(n.id)
+        elif isinstance(n, ast.arg):
+            bound.add(n.arg)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) and n is not fdef:
+            bound.add(n.name)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for alias in n.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+    return frozenset(bound & set(_REWRITABLE_BUILTINS))
+
+
 class _Transformer(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, shadowed=()):
         self.counter = 0
+        self.shadowed = frozenset(shadowed)
 
     def _fresh(self, kind):
         self.counter += 1
@@ -389,6 +416,8 @@ class _Transformer(ast.NodeTransformer):
         if not isinstance(node.func, ast.Name):
             return node
         fid = node.func.id
+        if fid in self.shadowed:  # user rebound the name; not the builtin
+            return node
         if fid in ("int", "float", "bool") and len(node.args) == 1 \
                 and not node.keywords:
             return ast.Call(func=_name("__pdtpu_convert_cast"),
@@ -616,13 +645,15 @@ def ast_transform(fn: Callable) -> Callable:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise Unsupported("not a plain function definition")
+    shadowed = _shadowed_builtins(fdef)
     if not any(isinstance(n, (ast.If, ast.While, ast.For, ast.Assert))
                or (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
-                   and n.func.id in ("print", "int", "float", "bool"))
+                   and n.func.id in _REWRITABLE_BUILTINS
+                   and n.func.id not in shadowed)
                for n in ast.walk(fdef)):
         raise Unsupported("nothing to convert")
     fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
-    new_tree = _Transformer().visit(tree)
+    new_tree = _Transformer(shadowed=shadowed).visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, f"<dy2static {fn.__qualname__}>", "exec")
     glb = dict(fn.__globals__)

@@ -17,7 +17,12 @@ Axes (any subset, any sizes):
   ep — expert parallel (MoE expert sharding)
 """
 from . import collective, mesh, metrics, sharding
-from .data_parallel import DataParallel, apply_collective_grads, scale_loss
+from .data_parallel import (
+    DataParallel,
+    apply_collective_grads,
+    scale_loss,
+    shard_batch,
+)
 from .mesh import (
     DP_AXIS,
     EP_AXIS,
@@ -29,6 +34,7 @@ from .mesh import (
     get_mesh,
     init_parallel_env,
     mesh_axis_size,
+    mesh_fingerprint,
     set_mesh,
 )
 from .collective import (
@@ -47,6 +53,7 @@ from .collective import (
     send,
 )
 from .sharding import (
+    ShardingPlan,
     ShardingRules,
     infer_sharding,
     shard_layer,

@@ -23,7 +23,8 @@ from ..distributed import env as _env
 from ..nn.layer.base import Layer
 from . import collective as _coll
 
-__all__ = ["DataParallel", "scale_loss", "apply_collective_grads"]
+__all__ = ["DataParallel", "scale_loss", "apply_collective_grads",
+           "shard_batch"]
 
 
 def _live_axis(axis: Optional[str] = None) -> Optional[str]:
@@ -41,6 +42,23 @@ def scale_loss(loss, axis: Optional[str] = None):
         n = _env.get_world_size()
         return loss / n if n > 1 else loss
     return loss / jax.lax.psum(1, ax)
+
+
+def shard_batch(batch, mesh=None, batch_axes=None, seq_axis=None):
+    """Stage a host batch dict onto the mesh, leading dim sharded over the
+    data axes (scalars and batch-1 leaves replicate; an indivisible batch
+    raises).  The dygraph-loop face of `ShardingPlan.feed_shardings` — the
+    same placement the Executor's sharded fast path and DeviceFeeder use,
+    so eager DataParallel steps and static sharded steps agree on layout
+    (ref: fluid/dygraph/parallel.py split-batch helpers)."""
+    from . import mesh as _mesh
+    from .sharding import ShardingPlan
+
+    plan = ShardingPlan(
+        mesh=mesh, batch_axes=tuple(batch_axes or (_mesh.DP_AXIS,)),
+        seq_axis=seq_axis, donate=False)
+    shardings = plan.feed_shardings(batch)
+    return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
 
 
 def apply_collective_grads(grads: Any, axis: Optional[str] = None):

@@ -103,6 +103,20 @@ def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
     return mesh.shape[axis] if axis in mesh.axis_names else 1
 
 
+def mesh_fingerprint(mesh: Optional[Mesh] = None) -> str:
+    """Stable content fingerprint of a mesh's *shape*: axis names/sizes plus
+    the device platform and kind.  Two processes over equivalent topologies
+    (same axis layout, same hardware generation) produce the same string —
+    the mesh component of the persistent compile-cache key
+    (static/compile_cache.py); deliberately excludes device ids, which vary
+    per process."""
+    mesh = mesh or current_mesh()
+    d0 = mesh.devices.ravel()[0]
+    axes = ",".join(f"{a}={mesh.shape[a]}" for a in mesh.axis_names)
+    return (f"mesh({axes})x{mesh.devices.size}"
+            f"@{d0.platform}:{getattr(d0, 'device_kind', '?')}")
+
+
 def init_parallel_env(strategy=None, *, dp: Optional[int] = None, pp: int = 1,
                       tp: int = 1, sp: int = 1, ep: int = 1) -> Mesh:
     """Initialize the distributed environment (ref:

@@ -288,10 +288,20 @@ class PipelineStage:
         """PartitionSpec placing the block dim over pp (leaves: [L, ...])."""
         return PartitionSpec(self.axis)
 
+    def sharding_annotations(self):
+        """Per-leaf annotation axes ({name: (pp, None, ...)}) in the format
+        `parallel.sharding.infer_sharding` (and so `ShardingPlan`) consumes —
+        pass these to `CompiledProgram.with_sharding(annotations=...)` to run
+        pipeline-stage state under the Executor's sharded fast path."""
+        return {k: (self.axis,) + (None,) * (v.ndim - 1)
+                for k, v in self.params.items()}
+
     def shard_params(self):
-        ns = NamedSharding(self.mesh, self.sharding_spec())
-        self.params = jax.tree_util.tree_map(
-            lambda p: jax.device_put(p, ns), self.params)
+        from . import sharding as _sharding
+
+        self.params = _sharding.shard_params(
+            self.params, mesh=self.mesh,
+            annotations=self.sharding_annotations())
         return self.params
 
     def __call__(self, x, params=None):

@@ -173,6 +173,139 @@ def unshard(x):
     return jax.tree_util.tree_map(lambda a: np.asarray(a), x)
 
 
+_plan_tokens = iter(range(1, 1 << 62))
+
+
+class ShardingPlan:
+    """Resolved sharding intent for an Executor step on a mesh.
+
+    One plan = one placement policy: how feed batches split across the mesh
+    (``batch_axes``/``seq_axis``), how the persistable-state pytree is laid
+    out (``annotations`` > ``rules`` > ZeRO ``zero_stage``, the
+    `infer_sharding` precedence), and whether the sharded state may be
+    donated into the compiled step (``donate`` — the data-parallel
+    place-once contract forbids it there, tests/test_static_dp.py).  The
+    Executor resolves everything else from the plan: per-shard feed
+    placement, `with_sharding_constraint` pins on the updated state (so
+    steady-state steps re-place nothing), and the mesh/sharding component
+    of the persistent compile-cache key (`fingerprint`).
+
+    This is the rebuild's replacement for the reference's per-device
+    program clones: `ParallelExecutor`'s SSA multi-device graph
+    (parallel_executor.cc:443) becomes a *description* of where one
+    program's values live.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 annotations: Optional[Dict[str, Tuple]] = None,
+                 zero_stage: int = 0,
+                 batch_axes: Sequence[str] = (_mesh.DP_AXIS,),
+                 seq_axis: Optional[str] = None,
+                 donate: bool = True,
+                 devices: Optional[Sequence] = None):
+        if mesh is not None and devices is not None:
+            raise ValueError("pass either mesh or devices, not both")
+        self._mesh = mesh
+        self._devices = list(devices) if devices is not None else None
+        self.rules = rules
+        self.annotations = dict(annotations) if annotations else None
+        self.zero_stage = int(zero_stage)
+        self.batch_axes = tuple(batch_axes)
+        self.seq_axis = seq_axis
+        self.donate = bool(donate)
+        # monotonic identity token: the in-memory hot-cache key component
+        # (cheap int compare per step; content fingerprint() is the slow
+        # cross-process identity and only runs at compile time)
+        self.token = next(_plan_tokens)
+
+    def resolve_mesh(self) -> Mesh:
+        """The mesh this plan places onto (resolved once, then pinned so the
+        hot path and the cache key agree across steps)."""
+        if self._mesh is None:
+            if self._devices is not None:
+                # devices-only plans (with_data_parallel places) get a 1-axis
+                # dp mesh over exactly those devices, reference split order
+                self._mesh = Mesh(np.asarray(self._devices), (_mesh.DP_AXIS,))
+            else:
+                self._mesh = _mesh.current_mesh()
+        return self._mesh
+
+    def num_devices(self) -> int:
+        return self.resolve_mesh().devices.size
+
+    def _batch_spec_axes(self, mesh: Mesh) -> Tuple[str, ...]:
+        return tuple(a for a in self.batch_axes if a in mesh.axis_names)
+
+    def batch_divisor(self, mesh: Optional[Mesh] = None) -> int:
+        mesh = mesh or self.resolve_mesh()
+        n = 1
+        for a in self._batch_spec_axes(mesh):
+            n *= mesh.shape[a]
+        return n
+
+    def feed_sharding(self, name: str, arr,
+                      mesh: Optional[Mesh] = None) -> NamedSharding:
+        """Sharding for one feed array: leading (batch) dim over the batch
+        axes, optional second dim over ``seq_axis``; scalars and batch-1
+        feeds replicate.  An indivisible batch is a user error, not a silent
+        repartition (reference: with_data_parallel's even-split contract)."""
+        mesh = mesh or self.resolve_mesh()
+        batch = self._batch_spec_axes(mesh)
+        ndim = len(np.shape(arr))
+        shape = np.shape(arr)
+        if not batch or ndim == 0 or shape[0] == 1:
+            return NamedSharding(mesh, PartitionSpec())
+        n = self.batch_divisor(mesh)
+        if shape[0] % n != 0:
+            raise ValueError(
+                f"data-parallel feed '{name}' batch dim {shape[0]} "
+                f"does not divide the {n} devices (the reference's "
+                "with_data_parallel requires an even split)")
+        spec = [batch if len(batch) > 1 else batch[0]]
+        if (self.seq_axis is not None and self.seq_axis in mesh.axis_names
+                and ndim > 1 and shape[1] % mesh.shape[self.seq_axis] == 0
+                and shape[1] > 1):
+            spec.append(self.seq_axis)
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    def feed_shardings(self, batch: Dict[str, Any],
+                       mesh: Optional[Mesh] = None
+                       ) -> Dict[str, NamedSharding]:
+        """Per-leaf shardings for a whole feed dict — hand this to
+        ``io.DeviceFeeder(device=...)`` so the prefetch thread stages every
+        batch pre-sharded and the Executor's placement rim passes it through
+        untouched."""
+        mesh = mesh or self.resolve_mesh()
+        return {k: self.feed_sharding(k, v, mesh) for k, v in batch.items()}
+
+    def state_shardings(self, state: Dict[str, Any],
+                        mesh: Optional[Mesh] = None
+                        ) -> Dict[str, NamedSharding]:
+        """NamedSharding per persistable leaf (annotations > rules > ZeRO >
+        replicated) — `infer_sharding` over the flat state dict."""
+        mesh = mesh or self.resolve_mesh()
+        return infer_sharding(state, mesh, self.rules, self.annotations,
+                              self.zero_stage)
+
+    def fingerprint(self) -> str:
+        """Content fingerprint of the plan for the persistent compile-cache
+        key: mesh shape + every placement-relevant knob.  Stable across
+        processes (no device ids, no object identity)."""
+        mesh = self.resolve_mesh()
+        rules = "-"
+        if self.rules is not None:
+            rules = ";".join(f"{p.pattern}->{a}"
+                             for p, a in self.rules.rules)
+        ann = "-"
+        if self.annotations:
+            ann = ";".join(f"{k}->{v}"
+                           for k, v in sorted(self.annotations.items()))
+        return (f"{_mesh.mesh_fingerprint(mesh)}|batch={self.batch_axes}"
+                f"|seq={self.seq_axis}|zero={self.zero_stage}"
+                f"|donate={int(self.donate)}|rules={rules}|ann={ann}")
+
+
 # Default rule table for transformer-family models (ERNIE/BERT/GPT blocks):
 # Megatron layout — attention qkv + ffn-in column-parallel, attention-out +
 # ffn-out row-parallel, embeddings vocab-parallel.  Matches the structured

@@ -1,0 +1,224 @@
+"""Persistent on-disk AOT executable cache for the Executor fast path.
+
+Reference parity: the closest ancestors are the reference's in-process
+prepared-context cache (fluid/executor.py:1272 — a dict of Prepared
+contexts keyed on program id, gone when the process dies) and
+ParallelExecutor's per-device program clones, both of which re-lower the
+ProgramDesc in every worker of a fleet.  TPU-native design: jax-
+compilation-cache-style — the traced-and-lowered step function is
+serialized with ``jax.export`` (StableHLO + input shardings + calling
+convention) and written under ``compile_cache_dir``; a later process —
+another fleet worker, a restarted trainer, a serving replica — deserializes
+the artifact and jits its ``call`` (donation re-applied via
+``donate_argnums``), skipping the program trace and XLA lowering entirely.
+
+Key discipline (a wrong hit is silent corruption, so everything that can
+change the compiled artifact is in the key):
+
+* schema version of this file format,
+* jax + jaxlib versions and the backend platform/device kind,
+* the program *content* fingerprint (canonical walk of every block: op
+  types, sorted input/output slots, canonicalized attrs, var
+  shape/dtype/persistable) — not object identity,
+* the PRNG seed baked into the compiled step,
+* fetch names, feed signature, donated/carried state signatures, donation,
+* the mesh shape × sharding-plan fingerprint (parallel/sharding.py
+  ``ShardingPlan.fingerprint``; ``"single"`` off-mesh).
+
+Entries are self-checking: ``PDTC`` magic + schema + SHA-256 over the
+payload, written atomically (tmp + ``os.replace``) so a crashed writer
+never leaves a half entry.  ``load`` returns ``None`` on ANY failure —
+truncation, bit-rot, version skew, a hand-edited file — and the caller
+falls back to a normal compile; a corrupt cache can cost time, never
+correctness.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import tempfile
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import monitor as _monitor
+from ..utils import trace as _trace
+
+__all__ = ["CompileCache", "active_cache", "program_fingerprint",
+           "build_cache_key"]
+
+# -- telemetry (registered at import so metricsdump lists them) --------------
+_m_cc_hit = _monitor.counter(
+    "executor.compile_cache_hit",
+    "Persistent compile-cache hits: compiled steps deserialized from "
+    "compile_cache_dir instead of traced + lowered.")
+_m_cc_miss = _monitor.counter(
+    "executor.compile_cache_miss",
+    "Persistent compile-cache misses: steps traced, lowered, and (when the "
+    "export succeeded) serialized into compile_cache_dir.")
+_m_cold_ms = _monitor.histogram(
+    "executor.cold_start_ms",
+    "Cold-start wall time of an Executor compile-cache-entry build (ms): "
+    "everything between the in-memory cache miss and the first step's "
+    "dispatch, labeled by where the executable came from (cache=hit: "
+    "deserialized from compile_cache_dir; miss: compiled then stored; "
+    "off: persistent cache disabled).", labelnames=("cache",))
+
+_MAGIC = b"PDTC"
+_SCHEMA = 1
+
+
+def _canon(value) -> str:
+    """Canonical stable repr for attr/spec values (dict order, numpy arrays,
+    and container types normalized; floats via repr so 0.1 survives)."""
+    if isinstance(value, np.ndarray):
+        return (f"nd({value.dtype}:{value.shape}:"
+                f"{hashlib.sha256(np.ascontiguousarray(value).tobytes()).hexdigest()[:16]})")
+    if isinstance(value, np.generic):
+        return f"np({value.dtype}:{value!r})"
+    if isinstance(value, dict):
+        items = ",".join(f"{_canon(k)}:{_canon(v)}"
+                         for k, v in sorted(value.items(), key=lambda kv: str(kv[0])))
+        return "{" + items + "}"
+    if isinstance(value, (list, tuple)):
+        return "[" + ",".join(_canon(v) for v in value) + "]"
+    if isinstance(value, bytes):
+        return f"b({hashlib.sha256(value).hexdigest()[:16]})"
+    return f"{type(value).__name__}:{value!r}"
+
+
+def program_fingerprint(program) -> str:
+    """Content hash of a static Program: every block's ops (type, sorted
+    input/output slots, canonical attrs) and vars (shape/dtype/persistable).
+    Identity- and process-independent — two workers building the same graph
+    get the same fingerprint."""
+    h = hashlib.sha256()
+    for block in program.blocks:
+        h.update(f"block{block.idx}".encode())
+        for name in sorted(getattr(block, "vars", {})):
+            v = block.vars[name]
+            h.update(f"var:{name}:{getattr(v, 'shape', None)}:"
+                     f"{getattr(v, 'dtype', None)}:"
+                     f"{int(bool(getattr(v, 'persistable', False)))};".encode())
+        for op in block.ops:
+            ins = ",".join(f"{k}={sorted(v)}"
+                           for k, v in sorted(op.inputs.items()))
+            outs = ",".join(f"{k}={sorted(v)}"
+                            for k, v in sorted(op.outputs.items()))
+            attrs = ",".join(f"{k}={_canon(v)}"
+                             for k, v in sorted(op.attrs.items()))
+            h.update(f"op:{op.type}|{ins}|{outs}|{attrs};".encode())
+    return h.hexdigest()
+
+
+def _sig(arrays: Dict[str, Any]) -> str:
+    return ";".join(f"{k}:{tuple(np.shape(v))}:{np.asarray(v).dtype if not hasattr(v, 'dtype') else v.dtype}"
+                    for k, v in sorted(arrays.items()))
+
+
+def build_cache_key(program, seed: int, fetch_names: Sequence[str],
+                    feed_arrays: Dict[str, Any], donated: Dict[str, Any],
+                    carried: Dict[str, Any], donate: bool,
+                    plan_fingerprint: Optional[str]) -> str:
+    """SHA-256 key for one compiled step artifact (see module docstring for
+    what is deliberately included)."""
+    import jax
+    import jaxlib
+
+    backend = jax.default_backend()
+    kind = "?"
+    try:
+        kind = jax.devices(backend)[0].device_kind
+    except Exception:
+        pass
+    parts = (
+        f"schema={_SCHEMA}",
+        f"jax={jax.__version__}",
+        f"jaxlib={jaxlib.__version__}",
+        f"backend={backend}:{kind}:{jax.device_count()}",
+        f"program={program_fingerprint(program)}",
+        f"seed={int(seed)}",
+        f"fetch={list(fetch_names)}",
+        f"feed={_sig(feed_arrays)}",
+        f"donated={_sig(donated)}",
+        f"carried={_sig(carried)}",
+        f"donate={int(bool(donate))}",
+        f"plan={plan_fingerprint or 'single'}",
+    )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+class CompileCache:
+    """Content-addressed store of serialized ``jax.export`` artifacts.
+
+    One file per key under ``root``; writes are atomic (tmp file in the same
+    directory + ``os.replace``) and reads are checksum-verified, so a
+    corrupted or torn entry deserializes to ``None`` — never to a wrong
+    executable."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.pdtc")
+
+    def load(self, key: str) -> Optional[bytes]:
+        """The stored payload, or None on miss OR any corruption/skew — the
+        caller recompiles; a bad cache entry must never raise."""
+        try:
+            with open(self.path(key), "rb") as f:
+                data = f.read()
+            if len(data) < 4 + 4 + 32 or data[:4] != _MAGIC:
+                return None
+            (schema,) = struct.unpack("<I", data[4:8])
+            if schema != _SCHEMA:
+                return None
+            digest, payload = data[8:40], data[40:]
+            if hashlib.sha256(payload).digest() != digest:
+                _trace.flight_recorder().record(
+                    "compile_cache_corrupt", key=key[:16],
+                    path=self.path(key))
+                return None
+            return payload
+        except Exception:
+            return None
+
+    def store(self, key: str, payload: bytes) -> bool:
+        """Atomically persist one artifact; failures (read-only dir, disk
+        full) are non-fatal — the in-memory executable still runs."""
+        try:
+            blob = (_MAGIC + struct.pack("<I", _SCHEMA)
+                    + hashlib.sha256(payload).digest() + payload)
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self.path(key))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return True
+        except Exception as e:
+            _trace.flight_recorder().record(
+                "compile_cache_store_failed", key=key[:16], error=repr(e))
+            return False
+
+
+def active_cache() -> Optional[CompileCache]:
+    """The process cache per the ``compile_cache_dir`` flag (None = off)."""
+    from ..core import flags as _flags
+
+    root = _flags.get_flag("compile_cache_dir")
+    if not root:
+        return None
+    try:
+        return CompileCache(root)
+    except Exception as e:
+        _trace.flight_recorder().record(
+            "compile_cache_unavailable", root=str(root), error=repr(e))
+        return None

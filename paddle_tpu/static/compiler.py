@@ -72,6 +72,7 @@ class CompiledProgram:
         self._data_parallel = False
         self._loss_name: Optional[str] = None
         self._places: Optional[Sequence] = None
+        self._plan = None  # parallel.sharding.ShardingPlan, built lazily
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -85,6 +86,48 @@ class CompiledProgram:
         if build_strategy is not None:
             self._build_strategy = build_strategy
         return self
+
+    def with_sharding(self, mesh=None, rules=None, annotations=None,
+                      zero_stage: int = 0, batch_axes=None, seq_axis=None,
+                      donate: bool = True) -> "CompiledProgram":
+        """Run this program's compiled step under NamedShardings on a mesh —
+        the full hybrid-parallel face of the Executor fast path.
+
+        Unlike ``with_data_parallel`` (replicated state, place-once, no
+        donation), a sharded plan keeps the *sharded* persistable pytree
+        device-resident shard-by-shard across steps and donates it into the
+        compiled step (``donate=True`` default; platform-gated like the
+        single-device path), so multi-chip steady state pays the same
+        near-zero host rim PR 4's fast path bought single-chip.  ``mesh``
+        defaults to the process mesh (`parallel.mesh.current_mesh`);
+        ``rules``/``annotations``/``zero_stage`` follow
+        `parallel.sharding.infer_sharding` precedence for state placement;
+        ``batch_axes``/``seq_axis`` shard the feeds (defaults: batch over
+        ``dp``)."""
+        from ..parallel import mesh as _pmesh
+        from ..parallel.sharding import ShardingPlan
+
+        self._plan = ShardingPlan(
+            mesh=mesh, rules=rules, annotations=annotations,
+            zero_stage=zero_stage,
+            batch_axes=tuple(batch_axes) if batch_axes else (_pmesh.DP_AXIS,),
+            seq_axis=seq_axis, donate=donate)
+        return self
+
+    def _sharding_plan(self):
+        """The plan the Executor runs under (lazy: with_data_parallel only
+        commits to a device list at first run, like the reference's deferred
+        ParallelExecutor construction).  None = single-device path."""
+        if self._plan is None and self._data_parallel:
+            devices = self._devices()
+            if len(devices) > 1:
+                from ..parallel.sharding import ShardingPlan
+
+                # replicated state + batch-sharded feeds, and NO donation:
+                # the DP place-once contract pins buffer identity across
+                # steps (tests/test_static_dp.py)
+                self._plan = ShardingPlan(devices=devices, donate=False)
+        return self._plan
 
     @property
     def program(self) -> Program:

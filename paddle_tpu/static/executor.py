@@ -370,6 +370,13 @@ _m_cost_flops = _monitor.gauge(
 _m_cost_bytes = _monitor.gauge(
     "executor.cost_bytes_accessed", "XLA cost_analysis() bytes-accessed "
     "estimate of the last-compiled executable.", labelnames=("program",))
+_m_traces = _monitor.counter(
+    "executor.traces", "Program traces: how many times the Executor walked "
+    "a Program's ops to (re)build a step function.  Increments at trace "
+    "time only — steady-state dispatch of a compiled step never bumps it, "
+    "and a warm persistent compile-cache start keeps it at 0 (the step "
+    "deserializes instead of tracing).  A growing value in steady state is "
+    "a retrace bug.")
 
 
 _prog_tokens = iter(range(1, 1 << 62))
@@ -393,17 +400,17 @@ class _CacheEntry:
     ``feed_sig`` in place — no sorted-tuple signature is rebuilt, no program
     walk recomputes the persistable list."""
 
-    __slots__ = ("key", "compiled", "version", "donate", "devices_ids",
+    __slots__ = ("key", "compiled", "version", "donate", "plan_token",
                  "fetch_names", "feed_sig", "state_names", "needs_value",
-                 "op_count", "fingerprint")
+                 "op_count", "fingerprint", "disk_cache")
 
-    def __init__(self, key, version, donate, devices_ids, fetch_names,
+    def __init__(self, key, version, donate, plan_token, fetch_names,
                  feed_arrays, state_names, needs_value, op_count, fingerprint):
         self.key = key
         self.compiled = None
         self.version = version
         self.donate = donate
-        self.devices_ids = devices_ids
+        self.plan_token = plan_token
         self.fetch_names = list(fetch_names)
         self.feed_sig = {k: (tuple(v.shape), v.dtype)
                          for k, v in feed_arrays.items()}
@@ -411,11 +418,12 @@ class _CacheEntry:
         self.needs_value = frozenset(needs_value)
         self.op_count = op_count
         self.fingerprint = fingerprint
+        self.disk_cache = "off"  # persistent-cache provenance: hit|miss|off
 
-    def matches(self, version, fetch_names, feed_arrays, devices_ids,
+    def matches(self, version, fetch_names, feed_arrays, plan_token,
                 donate) -> bool:
         if (self.version != version or self.donate != donate
-                or self.devices_ids != devices_ids
+                or self.plan_token != plan_token
                 or self.fetch_names != fetch_names
                 or len(self.feed_sig) != len(feed_arrays)):
             return False
@@ -457,9 +465,9 @@ class Executor:
         round-trip (pair with ``io.DeviceFeeder`` prefetch)."""
         from .compiler import CompiledProgram
 
-        devices = None
+        plan = None
         if isinstance(program, CompiledProgram):
-            devices = program._devices() if program._data_parallel else None
+            plan = program._sharding_plan()
             program = program._program
         program = program or default_main_program()
         feed = feed or {}
@@ -475,21 +483,22 @@ class Executor:
 
         from ..core import flags as _flags
 
-        # donation is single-device only: the data-parallel path pins a
-        # place-once buffer-identity contract (tests/test_static_dp.py)
-        # that in-place donation would break
+        # donation follows the plan: the sharded fast path donates the
+        # *sharded* state pytree (with_sharding's default), while the
+        # data-parallel plan pins a place-once buffer-identity contract
+        # (tests/test_static_dp.py) that in-place donation would break
         donate = (bool(_flags.get_flag("donate_state"))
                   and _donation_async_safe()
-                  and not (devices and len(devices) > 1))
-        dev_ids = tuple(id(d) for d in devices) if devices else None
+                  and (plan is None or plan.donate))
+        plan_token = plan.token if plan is not None else None
 
         # hot path: one dict lookup on the program token, then an in-place
         # feed-shape check — no sorted signature tuple, no program re-walk
         entry = self._hot.get(getattr(program, "_exec_cache_token", None))
         if entry is None or not entry.matches(program._version, fetch_names,
-                                              feed_arrays, dev_ids, donate):
+                                              feed_arrays, plan_token, donate):
             entry = self._cold_lookup(program, fetch_names, feed_arrays,
-                                      dev_ids, donate)
+                                      plan_token, donate)
 
         state, missing = {}, None
         for n in entry.state_names:
@@ -545,11 +554,28 @@ class Executor:
                     _check_program(program, feed_names=set(feed_arrays),
                                    fetch_names=fetch_names)
                 seed = program.random_seed or _random_seed()
-                entry.compiled = self._build(
+                # persistent AOT cache (static/compile_cache.py): key the
+                # artifact by program content × mesh/plan × versions; a hit
+                # deserializes the compiled step instead of tracing it
+                from . import compile_cache as _ccache
+
+                disk = _ccache.active_cache()
+                disk_key = None
+                if disk is not None:
+                    disk_key = _ccache.build_cache_key(
+                        program, seed, fetch_names, feed_arrays, d_state,
+                        p_state, donate,
+                        plan.fingerprint() if plan is not None else None)
+                entry.compiled, entry.disk_cache, cost = self._build(
                     program, fetch_names, entry.state_names, seed,
-                    devices=devices, feed_arrays=feed_arrays, donate=donate,
-                    example=(feed_arrays, d_state, p_state, step_arg))
-                cost = getattr(entry.compiled, "xla_cost", None)
+                    plan=plan, feed_arrays=feed_arrays, donate=donate,
+                    example=(feed_arrays, d_state, p_state, step_arg),
+                    disk=disk, disk_key=disk_key)
+                sp.set_attr("compile_cache", entry.disk_cache)
+                if entry.disk_cache == "hit":
+                    _ccache._m_cc_hit.inc()
+                elif entry.disk_cache == "miss":
+                    _ccache._m_cc_miss.inc()
                 if cost:
                     # XLA cost_analysis() of the compiled artifact:
                     # flops/bytes land on the compile span and as gauges
@@ -585,7 +611,13 @@ class Executor:
         # under async dispatch the device may still be computing when
         # compiled() returns, so this is the Python-rim cost, not step time
         if cache_miss:
-            _m_compile_ms.observe((now - t_compile0) * 1000.0)
+            from . import compile_cache as _ccache
+
+            cold_ms = (now - t_compile0) * 1000.0
+            _m_compile_ms.observe(cold_ms)
+            # cold-start cost labeled by executable provenance: a warm
+            # persistent cache (hit) should sit well below a real compile
+            _ccache._m_cold_ms.observe(cold_ms, cache=entry.disk_cache)
         else:
             _m_dispatch_ms.observe((now - t_run0) * 1000.0)
         _trace.flight_recorder().record(
@@ -608,7 +640,7 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
-    def _cold_lookup(self, program, fetch_names, feed_arrays, dev_ids,
+    def _cold_lookup(self, program, fetch_names, feed_arrays, plan_token,
                      donate) -> _CacheEntry:
         """Full cache-key build (sorted feed signature + program walk); the
         resulting entry is pinned on the hot map so steady-state calls skip
@@ -617,13 +649,13 @@ class Executor:
         key = (token, program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
-               dev_ids, donate)
+               plan_token, donate)
         entry = self._cache.get(key)
         if entry is None:
             state_names = self._state_names(program, global_scope())
             needs = [n for n in state_names if self._needs_value(program, n)]
             entry = _CacheEntry(
-                key, program._version, donate, dev_ids, fetch_names,
+                key, program._version, donate, plan_token, fetch_names,
                 feed_arrays, state_names, needs,
                 op_count=sum(len(b.ops) for b in program.blocks),
                 # cache token + program version identify the exact compiled
@@ -732,14 +764,23 @@ class Executor:
         return None
 
     def _build(self, program: Program, fetch_names, state_names, seed,
-               devices=None, feed_arrays=None, example=None, donate=False):
+               plan=None, feed_arrays=None, example=None, donate=False,
+               disk=None, disk_key=None):
         """Trace the program into `(feeds, donated, carried, step) ->
         (fetches, new_state)`.  The PRNG base key is derived INSIDE the
         compiled function — `fold_in(PRNGKey(seed), step)` with `step`
         passed as a scalar arg — so steady-state calls never mint a host
         PRNGKey (a small jit dispatch of its own) and never retrace on the
-        step counter.  `seed` is captured per compile-cache entry."""
+        step counter.  `seed` is captured per compile-cache entry.
+
+        Returns ``(compiled, disk_cache_status, xla_cost)``: status is
+        ``"hit"`` (step deserialized from ``compile_cache_dir`` — no trace,
+        no lowering), ``"miss"`` (traced, exported, stored), or ``"off"``
+        (persistent cache disabled or export unavailable)."""
+        state_constraints: Dict[str, Any] = {}
+
         def raw(feeds, donated, carried, step):
+            _m_traces.inc()  # host side effect: fires at trace time only
             env: Dict[str, Any] = {}
             env.update({k: jnp.asarray(v) for k, v in carried.items()})
             env.update({k: jnp.asarray(v) for k, v in donated.items()})
@@ -747,15 +788,62 @@ class Executor:
             base_key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
             _trace_block(program, env, base_key)
             fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in state_names if n in env}
+            new_state = {}
+            for n in state_names:
+                if n in env:
+                    v = env[n]
+                    sh = state_constraints.get(n)
+                    if sh is not None:
+                        # pin the updated state to the plan's layout so
+                        # steady-state write-backs come home already sharded
+                        # and the placement rim passes them through
+                        v = jax.lax.with_sharding_constraint(v, sh)
+                    new_state[n] = v
             return fetches, new_state
 
-        if not devices or len(devices) == 1:
-            return self._build_single(raw, example, donate)
-        return self._build_data_parallel(raw, devices, feed_arrays)
+        if plan is None:
+            return self._build_single(raw, example, donate, disk, disk_key)
+        return self._build_sharded(raw, plan, example, donate,
+                                   state_constraints, disk, disk_key)
 
     @staticmethod
-    def _build_single(raw, example, donate):
+    def _load_or_export(raw, example, donate, disk, disk_key):
+        """Resolve the core compiled step through the persistent cache.
+
+        Hit: deserialize the ``jax.export`` artifact and jit its ``call``
+        (donation re-applied via ``donate_argnums``) — the program is never
+        traced and XLA never lowers it.  Miss: export once (the only trace
+        of ``raw``), store atomically, and RUN the exported module too, so
+        cold and warm processes execute the byte-identical artifact.  Any
+        export-layer failure degrades to plain jit — the cache can only
+        cost time, never a step."""
+        donate_args = (1,) if donate else ()
+        if disk is not None and disk_key is not None and example is not None:
+            from jax import export as _export
+
+            payload = disk.load(disk_key)
+            if payload is not None:
+                try:
+                    exp = _export.deserialize(payload)
+                    return (jax.jit(exp.call, donate_argnums=donate_args),
+                            "hit")
+                except Exception as e:
+                    _trace.flight_recorder().record(
+                        "compile_cache_deserialize_failed",
+                        key=disk_key[:16], error=repr(e))
+            try:
+                exp = _export.export(jax.jit(raw))(*example)
+                disk.store(disk_key, exp.serialize())
+                return (jax.jit(exp.call, donate_argnums=donate_args),
+                        "miss")
+            except Exception as e:
+                _trace.flight_recorder().record(
+                    "compile_cache_export_failed", key=disk_key[:16],
+                    error=repr(e))
+        return jax.jit(raw, donate_argnums=donate_args), "off"
+
+    @staticmethod
+    def _build_single(raw, example, donate, disk=None, disk_key=None):
         """jit the traced step (donating the `donated` state subtree when the
         donate_state fast path is on); when telemetry is on, AOT-compile
         against the example args instead so the compiled artifact's
@@ -763,17 +851,20 @@ class Executor:
         the reference's per-op cost model) is observable.  The AOT
         executable is pinned to the example's arg structure; a later call
         with a different state pytree (a program that grows persistables)
-        falls back to the jitted path, which retraces as usual."""
-        if donate:
-            jitted = jax.jit(raw, donate_argnums=(1,))
-        else:
-            jitted = jax.jit(raw)
+        falls back to the jitted path, which retraces as usual.  The
+        persistent-cache path skips cost analysis (its artifact was lowered
+        once, possibly in another process)."""
+        core, status = Executor._load_or_export(raw, example, donate, disk,
+                                                disk_key)
+        if status != "off":
+            return core, status, None
+        jitted = core
         if example is None or not _monitor.enabled():
-            return jitted
+            return jitted, status, None
         try:
             aot = jitted.lower(*example).compile()
         except Exception:
-            return jitted
+            return jitted, status, None
         cost = None
         try:
             ca = aot.cost_analysis()
@@ -792,55 +883,63 @@ class Executor:
                 # the donated buffers are still live for the jitted retry
                 return jitted(feeds, donated, carried, step)
 
-        call.xla_cost = cost
-        return call
+        return call, status, cost
 
     @staticmethod
-    def _build_data_parallel(raw, devices, feed_arrays):
-        """Data-parallel build: the SAME traced computation, jitted over a
-        1-axis mesh with batch-sharded feeds + replicated persistables.
-        GSPMD partitions the forward, and the replay-gradient summation
-        against replicated params lowers to the cross-device all-reduce the
-        reference's MultiDevSSAGraphBuilder inserted per gradient
+    def _build_sharded(raw, plan, example, donate, state_constraints,
+                       disk=None, disk_key=None):
+        """Sharded build: the SAME traced computation with feeds and
+        persistable state placed by the ShardingPlan's NamedShardings.
+        GSPMD partitions the compute and inserts the collectives the
+        reference's MultiDevSSAGraphBuilder spelled out per gradient
         (ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464).
-        No donation here: the place-once contract pins buffer identity
-        across steps (tests/test_static_dp.py)."""
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        mesh = Mesh(np.asarray(devices), ("dp",))
-        n = len(devices)
-        repl = NamedSharding(mesh, PartitionSpec())
+        The updated state is pinned to its input layout inside the traced
+        step (``state_constraints`` feeds the `with_sharding_constraint` in
+        ``raw``), so steady-state write-backs land already sharded and the
+        placement rim below passes them through by identity: per-shard
+        device residency across steps, donation of the sharded pytree
+        included when the plan allows it (``with_sharding``; the
+        data-parallel plan forbids it — the place-once contract in
+        tests/test_static_dp.py pins buffer identity)."""
+        mesh = plan.resolve_mesh()
+        feeds0, d0, p0, step0 = example
+        feed_sh = {k: plan.feed_sharding(k, v, mesh)
+                   for k, v in feeds0.items()}
+        state_all = dict(p0)
+        state_all.update(d0)
+        state_sh = plan.state_shardings(state_all, mesh)
+        state_constraints.update(state_sh)
 
-        def feed_sharding(name, arr):
-            if arr.ndim == 0 or arr.shape[0] == 1:
-                return repl
-            if arr.shape[0] % n != 0:
-                raise ValueError(
-                    f"data-parallel feed '{name}' batch dim {arr.shape[0]} "
-                    f"does not divide the {n} devices (the reference's "
-                    "with_data_parallel requires an even split)")
-            return NamedSharding(mesh, PartitionSpec("dp"))
+        def place(v, sh):
+            # place-once: an array already laid out per the plan passes
+            # through by identity (no device_put, no copy — what the DP
+            # buffer-identity test and the donation path both rely on);
+            # host values and stale layouts are placed
+            if isinstance(v, jax.Array):
+                try:
+                    if v.sharding.is_equivalent_to(sh, v.ndim):
+                        return v
+                except Exception:
+                    pass
+            return jax.device_put(v, sh)
 
-        feed_sh = {k: feed_sharding(k, v) for k, v in feed_arrays.items()}
-        jitted = jax.jit(raw)
+        def place_all(feeds, donated, carried):
+            return ({k: place(v, feed_sh[k]) for k, v in feeds.items()},
+                    {n: place(v, state_sh[n]) for n, v in donated.items()},
+                    {n: place(v, state_sh[n]) for n, v in carried.items()})
+
+        placed_example = None
+        if disk is not None:
+            placed_example = (*place_all(feeds0, d0, p0), step0)
+        core, status = Executor._load_or_export(raw, placed_example, donate,
+                                                disk, disk_key)
 
         def call(feeds, donated, carried, step):
-            placed_feeds = {k: jax.device_put(np.asarray(v), feed_sh[k])
-                            for k, v in feeds.items()}
-            # place-once contract: after step 1 the state arrays come back
-            # from the jitted step ALREADY replicated — skip device_put so
-            # the steady-state path provably moves no persistable bytes
-            # (tests/test_static_dp.py pins buffer identity); only fresh
-            # host values (startup init, user scope writes) are placed
-            state = dict(donated)
-            state.update(carried)
-            placed_state = {
-                k: v if isinstance(v, jax.Array) and v.sharding == repl
-                else jax.device_put(v, repl)
-                for k, v in state.items()}
-            return jitted(placed_feeds, {}, placed_state, step)
+            pf, pd, pc = place_all(feeds, donated, carried)
+            return core(pf, pd, pc, step)
 
-        return call
+        return call, status, None
 
     def close(self):
         self._cache.clear()

@@ -320,14 +320,17 @@ def parse_program_desc(data: bytes) -> dict:
 def _enc_attr(name: str, atype: int, value) -> bytes:
     out = _f_bytes(1, name.encode()) + _f_varint(2, atype)
     if atype == INT:
-        out += _f_varint(3, int(value) & 0xFFFFFFFF)
+        # proto2 int32: negative values are sign-extended to 64 bits and
+        # emitted as the canonical 10-byte varint (NOT truncated to the
+        # 32-bit pattern, which real protobuf decoders reject/misread)
+        out += _f_varint(3, int(value) & 0xFFFFFFFFFFFFFFFF)
     elif atype == FLOAT:
         out += _f_float(4, float(value))
     elif atype == STRING:
         out += _f_bytes(5, str(value).encode())
     elif atype == INTS:
         for x in value:
-            out += _f_varint(6, int(x) & 0xFFFFFFFF)
+            out += _f_varint(6, int(x) & 0xFFFFFFFFFFFFFFFF)
     elif atype == FLOATS:
         for x in value:
             out += _f_float(7, float(x))
@@ -508,7 +511,21 @@ def program_to_desc(program, feeds: Sequence[str],
                     fetches: Sequence[str]) -> dict:
     """Our (single-block) Program -> ProgramDesc dict ready for
     encode_program_desc, with reference-style feed/fetch ops."""
-    from .framework import Parameter
+    from ..core.errors import UnimplementedError
+    from .framework import SUB_BLOCK_ATTRS, Parameter
+
+    # mirror of the import-side guard (program_from_desc): a silently
+    # truncated export would round-trip to a program missing its cond/while
+    # bodies — fail legibly instead (ADVICE round-5 finding)
+    if (len(program.blocks) > 1
+            or any(a in op.attrs for op in program.global_block().ops
+                   for a in SUB_BLOCK_ATTRS)):
+        raise UnimplementedError(
+            "exporting a Program with control-flow sub-blocks: the proto "
+            "exporter emits single-block inference programs only — the "
+            "reference block encoding carries scope semantics that do not "
+            "survive the XLA lowering, so a multi-block export would drop "
+            "the cond/while bodies silently")
 
     blk = program.global_block()
     vars_out = [

@@ -463,3 +463,60 @@ def test_convert_print_honors_kwargs(capsys):
     jax.effects_barrier()
     out = capsys.readouterr().out
     assert "a|" in out and "<END>" in out
+
+
+# -- builtin rewrite shadowing + len gate -------------------------------------
+
+def test_builtin_rewrite_skips_shadowed_names():
+    """A locally rebound int/float/bool/len/print is the user's object —
+    the cast/print/len rewrite must not fire on it (regression: the
+    rewrite used to hijack shadowed names)."""
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    def param_shadow(len, x):
+        if jnp.sum(x) > 0:
+            y = len + 1
+        else:
+            y = len - 1
+        return y
+
+    g = ast_transform(param_shadow)
+    assert int(g(5, jnp.ones(3))) == 6  # convert_len would have crashed
+
+    def assign_shadow(x):
+        int = 10            # noqa: A001 — the point of the test
+        if jnp.sum(x) > 0:
+            y = int + 1
+        else:
+            y = 0
+        return y
+
+    assert int(ast_transform(assign_shadow)(jnp.ones(3))) == 11
+
+    def import_shadow(x):
+        from math import floor as float  # noqa: A001
+        if jnp.sum(x) > 0:
+            y = float(2.9)
+        else:
+            y = 0
+        return y
+
+    assert int(ast_transform(import_shadow)(jnp.ones(3))) == 2
+
+
+def test_len_alone_is_convertible():
+    """`len` joined the convertible gate: a function whose only rewritable
+    construct is len(tensor) converts instead of raising Unsupported."""
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    def f(x):
+        return len(x) + 0
+
+    g = ast_transform(f)  # must not raise "nothing to convert"
+    assert g(jnp.ones((4, 2))) == 4
+
+    def shadowed(len, x):
+        return len(x)      # a CALL, but through the shadowed name
+
+    with pytest.raises(Unsupported, match="nothing to convert"):
+        ast_transform(shadowed)  # the only `len` is shadowed -> no-op

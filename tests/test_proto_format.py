@@ -289,3 +289,79 @@ def test_cipher_rejected_on_reference_format(tmp_path, _fresh_programs):
     with pytest.raises(ValueError, match="cipher"):
         static.load_inference_model(d, exe, cipher=cipher,
                                     model_filename="__model__")
+
+
+# -- negative int attrs: canonical proto2 wire form ---------------------------
+
+def test_negative_int_attr_encodes_sign_extended():
+    """proto2 int32 fields encode negatives as 10-byte sign-extended
+    varints — a truncated 5-byte form round-trips through OUR decoder but
+    is rejected/misread by strict reference parsers (regression for the
+    `& 0xFFFFFFFF` truncation in _enc_attr)."""
+    body = PF._enc_attr("axis", PF.INT, -1)
+    # field 3 varint payload must be the full 64-bit sign extension
+    canonical = _varint((3 << 3)) + _varint((1 << 64) - 1)
+    assert canonical in body
+    name, atype, value = PF._parse_attr(body)
+    assert (name, atype, value) == ("axis", PF.INT, -1)
+
+    body = PF._enc_attr("shape", PF.INTS, [-1, 3, -7])
+    assert _varint((6 << 3)) + _varint((1 << 64) - 1) in body
+    name, atype, value = PF._parse_attr(body)
+    assert (name, atype, value) == ("shape", PF.INTS, [-1, 3, -7])
+
+
+def test_negative_int_attr_decoder_accepts_both_forms():
+    """The decoder keeps accepting the legacy truncated 5-byte form (our
+    own pre-fix files) alongside the canonical 10-byte one."""
+    base = _ld(1, b"axis") + _vi(2, PF.INT)
+    legacy = base + _varint(3 << 3) + _varint(-1 & 0xFFFFFFFF)
+    canon = base + _varint(3 << 3) + _varint(-1 & ((1 << 64) - 1))
+    assert PF._parse_attr(legacy) == ("axis", PF.INT, -1)
+    assert PF._parse_attr(canon) == ("axis", PF.INT, -1)
+
+
+def test_negative_int_attr_program_roundtrip(tmp_path, _fresh_programs):
+    """End-to-end: a program whose op carries negative INT/INTS attrs
+    (reshape shape=[-1, 2], elementwise axis=-1) survives
+    program_to_desc -> encode -> parse -> program_from_desc."""
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    y = L.reshape(x, [-1, 2])
+    blob = PF.encode_program_desc(PF.program_to_desc(main, ["x"], [y.name]))
+    desc = PF.parse_program_desc(blob)
+    shapes = [op["attrs"]["shape"] for b in desc["blocks"]
+              for op in b["ops"] if "shape" in op["attrs"]]
+    assert [-1, 2] in shapes
+    prog, feeds, fetches = PF.program_from_desc(desc)
+    assert feeds == ["x"]
+
+
+# -- multi-block export guard -------------------------------------------------
+
+def test_program_to_desc_rejects_sub_block_ops(_fresh_programs):
+    """Mirror of the import-side guard: exporting an op that carries a
+    sub-block attr must fail legibly instead of silently dropping the
+    cond/while body."""
+    from paddle_tpu.core.errors import UnimplementedError
+
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    y = L.scale(x, scale=2.0)
+    main.global_block().ops[-1].attrs["body_block"] = 1
+    with pytest.raises(UnimplementedError, match="sub-block"):
+        PF.program_to_desc(main, ["x"], [y.name])
+
+
+def test_program_to_desc_rejects_multi_block(_fresh_programs):
+    from paddle_tpu.core.errors import UnimplementedError
+
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    y = L.scale(x, scale=2.0)
+    main.blocks.append(object())  # guard fires before any block is touched
+    try:
+        with pytest.raises(UnimplementedError, match="sub-block"):
+            PF.program_to_desc(main, ["x"], [y.name])
+    finally:
+        main.blocks.pop()

@@ -1,0 +1,431 @@
+"""Sharded steady-state fast path + persistent AOT executable cache.
+
+Covers the PR-6 contract:
+  * the donated SHARDED step is bitwise-identical to the undonated sharded
+    step (donation never changes math), and matches the single-device fast
+    path at the DP tolerance — different XLA executables (GSPMD partitioned
+    vs single-device) legitimately differ in ulps, so cross-executable
+    parity is tolerance-based, never bitwise;
+  * steady state under a sharding plan compiles exactly once (cache_miss
+    == 1) and never re-traces Python (`executor.traces` stops growing);
+  * the persistent executable cache round-trips: compile -> store -> fresh
+    Executor deserializes (compile_cache_hit) with bitwise-identical
+    losses; eviction recompiles; a corrupted file falls back cleanly; and
+    a SECOND PROCESS warm-starts without a single Python trace.
+
+Cache tests share ONE Program object across runs inside a process: the
+global unique-name counter makes a rebuilt program fingerprint-different
+(fresh processes regenerate identical names, which the subprocess test
+exercises for real).
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core import flags
+from paddle_tpu.parallel.mesh import DP_AXIS
+from paddle_tpu.parallel.sharding import ShardingPlan
+from paddle_tpu.static import compile_cache as cc
+from paddle_tpu.static import executor as executor_mod
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import monitor
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = flags.get_flags(["donate_state", "metrics", "compile_cache_dir"])
+    yield
+    flags.set_flags(saved)
+
+
+def _mesh(n: int) -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:n]), (DP_AXIS,))
+
+
+def _build_net(seed: int = 7):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with static.program_guard(main, startup):
+        x = L.data("x", [8])
+        y = L.data("y", [1])
+        pred = L.fc(L.fc(x, 16, act="relu"), 1)
+        loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+        static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(batch: int = 16):
+    rng = np.random.default_rng(3)
+    return {"x": rng.normal(size=(batch, 8)).astype(np.float32),
+            "y": rng.normal(size=(batch, 1)).astype(np.float32)}
+
+
+def _train(run_target, main, startup, loss, steps: int = 5,
+           return_numpy: bool = False):
+    """Fresh Scope+Executor over an already-built program; float losses."""
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        out = [exe.run(run_target, feed=feed, fetch_list=[loss],
+                       return_numpy=return_numpy)[0] for _ in range(steps)]
+        return [float(np.asarray(l)) for l in out], scope
+
+
+# ---------------------------------------------------------------------------
+# sharded fast-path parity
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_sharded_donated_matches_undonated_bitwise(_flags_guard, monkeypatch):
+    """Donation must not change math: the same sharded plan with and
+    without state donation yields bit-for-bit identical losses (CPU skips
+    donation by default, so force it through the platform gate)."""
+    monkeypatch.setattr(executor_mod, "_FORCE_DONATION", True)
+    flags.set_flags({"donate_state": True})
+    mesh = _mesh(8)
+
+    main, startup, loss = _build_net(seed=7)
+    donated = static.CompiledProgram(main).with_sharding(mesh=mesh,
+                                                         donate=True)
+    d_losses, _ = _train(donated, main, startup, loss)
+
+    main2, startup2, loss2 = _build_net(seed=7)
+    undonated = static.CompiledProgram(main2).with_sharding(mesh=mesh,
+                                                            donate=False)
+    u_losses, _ = _train(undonated, main2, startup2, loss2)
+
+    assert d_losses == u_losses  # bitwise: same plan, same executable math
+
+
+@needs_devices
+def test_sharded_matches_unsharded_within_tolerance(_flags_guard):
+    """8-device GSPMD partitioning reorders the batch reduction (psum tree
+    vs flat sum), so sharded-vs-single-device parity is ulp-level, not
+    bitwise — the same rel=2e-4 contract test_static_dp.py pins for
+    with_data_parallel."""
+    flags.set_flags({"donate_state": True})
+
+    main, startup, loss = _build_net(seed=7)
+    base, _ = _train(main, main, startup, loss)
+
+    main2, startup2, loss2 = _build_net(seed=7)
+    sharded = static.CompiledProgram(main2).with_sharding(mesh=_mesh(8))
+    got, _ = _train(sharded, main2, startup2, loss2)
+
+    assert got == pytest.approx(base, rel=2e-4)
+
+
+@needs_devices
+def test_sharded_zero_steady_state_retraces(_flags_guard):
+    """Under a sharding plan the hot cache must hold: one compile on the
+    first step, every later step a hit, and the Python tracer never runs
+    again (`executor.traces` counts trace-time host effects)."""
+    flags.set_flags({"donate_state": True, "metrics": True})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+    compiled = static.CompiledProgram(main).with_sharding(mesh=_mesh(8))
+
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        miss0 = reg.get("executor.cache_miss").value()
+        hit0 = reg.get("executor.cache_hit").value()
+        exe.run(compiled, feed=feed, fetch_list=[loss], return_numpy=False)
+        traces1 = reg.get("executor.traces").value()
+        n = 6
+        for _ in range(n - 1):
+            exe.run(compiled, feed=feed, fetch_list=[loss],
+                    return_numpy=False)
+        assert reg.get("executor.cache_miss").value() - miss0 == 1
+        assert reg.get("executor.cache_hit").value() - hit0 == n - 1
+        # zero retraces after the first step
+        assert reg.get("executor.traces").value() == traces1
+
+
+@needs_devices
+def test_sharded_state_and_fetches_live_on_the_mesh(_flags_guard):
+    """After sharded steps the persistable state written back to the scope
+    is device-resident across the whole mesh (replicated NamedSharding
+    under the default plan) — no per-step host round-trip."""
+    flags.set_flags({"donate_state": True})
+    mesh = _mesh(8)
+    main, startup, loss = _build_net(seed=7)
+    compiled = static.CompiledProgram(main).with_sharding(mesh=mesh)
+
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        out = None
+        for _ in range(3):
+            out = exe.run(compiled, feed=feed, fetch_list=[loss],
+                          return_numpy=False)[0]
+        assert isinstance(out, jax.Array)
+        persistables = [v.name for v in main.global_block().vars.values()
+                        if getattr(v, "persistable", False)]
+        assert persistables
+        repl = NamedSharding(mesh, P())
+        on_mesh = 0
+        for name in persistables:
+            val = scope.find_var(name)
+            if not isinstance(val, jax.Array):
+                continue
+            assert val.sharding.is_equivalent_to(repl, val.ndim), name
+            assert len(val.sharding.device_set) == 8, name
+            on_mesh += 1
+        assert on_mesh >= 2  # at minimum the two fc weight/bias pairs
+
+
+@needs_devices
+def test_sharded_indivisible_batch_raises(_flags_guard):
+    flags.set_flags({"donate_state": True})
+    main, startup, loss = _build_net(seed=7)
+    compiled = static.CompiledProgram(main).with_sharding(mesh=_mesh(8))
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(3)
+        bad = {"x": rng.normal(size=(12, 8)).astype(np.float32),
+               "y": rng.normal(size=(12, 1)).astype(np.float32)}
+        with pytest.raises(ValueError, match="does not divide"):
+            exe.run(compiled, feed=bad, fetch_list=[loss],
+                    return_numpy=False)
+
+
+@needs_devices
+def test_device_feeder_stages_plan_shardings():
+    """DeviceFeeder(device=plan.feed_shardings(...)) hands the consumer
+    batches whose leaves already carry the plan's NamedShardings — the
+    Executor's placement rim then passes them through by identity."""
+    from paddle_tpu.io import DeviceFeeder
+
+    mesh = _mesh(4)
+    plan = ShardingPlan(mesh=mesh, donate=False)
+    batch = _feed()
+    shardings = plan.feed_shardings(batch, mesh)
+    feeder = DeviceFeeder([batch, batch], device=shardings)
+    staged = list(feeder)
+    assert len(staged) == 2
+    for got in staged:
+        for k, v in got.items():
+            assert isinstance(v, jax.Array)
+            assert v.sharding.is_equivalent_to(shardings[k], v.ndim), k
+
+
+# ---------------------------------------------------------------------------
+# persistent executable cache
+# ---------------------------------------------------------------------------
+
+def _cc_counters(reg):
+    def val(name):
+        m = reg.get(name)
+        return m.value() if m is not None else 0
+    return (val("executor.compile_cache_hit"),
+            val("executor.compile_cache_miss"),
+            val("executor.traces"))
+
+
+def test_compile_cache_roundtrip_evict_reload(_flags_guard, tmp_path):
+    """compile -> store -> fresh Executor reloads from disk (hit, zero
+    traces) with bitwise-identical fetches; evicting the files recompiles
+    (miss) to the same numbers."""
+    flags.set_flags({"donate_state": True, "metrics": True,
+                     "compile_cache_dir": str(tmp_path)})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+
+    cold, _ = _train(main, main, startup, loss)
+    files = sorted(tmp_path.glob("*.pdtc"))
+    assert files, "cold run stored no executables"
+
+    h0, m0, t0 = _cc_counters(reg)
+    warm, _ = _train(main, main, startup, loss)
+    h1, m1, t1 = _cc_counters(reg)
+    assert warm == cold                      # bitwise: same executable bytes
+    assert h1 - h0 >= 2                      # startup + main both reloaded
+    assert m1 - m0 == 0
+    assert t1 - t0 == 0                      # deserialization never re-traces
+
+    for f in files:
+        f.unlink()
+    h0, m0, _ = _cc_counters(reg)
+    again, _ = _train(main, main, startup, loss)
+    h1, m1, _ = _cc_counters(reg)
+    assert again == cold
+    assert h1 - h0 == 0 and m1 - m0 >= 2     # evicted -> recompiled+stored
+    assert sorted(tmp_path.glob("*.pdtc"))   # ...and stored again
+
+
+def test_compile_cache_corrupted_file_falls_back(_flags_guard, tmp_path):
+    """A truncated/bit-flipped cache file must recompile cleanly (digest
+    check), never crash or load garbage."""
+    flags.set_flags({"donate_state": True, "metrics": True,
+                     "compile_cache_dir": str(tmp_path)})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+    cold, _ = _train(main, main, startup, loss)
+
+    for f in tmp_path.glob("*.pdtc"):
+        blob = bytearray(f.read_bytes())
+        blob[60:64] = b"\xde\xad\xbe\xef"    # inside the payload
+        f.write_bytes(bytes(blob))
+
+    h0, _, _ = _cc_counters(reg)
+    got, _ = _train(main, main, startup, loss)
+    h1, _, _ = _cc_counters(reg)
+    assert got == cold
+    assert h1 - h0 == 0                      # corrupt files never count as hits
+
+
+def test_compile_cache_mismatched_key_misses(_flags_guard, tmp_path):
+    """The key covers fetches and feed signatures: changing either must
+    miss rather than replay the wrong executable."""
+    flags.set_flags({"donate_state": True, "metrics": True,
+                     "compile_cache_dir": str(tmp_path)})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+    _train(main, main, startup, loss, steps=1)
+
+    scope = static.Scope()
+    h0, m0, _ = _cc_counters(reg)
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)                     # hits
+        exe.run(main, feed=_feed(batch=32), fetch_list=[loss],
+                return_numpy=False)          # new feed shape -> miss
+    h1, m1, _ = _cc_counters(reg)
+    assert m1 - m0 == 1
+    assert h1 - h0 == 1
+
+
+def test_build_cache_key_sensitivity():
+    """Unit check on the key: program contents, fetches, donation, and the
+    sharding-plan fingerprint all feed the digest."""
+    main, _, loss = _build_net(seed=7)
+    feeds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in _feed().items()}
+
+    def key(**kw):
+        a = dict(program=main, seed=7, fetch_names=(loss.name,),
+                 feed_arrays=feeds, donated={}, carried={}, donate=False,
+                 plan_fingerprint=None)
+        a.update(kw)
+        return cc.build_cache_key(**a)
+
+    base = key()
+    assert key() == base
+    assert key(fetch_names=()) != base
+    assert key(donate=True) != base
+    assert key(seed=8) != base
+    assert key(plan_fingerprint="mesh(dp=8)x8@cpu:cpu|...") != base
+
+    main2, _, _ = _build_net(seed=7)  # fresh names -> different fingerprint
+    assert (cc.program_fingerprint(main2) != cc.program_fingerprint(main))
+
+
+_CHILD = r"""
+import json, sys
+import numpy as np
+import paddle_tpu.static as static
+from paddle_tpu.core import flags
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import monitor
+
+flags.set_flags({"donate_state": True, "metrics": True,
+                 "compile_cache_dir": sys.argv[1]})
+main, startup = static.Program(), static.Program()
+main.random_seed = 7
+startup.random_seed = 7
+with static.program_guard(main, startup):
+    x = L.data("x", [8])
+    y = L.data("y", [1])
+    pred = L.fc(L.fc(x, 16, act="relu"), 1)
+    loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+    static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+scope = static.Scope()
+with static.scope_guard(scope):
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(3)
+    feed = {"x": rng.normal(size=(16, 8)).astype(np.float32),
+            "y": rng.normal(size=(16, 1)).astype(np.float32)}
+    losses = [float(np.asarray(
+        exe.run(main, feed=feed, fetch_list=[loss])[0])) for _ in range(4)]
+reg = monitor.default_registry()
+def val(n):
+    m = reg.get(n)
+    return m.value() if m is not None else 0
+print(json.dumps({"losses": losses,
+                  "cc_hit": val("executor.compile_cache_hit"),
+                  "cc_miss": val("executor.compile_cache_miss"),
+                  "traces": val("executor.traces")}))
+"""
+
+
+def test_compile_cache_cross_process_warm_start(tmp_path):
+    """The real contract: a SECOND PROCESS with a warm compile_cache_dir
+    deserializes every executable — compile_cache_hit > 0 and zero Python
+    traces — and reproduces the first process's losses bit-for-bit."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(repo) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+
+    def run_once():
+        proc = subprocess.run(
+            [sys.executable, str(script), str(cache)], cwd=repo,
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run_once()
+    assert cold["cc_miss"] >= 2 and cold["cc_hit"] == 0
+    assert cold["traces"] >= 2
+
+    warm = run_once()
+    assert warm["losses"] == cold["losses"]   # bitwise across processes
+    assert warm["cc_hit"] >= 2 and warm["cc_miss"] == 0
+    assert warm["traces"] == 0                # tracing/lowering fully skipped
+
+
+@needs_devices
+def test_compile_cache_with_sharding_plan(_flags_guard, tmp_path):
+    """Sharded executables cache too: the plan fingerprint is in the key,
+    so a warm reload under the same mesh hits and stays parity-exact."""
+    flags.set_flags({"donate_state": True, "metrics": True,
+                     "compile_cache_dir": str(tmp_path)})
+    reg = monitor.default_registry()
+    mesh = _mesh(8)
+    main, startup, loss = _build_net(seed=7)
+    compiled = static.CompiledProgram(main).with_sharding(mesh=mesh)
+
+    cold, _ = _train(compiled, main, startup, loss)
+    assert sorted(tmp_path.glob("*.pdtc"))
+    h0, m0, t0 = _cc_counters(reg)
+    warm, _ = _train(compiled, main, startup, loss)
+    h1, m1, t1 = _cc_counters(reg)
+    assert warm == cold
+    assert h1 - h0 >= 1 and t1 - t0 == 0
