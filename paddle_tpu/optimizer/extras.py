@@ -117,24 +117,35 @@ class DGCMomentum(Optimizer):
 
     def param_update(self, g, p, s, lr, step):
         lr = lr.astype(p.dtype)
-        sparse, dgc_v, err = dgc_compress(g, s["dgc_velocity"], s["error"],
-                                          self.sparsity, self.momentum)
-        use_dgc = step >= self.rampup_begin_step
-        # DGC folds momentum into its own velocity (momentum correction), so
-        # the sparse tensor IS the update — applying the outer momentum on
-        # top would compound it and diverge.
-        p_dgc = p - lr * sparse
-        v_plain = self.momentum * s["velocity"] + g
-        if self.use_nesterov:
-            p_plain = p - lr * (g + self.momentum * v_plain)
+
+        def _dgc(operand):
+            g_, p_ = operand
+            sparse, dgc_v, err = dgc_compress(
+                g_, s["dgc_velocity"], s["error"], self.sparsity,
+                self.momentum)
+            # DGC folds momentum into its own velocity (momentum
+            # correction), so the sparse tensor IS the update — applying the
+            # outer momentum on top would compound it and diverge.
+            return p_ - lr * sparse, s["velocity"], dgc_v, err
+
+        def _plain(operand):
+            g_, p_ = operand
+            v_plain = self.momentum * s["velocity"] + g_
+            if self.use_nesterov:
+                p_new = p_ - lr * (g_ + self.momentum * v_plain)
+            else:
+                p_new = p_ - lr * v_plain
+            return p_new, v_plain, s["dgc_velocity"], s["error"]
+
+        if self.rampup_begin_step <= 0:
+            # compression active from step 0 forever: compile only the
+            # compressed path (no dead warmup FLOPs)
+            p_new, v, dgc_v, err = _dgc((g, p))
         else:
-            p_plain = p - lr * v_plain
-        p_new = jnp.where(use_dgc, p_dgc, p_plain)
-        return p_new, {
-            "velocity": jnp.where(use_dgc, s["velocity"], v_plain),
-            "dgc_velocity": jnp.where(use_dgc, dgc_v, s["dgc_velocity"]),
-            "error": jnp.where(use_dgc, err, s["error"]),
-        }
+            # one branch per step instead of compute-both-and-select
+            p_new, v, dgc_v, err = jax.lax.cond(
+                step >= self.rampup_begin_step, _dgc, _plain, (g, p))
+        return p_new, {"velocity": v, "dgc_velocity": dgc_v, "error": err}
 
 
 class ExponentialMovingAverage:

@@ -16,7 +16,18 @@ Axes (any subset, any sizes):
   sp — sequence/context parallel (ring attention over sequence shards)
   ep — expert parallel (MoE expert sharding)
 """
-from . import collective, mesh, metrics, sharding
+from . import collective, compress, mesh, metrics, sharding
+from .compress import (
+    CommOptions,
+    bucket_signature,
+    bucketed_all_reduce,
+    comm_scope,
+    optimized_all_reduce,
+    quantize_blockwise,
+    dequantize_blockwise,
+    sync_gradients,
+    wire_bytes,
+)
 from .data_parallel import (
     DataParallel,
     apply_collective_grads,
@@ -31,6 +42,7 @@ from .mesh import (
     TP_AXIS,
     MeshConfig,
     current_mesh,
+    dp_hierarchy,
     get_mesh,
     init_parallel_env,
     mesh_axis_size,

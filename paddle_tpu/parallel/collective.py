@@ -234,15 +234,47 @@ def _resolve_size(m, axes) -> int:
 
 # -- core collectives --------------------------------------------------------
 
-def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True):
+def _resolve_compress(compress):
+    """Normalize the compress= argument, consulting the ambient comm scope
+    (compress.comm_scope — set by ShardingPlan/DistributedStrategy) when the
+    caller passed None.  Returns a payload kind or None; "none" explicitly
+    forces full precision inside a quantizing scope."""
+    from . import compress as _compress
+    if compress is None:
+        opts = _compress.current_comm()
+        return opts.payload() if opts is not None else None
+    if compress in ("", "none", False):
+        return None
+    if compress not in _compress.COMPRESS_KINDS:
+        raise ValueError(
+            f"compress={compress!r}; expected one of "
+            f"{_compress.COMPRESS_KINDS} or 'none'")
+    return compress
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True,
+               compress=None, block_size: int = 256, hierarchy="auto"):
     """ref: distributed/collective.py:116; c_allreduce_op.h:38.
 
     Traced: psum/pmax/pmin over the group axis.  Eager: global-view
-    reduction across the leading-dim shards."""
+    reduction across the leading-dim shards.
+
+    compress="int8"/"fp8" rides the wire as an EQuARX-style block-quantized
+    payload (parallel/compress.py; SUM and AVG only, single-axis groups);
+    None inherits the ambient comm_scope, "none" forces full precision."""
     g = _resolve(group)
     opname = op.lower() if isinstance(op, str) else op
+    kind = _resolve_compress(compress) \
+        if opname in (ReduceOp.SUM, ReduceOp.AVG) else None
+    if kind is not None and len(g.axes) > 1:
+        kind = None  # multi-axis global ring: no single hierarchy, stay exact
 
     def _reduce_local(x, ax):
+        if kind is not None:
+            from . import compress as _compress
+            return _compress.optimized_all_reduce(
+                x, ax, compress=kind, block_size=block_size,
+                hierarchy=hierarchy, mean=opname == ReduceOp.AVG)
         if opname == ReduceOp.SUM:
             return lax.psum(x, ax)
         if opname == ReduceOp.MAX:
@@ -266,7 +298,43 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True):
     # global-array equivalent of "every rank ends with the reduction" is just
     # the reduction itself, computed with one jitted psum over shards when the
     # array is sharded, else a no-op sum of one.
-    return _eager_collective(lambda x: _reduce_local(x, ax), tensor, axes)
+    return _instrumented_eager(
+        lambda x: _reduce_local(x, ax), tensor, axes, ax, opname, kind,
+        block_size, _resolve_size(m, axes))
+
+
+def _instrumented_eager(fn, tensor, axes, ax, opname, kind, block_size, n):
+    """Eager allreduce wrapped in a tracecat span + monitor histograms
+    (comm.allreduce_bytes{axis,dtype}, comm.allreduce_ms{axis},
+    comm.compress_ratio) so imperative sync shows up as comm, not compute.
+    The device sync inside the timer only happens while metrics are on."""
+    from . import compress as _compress
+    from ..utils import monitor as _monitor
+    from ..utils import trace as _trace
+
+    nelem = int(jnp.size(tensor))
+    wire = _compress.wire_bytes(nelem, kind, block_size, n)
+    axis_label = "+".join(axes)
+    with _trace.span("comm::allreduce", axis=axis_label, op=opname,
+                     bytes=wire, compress=kind or "none"):
+        timer = _monitor.histogram(
+            "comm.allreduce_ms", "eager allreduce wall time",
+            labelnames=("axis",), buckets=_monitor.TIME_MS_BUCKETS)
+        with timer.time(axis=axis_label):
+            out = _eager_collective(fn, tensor, axes)
+            if _monitor.enabled():
+                out = jax.block_until_ready(out)
+    _monitor.histogram(
+        "comm.allreduce_bytes", "wire bytes per allreduce",
+        labelnames=("axis", "dtype"),
+        buckets=(1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 26, 1 << 30),
+    ).observe(wire, axis=axis_label, dtype=kind or str(jnp.asarray(tensor).dtype))
+    raw = _compress.wire_bytes(nelem, None, block_size, n)
+    if raw:
+        _monitor.gauge(
+            "comm.compress_ratio", "wire bytes relative to fp32 allreduce",
+        ).set(wire / raw)
+    return out
 
 
 def all_gather(tensor_or_list, tensor=None, group=None, axis: int = 0):

@@ -4,13 +4,16 @@ Reference parity: fluid/dygraph/parallel.py:236 `DataParallel` — wraps a
 Layer; after backward, `apply_collective_grads` coalesces gradient buckets
 and allreduces them over NCCL (imperative/all_reduce.cc).
 
-TPU-native design: under pjit/shard_map, gradient averaging is just `pmean`
-over the data mesh axis and XLA fuses/schedules the collectives — the
-reference's hand-managed bucket coalescing (_coalesce_tensors) exists to
-amortize NCCL launch overhead, which has no ICI analogue, so the wrapper is
-thin: it scales the loss (1/n like the reference's scale_loss), exposes
-`apply_collective_grads` as a pmean over the live data axis, and is an
-identity in single-process eager mode so the same script runs anywhere.
+TPU-native design: under pjit/shard_map, gradient averaging is `pmean`
+over the data mesh axis.  The reference's hand-managed bucket coalescing
+(_coalesce_tensors) is rebuilt on parallel/compress.py: `comm_buffer_size`
+MB flat fp32 buckets issued in reverse-topological order (overlapping the
+remaining backward via lax.optimization_barrier chaining) with an optional
+block-quantized wire payload — the same bucketer fleet's
+`DistributedStrategy.comm_quantize` uses, so dygraph and fleet sync agree
+bit-for-bit.  The wrapper scales the loss (1/n like the reference's
+scale_loss) and is an identity in single-process eager mode so the same
+script runs anywhere.
 """
 from __future__ import annotations
 
@@ -22,6 +25,7 @@ import jax.numpy as jnp
 from ..distributed import env as _env
 from ..nn.layer.base import Layer
 from . import collective as _coll
+from . import compress as _compress
 
 __all__ = ["DataParallel", "scale_loss", "apply_collective_grads",
            "shard_batch"]
@@ -61,25 +65,39 @@ def shard_batch(batch, mesh=None, batch_axes=None, seq_axis=None):
     return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
 
 
-def apply_collective_grads(grads: Any, axis: Optional[str] = None):
+def apply_collective_grads(grads: Any, axis: Optional[str] = None,
+                           comm_buffer_size: Optional[float] = None,
+                           compress: Optional[str] = None,
+                           hierarchy: Any = "auto"):
     """Average a gradient pytree across data-parallel workers
     (ref DataParallel.apply_collective_grads).
 
     Inside shard_map the right collective depends on how the grad was made:
     differentiating w.r.t. REPLICATED params auto-inserts a psum in the
-    backward pass (jax's varying-manual-axes rule), so those grads arrive
-    already summed and only need dividing by the axis size; grads that still
-    vary over the axis (e.g. ZeRO-sharded params) need a true pmean.  The
-    value's vma set distinguishes the two exactly.  Outside any mesh
-    context: identity (single process).
+    backward pass (jax's varying-manual-axes rule, where available), so
+    those grads arrive already summed and only need dividing by the axis
+    size; grads that still vary over the axis (e.g. ZeRO-sharded params)
+    need a true pmean.  Outside any mesh context: identity (single
+    process).
+
+    With `comm_buffer_size` (MB) the varying leaves ride the shared bucketer
+    (parallel/compress.py: coalesced ~buffer-sized fp32 buckets issued in
+    reverse-topological order, overlapping the backward pass) — the same
+    sync fleet's `comm_quantize` uses — optionally with a quantized wire
+    payload (`compress="int8"/"fp8"`).
     """
     ax = _live_axis(axis)
     if ax is None:
         return grads
 
+    if comm_buffer_size is not None or compress is not None:
+        return _compress.sync_gradients(
+            grads, ax, compress=compress,
+            buffer_mb=25.0 if comm_buffer_size is None else comm_buffer_size,
+            hierarchy=hierarchy)
+
     def avg(g):
-        varying = ax in jax.typeof(g).vma
-        if varying:
+        if _compress._leaf_varying(g, ax):
             return jax.lax.pmean(g, ax)
         return g / jax.lax.psum(1, ax)
 
@@ -102,12 +120,19 @@ class DataParallel(Layer):
     """
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
-                 last_comm_buffer_size: int = 1, data_axis: Optional[str] = None):
+                 last_comm_buffer_size: int = 1, data_axis: Optional[str] = None,
+                 comm_quantize: Optional[str] = None):
         super().__init__()
-        # comm_buffer sizes are accepted for API parity; bucketing is an
-        # NCCL-launch-overhead workaround with no ICI equivalent
+        if comm_buffer_size is None or float(comm_buffer_size) <= 0:
+            raise ValueError(
+                f"comm_buffer_size must be > 0 MB, got {comm_buffer_size!r}")
         self._layers = layers
         self.data_axis = data_axis
+        self.comm_buffer_size = float(comm_buffer_size)
+        # last_comm_buffer_size is parity-only: the reference uses a smaller
+        # trailing bucket to flush stragglers; the greedy bucketer's natural
+        # remainder bucket plays that role here
+        self.comm_quantize = comm_quantize
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -116,7 +141,9 @@ class DataParallel(Layer):
         return scale_loss(loss, self.data_axis)
 
     def apply_collective_grads(self, grads):
-        return apply_collective_grads(grads, self.data_axis)
+        return apply_collective_grads(
+            grads, self.data_axis, comm_buffer_size=self.comm_buffer_size,
+            compress=self.comm_quantize)
 
     # delegate the Layer surface to the wrapped model (ref behavior)
     def state_dict(self, *a, **k):

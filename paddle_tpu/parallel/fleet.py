@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from . import mesh as _mesh
 from . import collective as _coll
+from . import compress as _compress
 from ..distributed import env as _env
 
 
@@ -97,6 +98,18 @@ class DGCConfig:  # proto :47 DGCConfig
     momentum: float = 0.9
 
 
+@dataclasses.dataclass
+class CommConfig:
+    """Gradient-sync communication knobs (parallel/compress.py): bucket
+    coalescing size (the reducer.cc `comm_buffer_size` analogue), quantized
+    payload block size, and hierarchical (intra-host/inter-host) scheduling
+    ("auto" factors by jax.local_device_count; "off" forces flat; an int is
+    the intra-group size)."""
+    block_size: int = 256
+    buffer_size_mb: float = 25.0
+    hierarchical: Any = "auto"
+
+
 class DistributedStrategy:
     """Typed strategy object (ref proto distributed_strategy.proto:94)."""
 
@@ -119,6 +132,12 @@ class DistributedStrategy:
         self.pipeline_configs = PipelineConfig()
         self.hybrid_configs = HybridConfig()
         self.sequence_parallel = False
+        # Gradient-sync ownership: "" leaves sync to the train-step builder
+        # (legacy psum/pmean); "none" makes update() own a bucketed
+        # full-precision sync; "int8"/"fp8" additionally quantize the wire
+        # payload (EQuARX-style, parallel/compress.py).
+        self.comm_quantize = ""
+        self.comm_configs = CommConfig()
         self.find_unused_parameters = False  # parity no-op
         self.fuse_all_reduce_ops = True      # parity no-op (XLA fuses)
         self.nccl_comm_num = 1               # parity no-op (ICI)
@@ -220,6 +239,12 @@ class DistributedOptimizer:
     def __init__(self, inner, strategy: DistributedStrategy):
         from ..optimizer.optimizers import SGD, Lamb, LarsMomentum
         self.strategy = strategy
+        cq = getattr(strategy, "comm_quantize", "")
+        if cq not in ("", "none") and cq not in _compress.COMPRESS_KINDS:
+            raise ValueError(
+                f"DistributedStrategy.comm_quantize={cq!r}; expected '' "
+                f"(builder-owned sync), 'none', or one of "
+                f"{_compress.COMPRESS_KINDS}")
         # Pass the raw _lr through so an LRScheduler keeps scheduling (get_lr()
         # would freeze it at its current scalar value).
         if strategy.lamb and not isinstance(inner, Lamb):
@@ -274,6 +299,24 @@ class DistributedOptimizer:
         new_state = dict(state)
         cfg = self.strategy
 
+        if getattr(cfg, "comm_quantize", "") and not cfg.dgc:
+            # Owned gradient sync (comm_quantize set): bucketed, optionally
+            # quantized mean-allreduce over the bound dp axis, issued on the
+            # still-scaled grads — blockwise quantization is loss-scale
+            # invariant, and a non-finite grad on ANY replica propagates
+            # through the mean so every replica takes the same skip-step
+            # branch below.  Under GSPMD/eager the axis is unbound and sync
+            # falls back to the builder (identity here).
+            axis = _coll.bound_data_axis()
+            if axis is not None:
+                cc = cfg.comm_configs
+                grads = _compress.sync_gradients(
+                    grads, axis,
+                    compress=None if cfg.comm_quantize == "none"
+                    else cfg.comm_quantize,
+                    block_size=cc.block_size, buffer_mb=cc.buffer_size_mb,
+                    hierarchy=cc.hierarchical)
+
         finite = None
         if "loss_scale" in state:
             scale = state["loss_scale"]
@@ -301,19 +344,31 @@ class DistributedOptimizer:
             dc = cfg.dgc_configs
             step = state["inner"].get("step", jnp.zeros((), jnp.int32)) \
                 if isinstance(state["inner"], dict) else jnp.zeros((), jnp.int32)
-            use_dgc = step >= dc.rampup_begin_step
+            rampup = int(dc.rampup_begin_step)
 
             mom = getattr(self, "_dgc_momentum", dc.momentum)
 
-            def one(g, v, e):
-                g32 = g.astype(jnp.float32)
-                s_, v_, e_ = dgc_compress(g32, v, e, dc.sparsity, mom)
+            def compressed(g32, v, e):
+                return dgc_compress(g32, v, e, dc.sparsity, mom)
+
+            if rampup <= 0:
+                # compression is active from step 0 forever: compile only
+                # the compressed branch (no dead v_warm top-k-side FLOPs)
+                def one(g, v, e):
+                    return compressed(g.astype(jnp.float32), v, e)
+            else:
                 # pre-rampup: plain momentum-SGD warmup using the same
-                # velocity slot (ref DGCMomentumOptimizer warmup dynamics)
-                v_warm = mom * v + g32
-                return (jnp.where(use_dgc, s_, v_warm),
-                        jnp.where(use_dgc, v_, v_warm),
-                        jnp.where(use_dgc, e_, e))
+                # velocity slot (ref DGCMomentumOptimizer warmup dynamics);
+                # lax.cond executes exactly one branch per step instead of
+                # computing both and selecting
+                def one(g, v, e):
+                    def warm(args):
+                        g32, v_, e_ = args
+                        v_warm = mom * v_ + g32
+                        return v_warm, v_warm, e_
+                    return jax.lax.cond(
+                        step >= rampup, lambda args: compressed(*args), warm,
+                        (g.astype(jnp.float32), v, e))
 
             flat_g, treedef = jax.tree_util.tree_flatten(grads)
             flat_v = treedef.flatten_up_to(state["dgc"]["velocity"])
@@ -322,7 +377,15 @@ class DistributedOptimizer:
             sparse = [o[0] for o in outs]
             axis = _coll.bound_data_axis()
             if axis is not None:
-                sparse = [jax.lax.pmean(s, axis) for s in sparse]
+                cq = getattr(cfg, "comm_quantize", "")
+                if cq in _compress.COMPRESS_KINDS:
+                    cc = cfg.comm_configs
+                    sparse = [_compress.optimized_all_reduce(
+                        s, axis, compress=cq, block_size=cc.block_size,
+                        hierarchy=cc.hierarchical, mean=True)
+                        for s in sparse]
+                else:
+                    sparse = [jax.lax.pmean(s, axis) for s in sparse]
             grads = jax.tree_util.tree_unflatten(treedef, sparse)
             new_state["dgc"] = {
                 "velocity": jax.tree_util.tree_unflatten(

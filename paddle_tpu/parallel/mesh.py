@@ -103,6 +103,25 @@ def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
     return mesh.shape[axis] if axis in mesh.axis_names else 1
 
 
+def dp_hierarchy(axis_size: int,
+                 local: Optional[int] = None) -> Optional[Tuple[int, int]]:
+    """Factor a data-parallel axis of `axis_size` members into
+    (intra-host, inter-host) group sizes, or None when the axis does not
+    span hosts (everything local, or one device per host, or the host size
+    does not divide the axis).
+
+    The intra size comes from jax.local_device_count(): devices on one host
+    share the fast ICI links, so collectives should reduce-scatter there
+    before touching DCN (the InitHierarchicalCtxs two-ring split,
+    parallel_executor.cc:209, rebuilt on mesh axis_index_groups)."""
+    if local is None:
+        local = jax.local_device_count()
+    local = int(local)
+    if local <= 1 or local >= axis_size or axis_size % local:
+        return None
+    return local, axis_size // local
+
+
 def mesh_fingerprint(mesh: Optional[Mesh] = None) -> str:
     """Stable content fingerprint of a mesh's *shape*: axis names/sizes plus
     the device platform and kind.  Two processes over equivalent topologies

@@ -203,7 +203,11 @@ class ShardingPlan:
                  batch_axes: Sequence[str] = (_mesh.DP_AXIS,),
                  seq_axis: Optional[str] = None,
                  donate: bool = True,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 comm_quantize: str = "",
+                 comm_block_size: int = 256,
+                 comm_buffer_mb: float = 25.0,
+                 comm_hierarchy: Any = "auto"):
         if mesh is not None and devices is not None:
             raise ValueError("pass either mesh or devices, not both")
         self._mesh = mesh
@@ -214,10 +218,26 @@ class ShardingPlan:
         self.batch_axes = tuple(batch_axes)
         self.seq_axis = seq_axis
         self.donate = bool(donate)
+        # gradient-communication options: made ambient (compress.comm_scope)
+        # while the Executor traces the step, so axis-bound collectives —
+        # collective.all_reduce / the static c_allreduce_* lowerings — pick
+        # up quantization/hierarchy without program surgery
+        self.comm = None
+        if comm_quantize:
+            from . import compress as _compress
+            self.comm = _compress.CommOptions(
+                quantize=comm_quantize, block_size=int(comm_block_size),
+                buffer_mb=float(comm_buffer_mb), hierarchy=comm_hierarchy)
         # monotonic identity token: the in-memory hot-cache key component
         # (cheap int compare per step; content fingerprint() is the slow
         # cross-process identity and only runs at compile time)
         self.token = next(_plan_tokens)
+
+    def comm_scope(self):
+        """Context manager making this plan's comm options ambient during
+        tracing (no-op context when the plan carries none)."""
+        from . import compress as _compress
+        return _compress.comm_scope(self.comm)
 
     def resolve_mesh(self) -> Mesh:
         """The mesh this plan places onto (resolved once, then pinned so the
@@ -301,9 +321,11 @@ class ShardingPlan:
         if self.annotations:
             ann = ";".join(f"{k}->{v}"
                            for k, v in sorted(self.annotations.items()))
+        comm = self.comm.signature() if self.comm is not None else "-"
         return (f"{_mesh.mesh_fingerprint(mesh)}|batch={self.batch_axes}"
                 f"|seq={self.seq_axis}|zero={self.zero_stage}"
-                f"|donate={int(self.donate)}|rules={rules}|ann={ann}")
+                f"|donate={int(self.donate)}|rules={rules}|ann={ann}"
+                f"|comm={comm}")
 
 
 # Default rule table for transformer-family models (ERNIE/BERT/GPT blocks):

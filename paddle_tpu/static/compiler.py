@@ -89,7 +89,10 @@ class CompiledProgram:
 
     def with_sharding(self, mesh=None, rules=None, annotations=None,
                       zero_stage: int = 0, batch_axes=None, seq_axis=None,
-                      donate: bool = True) -> "CompiledProgram":
+                      donate: bool = True, comm_quantize: str = "",
+                      comm_block_size: int = 256,
+                      comm_buffer_mb: float = 25.0,
+                      comm_hierarchy="auto") -> "CompiledProgram":
         """Run this program's compiled step under NamedShardings on a mesh —
         the full hybrid-parallel face of the Executor fast path.
 
@@ -103,7 +106,14 @@ class CompiledProgram:
         ``rules``/``annotations``/``zero_stage`` follow
         `parallel.sharding.infer_sharding` precedence for state placement;
         ``batch_axes``/``seq_axis`` shard the feeds (defaults: batch over
-        ``dp``)."""
+        ``dp``).
+
+        ``comm_quantize``/``comm_block_size``/``comm_buffer_mb``/
+        ``comm_hierarchy`` make gradient-communication options ambient while
+        the step is traced (parallel/compress.py `comm_scope`): axis-bound
+        collectives inside the program pick up quantized payloads and
+        hierarchical scheduling, and the options key the persistent compile
+        cache through the plan fingerprint."""
         from ..parallel import mesh as _pmesh
         from ..parallel.sharding import ShardingPlan
 
@@ -111,7 +121,9 @@ class CompiledProgram:
             mesh=mesh, rules=rules, annotations=annotations,
             zero_stage=zero_stage,
             batch_axes=tuple(batch_axes) if batch_axes else (_pmesh.DP_AXIS,),
-            seq_axis=seq_axis, donate=donate)
+            seq_axis=seq_axis, donate=donate, comm_quantize=comm_quantize,
+            comm_block_size=comm_block_size, comm_buffer_mb=comm_buffer_mb,
+            comm_hierarchy=comm_hierarchy)
         return self
 
     def _sharding_plan(self):

@@ -24,6 +24,7 @@ bit-identical to the primal's, so AD is exact.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -786,7 +787,13 @@ class Executor:
             env.update({k: jnp.asarray(v) for k, v in donated.items()})
             env.update({k: jnp.asarray(v) for k, v in feeds.items()})
             base_key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            _trace_block(program, env, base_key)
+            # plan comm options (quantized/hierarchical gradient sync) are
+            # ambient only while the body traces: axis-bound collective
+            # lowerings consult parallel.compress.current_comm()
+            comm_ctx = plan.comm_scope() if plan is not None \
+                else contextlib.nullcontext()
+            with comm_ctx:
+                _trace_block(program, env, base_key)
             fetches = [env[n] for n in fetch_names]
             new_state = {}
             for n in state_names:

@@ -59,16 +59,33 @@ def _data_axis():
     return _coll.bound_data_axis()
 
 
-def _c_allreduce(reduce_fn):
+def _c_allreduce(reduce_fn, summing=False):
     def rule(ins, attrs, op):
         x = _one(ins, "X")
         axis = _data_axis()
-        return {"Out": [x if axis is None else reduce_fn(x, axis)]}
+        if axis is None:
+            return {"Out": [x]}
+        if summing:
+            # sum allreduce honors ambient comm options (ShardingPlan /
+            # comm_scope: quantized payload, hierarchical schedule) or an
+            # explicit `compress` op attr; other reductions stay exact
+            from ..parallel import compress as _compress
+
+            kind = attrs.get("compress") or None
+            opts = _compress.current_comm()
+            if kind is None and opts is not None:
+                kind = opts.payload()
+            if kind:
+                return {"Out": [_compress.optimized_all_reduce(
+                    x, axis, compress=kind,
+                    block_size=opts.block_size if opts else 256,
+                    hierarchy=opts.hierarchy if opts else "auto")]}
+        return {"Out": [reduce_fn(x, axis)]}
 
     return rule
 
 
-register_op("c_allreduce_sum")(_c_allreduce(jax.lax.psum))
+register_op("c_allreduce_sum")(_c_allreduce(jax.lax.psum, summing=True))
 register_op("c_allreduce_max")(_c_allreduce(jax.lax.pmax))
 register_op("c_allreduce_min")(_c_allreduce(jax.lax.pmin))
 register_op("c_allreduce_prod")(_c_allreduce(

@@ -173,6 +173,27 @@ def test_zero_sharding_constrains_opt_state():
         [str(x.sharding.spec) for x in leaves[:5]]
 
 
+def test_dgc_off_adds_zero_flops():
+    """With dgc disabled the fleet wrapper must compile to EXACTLY the inner
+    optimizer's update — no dead warmup/compression FLOPs riding along
+    (regression: the pre-rampup momentum branch used to be computed even
+    when compression was statically off)."""
+    p = {"w": jnp.zeros((128, 64), jnp.float32)}
+    g = {"w": jnp.ones((128, 64), jnp.float32)}
+
+    def flops(opt, state):
+        c = jax.jit(lambda g_, s_, p_: opt.update(g_, s_, p_)) \
+            .lower(g, state, p).compile().cost_analysis()
+        return (c[0] if isinstance(c, list) else c).get("flops", 0.0)
+
+    wrapped = DistributedOptimizer(Momentum(0.05, momentum=0.9),
+                                   DistributedStrategy())
+    bare = Momentum(0.05, momentum=0.9)
+    f_wrapped = flops(wrapped, wrapped.init(p))
+    f_bare = flops(bare, bare.init(p))
+    assert f_wrapped == f_bare, (f_wrapped, f_bare)
+
+
 def test_dgc_rampup_warmup_uses_momentum():
     """Pre-rampup dynamics must match plain momentum SGD (the reference
     DGCMomentumOptimizer warmup), not bare SGD."""
