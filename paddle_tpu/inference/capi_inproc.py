@@ -53,17 +53,27 @@ def create(model_path: str) -> int:
 def run(handle: int, request: bytes) -> bytes:
     """Execute one PDRQ request; returns a PDRS/PDER response — the SAME
     handler the pipe worker uses (capi_worker.handle_request), fed from
-    memory instead of stdin."""
+    memory instead of stdin.  An optional leading ``PDID | u64 id`` frame
+    is accepted for client-code parity with the pipelined pipe worker and
+    echoed back on the response; execution here is synchronous, so the id
+    changes framing only, never ordering."""
+    prefix = b""
     try:
         exe, program, fetches, scope = _predictors[handle]
         buf = io.BytesIO(request)
         magic = buf.read(4)
+        if magic == b"PDID":
+            prefix = b"PDID" + buf.read(8)
+            if len(prefix) != 12:
+                raise ValueError("truncated PDID frame")
+            magic = buf.read(4)
         if magic != b"PDRQ":
             raise ValueError(f"bad request magic {magic!r}")
-        return handle_request(buf, exe, program, fetches, scope=scope)
+        return prefix + handle_request(buf, exe, program, fetches,
+                                       scope=scope)
     except Exception as e:  # noqa: BLE001 — report over the wire
         msg = f"{type(e).__name__}: {e}".encode()
-        return b"PDER" + struct.pack("<i", len(msg)) + msg
+        return prefix + b"PDER" + struct.pack("<i", len(msg)) + msg
 
 
 def destroy(handle: int) -> None:

@@ -13,17 +13,32 @@ across calls, so running a program whose ops include backward+optimizer
 steps IS training.
 
 Wire format (little-endian):
-  request:  b"PDRQ" | i32 n_inputs | n x tensor
+  request:  [b"PDID" | u64 id]  b"PDRQ" | i32 n_inputs | n x tensor
   tensor:   i32 name_len | name | i32 dtype | i32 ndim | i64 dims[] | data
-  response: b"PDRS" | i32 n_outputs | n x tensor   (fetch order)
-  error:    b"PDER" | i32 len | utf-8 message
+  response: [b"PDID" | u64 id]  b"PDRS" | i32 n_outputs | n x tensor
+  error:    [b"PDID" | u64 id]  b"PDER" | i32 len | utf-8 message
   dtype codes: 0=f32 1=i32 2=i64 3=f64 4=u8 5=bool
+
+The ``PDID`` frame is optional and opts a request into PIPELINING: the
+client may send more id'd requests without waiting, the worker coalesces
+them through the serving frontend (``paddle_tpu.serving.Server`` — padded
+shape buckets, one executable per bucket), and id'd responses come back
+PDID-tagged, possibly OUT OF ORDER.  Id'd requests must follow the
+frontend contract: every feed shares its leading batch dim and every fetch
+is row-independent with that batch dim (standard inference graphs; feeds
+that don't fit fall back to a direct Executor run, still id-tagged).
+Id-less requests are byte-identical to the legacy protocol: strict
+request->response ordering on the direct Executor path, and each one acts
+as a drain barrier — it is answered only after every in-flight id'd
+request has completed.
 """
 from __future__ import annotations
 
+import io
 import os
 import struct
 import sys
+import threading
 
 import numpy as np
 
@@ -65,34 +80,105 @@ def _write_tensor(f, name, arr):
     f.write(arr.tobytes())
 
 
+def _parse_feed(request_stream):
+    """The feed dict of one PDRQ body (the magic is already consumed)."""
+    (n_in,) = struct.unpack("<i", _read_exact(request_stream, 4))
+    feed = {}
+    for _ in range(n_in):
+        name, arr = _read_tensor(request_stream)
+        feed[name] = arr
+    return feed
+
+
+def _encode_results(fetches, results) -> bytes:
+    out = io.BytesIO()
+    out.write(b"PDRS" + struct.pack("<i", len(results)))
+    for name, arr in zip(fetches, results):
+        _write_tensor(out, str(name), np.asarray(arr))
+    return out.getvalue()
+
+
+def _encode_error(e: BaseException) -> bytes:
+    msg = f"{type(e).__name__}: {e}".encode()
+    return b"PDER" + struct.pack("<i", len(msg)) + msg
+
+
 def handle_request(request_stream, exe, program, fetches, scope=None):
     """Parse one PDRQ request from ``request_stream`` and return the
     PDRS/PDER response bytes — the single protocol handler both
     transports share (pipe worker below; in-process capi_inproc)."""
     import contextlib
-    import io
 
     import paddle_tpu.static as static
 
-    out = io.BytesIO()
     try:
-        (n_in,) = struct.unpack("<i", _read_exact(request_stream, 4))
-        feed = {}
-        for _ in range(n_in):
-            name, arr = _read_tensor(request_stream)
-            feed[name] = arr
+        feed = _parse_feed(request_stream)
         ctx = (static.scope_guard(scope) if scope is not None
                else contextlib.nullcontext())
         with ctx:
             results = exe.run(program, feed=feed, fetch_list=list(fetches))
-        out.write(b"PDRS" + struct.pack("<i", len(results)))
-        for name, arr in zip(fetches, results):
-            _write_tensor(out, str(name), np.asarray(arr))
+        return _encode_results(fetches, results)
     except Exception as e:  # noqa: BLE001 — report over the wire
-        msg = f"{type(e).__name__}: {e}".encode()
-        return b"PDER" + struct.pack("<i", len(msg)) + msg
-    return out.getvalue()
+        return _encode_error(e)
 
+
+class _Pipeline:
+    """The worker's serving-frontend face: id'd requests submit here and
+    complete (possibly out of order) on the dispatcher thread; ``drain``
+    is the id-less barrier."""
+
+    def __init__(self, program, feed_names, fetches, scope, respond):
+        from ..serving import Server
+
+        edges = os.environ.get("PDTPU_CAPI_BUCKETS", "1,2,4,8,16,32")
+        wait_ms = float(os.environ.get("PDTPU_CAPI_MAX_WAIT_MS", "1.0"))
+        self.server = Server(
+            bucket_edges=tuple(int(e) for e in edges.split(",")),
+            max_wait_ms=wait_ms)
+        self.tenant = self.server.add_tenant(
+            "capi", program, feed_names, list(fetches), scope)
+        self.server.start()
+        self.fetches = list(fetches)
+        self._respond = respond
+        self._pending = {}
+        self._cond = threading.Condition()
+
+    def submit(self, req_id: int, feed) -> bool:
+        """True when accepted for pipelined dispatch; False when the feed
+        doesn't fit the frontend contract (caller runs it directly)."""
+        with self._cond:
+            if req_id in self._pending:
+                self._respond(req_id, _encode_error(ValueError(
+                    f"duplicate in-flight request id {req_id}")))
+                return True
+            try:
+                fut = self.server.submit("capi", feed)
+            except ValueError:
+                return False  # un-bucketable shape — direct path
+            except Exception as e:  # noqa: BLE001 — report over the wire
+                self._respond(req_id, _encode_error(e))
+                return True
+            self._pending[req_id] = fut
+        fut.add_done_callback(lambda f, i=req_id: self._complete(i, f))
+        return True
+
+    def _complete(self, req_id, fut):
+        try:
+            payload = _encode_results(self.fetches, fut.result())
+        except Exception as e:  # noqa: BLE001 — report over the wire
+            payload = _encode_error(e)
+        self._respond(req_id, payload)
+        with self._cond:
+            self._pending.pop(req_id, None)
+            self._cond.notify_all()
+
+    def drain(self):
+        with self._cond:
+            while self._pending:
+                self._cond.wait()
+
+    def close(self):
+        self.server.close()
 
 
 def main():
@@ -114,6 +200,17 @@ def main():
     else:
         program, feeds, fetches = static.load(model_path, exe)
     inp, out = sys.stdin.buffer, sys.stdout.buffer
+
+    wlock = threading.Lock()
+
+    def respond(req_id, payload):
+        with wlock:
+            if req_id is not None:
+                out.write(b"PDID" + struct.pack("<Q", req_id))
+            out.write(payload)
+            out.flush()
+
+    pipeline = None
     out.write(b"PDOK")
     out.flush()
     while True:
@@ -121,10 +218,48 @@ def main():
             magic = inp.read(4)
         except Exception:
             break
+        req_id = None
+        if magic == b"PDID":
+            try:
+                (req_id,) = struct.unpack("<Q", _read_exact(inp, 8))
+                magic = _read_exact(inp, 4)
+            except EOFError:
+                break
         if magic != b"PDRQ":
             break
-        out.write(handle_request(inp, exe, program, fetches))
-        out.flush()
+        if req_id is not None:
+            # pipelined path: coalesce through the serving frontend; the
+            # request body must be consumed here (the stream is serial)
+            # before the next frame can be read
+            try:
+                feed = _parse_feed(inp)
+            except EOFError:
+                break
+            except Exception as e:  # noqa: BLE001 — report over the wire
+                respond(req_id, _encode_error(e))
+                continue
+            if pipeline is None:
+                try:
+                    pipeline = _Pipeline(program, list(feeds), fetches,
+                                         static.global_scope(), respond)
+                except Exception:  # serving unavailable — direct fallback
+                    pipeline = False
+            if pipeline and pipeline.submit(req_id, feed):
+                continue
+            try:
+                results = exe.run(program, feed=feed,
+                                  fetch_list=list(fetches))
+                respond(req_id, _encode_results(fetches, results))
+            except Exception as e:  # noqa: BLE001 — report over the wire
+                respond(req_id, _encode_error(e))
+        else:
+            # legacy path: drain the pipeline (ordering barrier), then the
+            # byte-identical strict request->response protocol
+            if pipeline:
+                pipeline.drain()
+            respond(None, handle_request(inp, exe, program, fetches))
+    if pipeline:
+        pipeline.close()
 
 
 if __name__ == "__main__":

@@ -36,7 +36,7 @@ import jax
 from ..utils import monitor as _monitor
 from ..utils import trace as _trace
 
-__all__ = ["DeviceFeeder", "device_prefetch", "resolve_device"]
+__all__ = ["DeviceFeeder", "device_prefetch", "resolve_device", "stage"]
 
 _m_depth = _monitor.gauge(
     "io.prefetch_depth", "Device-staged batches queued ahead of the consumer "
@@ -170,3 +170,13 @@ class DeviceFeeder:
 def device_prefetch(source: Iterable[Any], device=None, depth: int = 2):
     """Functional form of :class:`DeviceFeeder` (returns an iterator)."""
     return iter(DeviceFeeder(source, device=device, depth=depth))
+
+
+def stage(batch, device=None):
+    """Stage ONE batch on ``device`` (same placement rules as DeviceFeeder:
+    None -> default device, 'tpu:1' strings, Device/Sharding, or a per-leaf
+    dict).  The one-shot face of the feeder for callers whose batches are
+    assembled on demand rather than pulled from an iterable — the serving
+    frontend stages each padded bucket batch this way right before
+    dispatch, so the H2D transfer overlaps the previous bucket's step."""
+    return _device_put(batch, resolve_device(device))

@@ -1,0 +1,31 @@
+"""Production serving subsystem: a continuous-batching, multi-tenant
+inference frontend over the static Executor fast path.
+
+Layers (each its own module, composable):
+
+* :mod:`~paddle_tpu.serving.frontend` — thread-safe ``Server``: concurrent
+  ``submit(feeds) -> Future``, coalesced into padded shape buckets, one
+  AOT executable per (tenant, bucket).
+* :mod:`~paddle_tpu.serving.continuous` — iteration-level batching for
+  autoregressive decode over a fixed device slot pool (join/evict between
+  steps, zero retraces).
+* :mod:`~paddle_tpu.serving.tenancy` — per-tenant program isolation, a
+  bounded LRU of live executables, per-tenant quotas.
+* :mod:`~paddle_tpu.serving.slo` — SLO-aware admission (projected-p99 load
+  shed) and the ``serve.*`` metric family.
+
+Reference parity: this subsystem is the TPU-native answer to
+``paddle/fluid/inference/`` (AnalysisPredictor + PredictorPool) and the
+Paddle Serving frontends — see SURVEY.md §7 and the README "Serving"
+section for the ancestry mapping.
+"""
+from .continuous import ContinuousBatcher, DecodeHandle, make_toy_lm
+from .frontend import DEFAULT_BUCKET_EDGES, Server
+from .slo import AdmissionError, QuotaExceededError, SLOPolicy
+from .tenancy import Tenant, TenantManager
+
+__all__ = [
+    "AdmissionError", "ContinuousBatcher", "DEFAULT_BUCKET_EDGES",
+    "DecodeHandle", "QuotaExceededError", "SLOPolicy", "Server", "Tenant",
+    "TenantManager", "make_toy_lm",
+]

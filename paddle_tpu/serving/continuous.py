@@ -1,0 +1,287 @@
+"""Continuous (iteration-level) batching for autoregressive decode.
+
+Reference parity: the reference inference stack batches at *request*
+granularity — AnalysisPredictor::Run sees one fixed batch from admission
+to completion, so a generation server either pads every sequence to the
+longest request in the batch (wasting compute on finished rows) or runs
+batch-of-one.  TPU-native design: the batching decision moves to the
+*decode iteration*.  A fixed pool of ``num_slots`` sequence slots lives on
+the device (hidden state + KV rows, the donated device-resident state
+machinery from the training fast path); every ``step()`` advances ALL
+occupied slots one token through one compiled step function, and between
+steps sequences join (claim a free slot, zeroed) or retire/evict (rows
+zeroed, slot freed) without touching the executable — the step shape never
+changes, so steady state is ZERO retraces no matter how requests arrive
+(pinned by ``executor.traces`` in tests/test_serving.py).
+
+Prompts are consumed token-by-token (teacher forcing) through the SAME
+step function as generation: a joining sequence needs no separate prefill
+executable and perturbs nothing about the running batch.  Correctness
+contract: the step function must compute each slot row independently
+(batched matmul / elementwise / per-row KV scatter — no cross-row ops), so
+a sequence's tokens are bitwise-identical no matter which slot it lands in
+or what its neighbors are doing; tests pin parity against a fresh
+single-slot decode of every sequence.
+
+The step-function protocol (pure, jit-able)::
+
+    pool', next_tokens = step_fn(pool, tokens, positions, active)
+
+      pool         device pytree, every leaf [num_slots, ...]
+      tokens       int32[num_slots]   token each slot consumes this step
+      positions    int32[num_slots]   0-based position of that token
+      active       bool[num_slots]    occupied slots (inactive rows must
+                                      pass through pool unchanged)
+      next_tokens  int32[num_slots]   each slot's prediction
+
+``make_toy_lm`` builds a deterministic greedy toy LM in this protocol
+(used by tests and ``tools/servebench --continuous``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags as _flags
+from ..utils import monitor as _monitor
+from .slo import AdmissionError, LOAD_SHED, REQUEST_MS, TTFT_MS
+
+__all__ = ["ContinuousBatcher", "DecodeHandle", "make_toy_lm"]
+
+_m_slots = _monitor.gauge(
+    "serve.decode_active_slots", "Occupied sequence slots in the continuous-"
+    "batching decode pool.")
+
+_FREE, _PROMPT, _DECODE = 0, 1, 2
+
+
+class DecodeHandle:
+    """One sequence's view of the batcher: fills ``tokens`` as the decode
+    progresses; ``done`` flips when it retires (finished or evicted)."""
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.done = False
+        self.evicted = False
+        self.slot: Optional[int] = None
+        self._t_submit = time.perf_counter()
+        self._ttft_recorded = False
+
+
+class ContinuousBatcher:
+    """Host-driven iteration-level batcher over a fixed device slot pool.
+
+    The caller (serving loop, servebench, tests) drives it::
+
+        cb = ContinuousBatcher(step_fn, init_state_fn, num_slots=8,
+                               max_len=64)
+        h = cb.join([3, 1, 4], max_new_tokens=16)   # AdmissionError if full
+        while not h.done:
+            cb.step()                                # advances ALL sequences
+        print(h.tokens)
+
+    ``donate=None`` resolves from the ``donate_state`` flag gated by the
+    same async-safety check the Executor fast path uses (CPU keeps the
+    pool un-donated).
+    """
+
+    def __init__(self, step_fn: Callable, init_state_fn: Callable,
+                 num_slots: int, max_len: int, donate: Optional[bool] = None,
+                 tenant: str = "default"):
+        from ..static import executor as _ex
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.tenant = str(tenant)
+        if donate is None:
+            donate = (bool(_flags.get_flag("donate_state"))
+                      and _ex._donation_async_safe())
+
+        def _counted(pool, tokens, positions, active):
+            _ex._m_traces.inc()  # host side effect: fires at trace time only
+            return step_fn(pool, tokens, positions, active)
+
+        self._step_fn = jax.jit(_counted,
+                                donate_argnums=(0,) if donate else ())
+        # zero the freed rows so the next joiner starts from pristine state
+        # (bitwise-equal to a fresh single-slot decode)
+        self._clear_fn = jax.jit(lambda pool, keep: jax.tree_util.tree_map(
+            lambda x: jnp.where(
+                keep.reshape((-1,) + (1,) * (x.ndim - 1)), x,
+                jnp.zeros((), x.dtype)), pool))
+        self._pool = init_state_fn(self.num_slots)
+        self._handles: List[Optional[DecodeHandle]] = [None] * self.num_slots
+        # per-slot FSM: _FREE | _PROMPT (teacher-forcing) | _DECODE
+        self._state = [_FREE] * self.num_slots
+        self._cursor = [0] * self.num_slots  # prompt index / last token
+
+    # -- admission -----------------------------------------------------------
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self._state if s != _FREE)
+
+    def try_join(self, prompt: Sequence[int],
+                 max_new_tokens: int) -> Optional[DecodeHandle]:
+        """Claim a free slot for ``prompt``; None when the pool is full."""
+        h = DecodeHandle(prompt, max_new_tokens)
+        if not h.prompt:
+            raise ValueError("empty prompt")
+        if len(h.prompt) + h.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(h.prompt)}) + max_new_tokens "
+                f"({h.max_new_tokens}) exceeds the pool's max_len "
+                f"({self.max_len})")
+        for slot in range(self.num_slots):
+            if self._state[slot] == _FREE:
+                self._state[slot] = _PROMPT
+                self._cursor[slot] = 0
+                self._handles[slot] = h
+                h.slot = slot
+                _m_slots.set(self.active_count)
+                return h
+        return None
+
+    def join(self, prompt: Sequence[int],
+             max_new_tokens: int) -> DecodeHandle:
+        h = self.try_join(prompt, max_new_tokens)
+        if h is None:
+            LOAD_SHED.inc(reason="slots")
+            raise AdmissionError(
+                f"continuous-batching pool full: {self.num_slots} slots "
+                "all decoding; back off and retry")
+        return h
+
+    def evict(self, handle: DecodeHandle) -> None:
+        """Retire a sequence mid-decode: its slot rows are zeroed and freed
+        at the next step boundary; ``handle.tokens`` keeps what was
+        generated so far."""
+        if handle.done or handle.slot is None:
+            return
+        slot = handle.slot
+        if self._handles[slot] is not handle:
+            return
+        handle.evicted = True
+        self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        h = self._handles[slot]
+        self._state[slot] = _FREE
+        self._handles[slot] = None
+        if h is not None:
+            h.done = True
+            h.slot = None
+            REQUEST_MS.observe((time.perf_counter() - h._t_submit) * 1e3,
+                               tenant=self.tenant, bucket="decode")
+        self._pool = self._clear_fn(
+            self._pool,
+            jnp.asarray(np.array([s != _FREE for s in self._state],
+                                 dtype=bool)))
+        _m_slots.set(self.active_count)
+
+    # -- the lockstep iteration ----------------------------------------------
+    def step(self) -> int:
+        """Advance every occupied slot one token; returns how many slots
+        were active.  Joins/evictions take effect between calls."""
+        tokens = np.zeros(self.num_slots, np.int32)
+        positions = np.zeros(self.num_slots, np.int32)
+        active = np.zeros(self.num_slots, bool)
+        for slot in range(self.num_slots):
+            st, h = self._state[slot], self._handles[slot]
+            if st == _PROMPT:
+                i = self._cursor[slot]
+                tokens[slot] = h.prompt[i]
+                positions[slot] = i
+                active[slot] = True
+            elif st == _DECODE:
+                tokens[slot] = h.tokens[-1]
+                positions[slot] = len(h.prompt) + len(h.tokens) - 1
+                active[slot] = True
+        n_active = int(active.sum())
+        if n_active == 0:
+            return 0
+        self._pool, nxt = self._step_fn(self._pool, tokens, positions, active)
+        nxt = np.asarray(nxt)
+        for slot in range(self.num_slots):
+            if not active[slot]:
+                continue
+            h = self._handles[slot]
+            if self._state[slot] == _PROMPT:
+                i = self._cursor[slot]
+                if i + 1 < len(h.prompt):
+                    self._cursor[slot] = i + 1  # next prompt token; the
+                    continue                    # prediction is teacher-forced
+                self._state[slot] = _DECODE     # last prompt token consumed:
+                # fall through — nxt IS the first generated token
+            h.tokens.append(int(nxt[slot]))
+            if not h._ttft_recorded:
+                h._ttft_recorded = True
+                TTFT_MS.observe((time.perf_counter() - h._t_submit) * 1e3)
+            if len(h.tokens) >= h.max_new_tokens:
+                self._retire(slot)
+        return n_active
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            if self.step() == 0:
+                return
+        raise RuntimeError(f"decode did not drain in {max_steps} steps")
+
+    def decode(self, prompts: Sequence[Sequence[int]],
+               max_new_tokens: int) -> List[List[int]]:
+        """Convenience: decode every prompt, joining as slots free up,
+        and return the generated tokens in prompt order."""
+        handles: List[Optional[DecodeHandle]] = [None] * len(prompts)
+        pending = list(range(len(prompts)))
+        while pending or self.active_count:
+            while pending:
+                h = self.try_join(prompts[pending[0]], max_new_tokens)
+                if h is None:
+                    break
+                handles[pending.pop(0)] = h
+            self.step()
+        return [h.tokens for h in handles]
+
+
+def make_toy_lm(vocab: int = 64, hidden: int = 16, max_len: int = 32,
+                seed: int = 0):
+    """A deterministic greedy toy LM in the step-function protocol:
+    embedding -> tanh recurrence over the hidden row -> mean over the
+    slot's KV rows up to the current position -> logits -> argmax.  Every
+    op is row-independent, so slot placement and neighbors cannot change a
+    sequence's tokens (the parity contract).  Returns
+    ``(step_fn, init_state_fn)``."""
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    emb = jax.random.normal(k1, (vocab, hidden), jnp.float32) * 0.1
+    w = jax.random.normal(k2, (hidden, hidden), jnp.float32) * 0.1
+    out = jax.random.normal(k3, (hidden, vocab), jnp.float32) * 0.1
+
+    def init_state_fn(num_slots):
+        return {"h": jnp.zeros((num_slots, hidden), jnp.float32),
+                "kv": jnp.zeros((num_slots, max_len, hidden), jnp.float32)}
+
+    def step_fn(pool, tokens, positions, active):
+        n = tokens.shape[0]
+        x = emb[tokens]                                   # [slots, hidden]
+        h = jnp.tanh(pool["h"] @ w + x)
+        kv = pool["kv"].at[jnp.arange(n), positions].set(h)
+        seen = (jnp.arange(max_len)[None, :]
+                <= positions[:, None])                    # [slots, max_len]
+        ctx = ((kv * seen[:, :, None]).sum(axis=1)
+               / (positions + 1).astype(jnp.float32)[:, None])
+        logits = ctx @ out
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        am = active[:, None]
+        return ({"h": jnp.where(am, h, pool["h"]),
+                 "kv": jnp.where(active[:, None, None], kv, pool["kv"])},
+                jnp.where(active, nxt, 0))
+
+    return step_fn, init_state_fn

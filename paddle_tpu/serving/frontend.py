@@ -1,0 +1,319 @@
+"""Thread-safe batching inference frontend: submit -> Future, coalesced
+into padded shape buckets, one AOT executable per bucket.
+
+Reference parity: the reference's serving entry point is
+AnalysisPredictor::Run (inference/api/analysis_predictor.cc) — one request
+per call, callers bring their own threads and a PredictorPool of cloned
+predictors, and whatever batch size a caller happens to send is the batch
+XLA^H^H^H the engine sees.  TPU-native design inverts this: the *server*
+owns batching.  Callers ``submit(feeds)`` from any number of threads and
+get a Future; a single dispatcher thread coalesces queued rows from the
+same tenant into the smallest configured shape bucket that fits (padding
+with zeros), runs the tenant's program through its own
+``static.Executor`` with a per-bucket ``entry_key``, and slices the
+fetched rows back onto each caller's Future.
+
+Why buckets: XLA compiles one executable per input shape.  Arbitrary
+batch sizes would retrace on nearly every dispatch; a fixed bucket ladder
+(default 1,2,4,8,16,32) caps compiles at ``len(bucket_edges)`` per tenant,
+each bucket keeps its own Executor hot slot (``entry_key="b{n}"``) and its
+own persistent compile-cache artifact, and steady state is zero retraces —
+pinned by ``executor.traces`` in tests/test_serving.py.
+
+Why padding is safe: every supported program row is computed
+independently (batched matmul/elementwise — there is no cross-row op in
+the inference graphs this frontend serves), so the real rows of a padded
+batch are bitwise-identical to running them alone; the zero rows are
+discarded at slice time.  tests/test_serving.py pins this bitwise, per
+dtype.
+
+Admission (see slo.py): closed-server and per-tenant-quota refusals plus
+projected-p99 load shed all raise typed :class:`AdmissionError` from
+``submit`` — nothing sheds silently.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io.prefetch import stage
+from ..utils import trace as _trace
+from .slo import (AdmissionError, BATCH_OCCUPANCY, BATCH_SIZE, LOAD_SHED,
+                  QUEUE_DEPTH, REQUESTS, SLOPolicy, TTFT_MS)
+from .tenancy import Tenant, TenantManager
+
+__all__ = ["Server", "DEFAULT_BUCKET_EDGES"]
+
+DEFAULT_BUCKET_EDGES = (1, 2, 4, 8, 16, 32)
+
+
+class _Request:
+    __slots__ = ("tenant", "feeds", "rows", "sig", "future", "t_submit")
+
+    def __init__(self, tenant: str, feeds: Dict[str, np.ndarray], rows: int,
+                 sig: Tuple, future: "Future", t_submit: float):
+        self.tenant = tenant
+        self.feeds = feeds
+        self.rows = rows
+        self.sig = sig
+        self.future = future
+        self.t_submit = t_submit
+
+
+class Server:
+    """Continuous-coalescing inference frontend.
+
+    ::
+
+        srv = Server(bucket_edges=(1, 2, 4, 8), max_wait_ms=2.0)
+        srv.add_tenant("bert", program, feed_names=["x"],
+                       fetch_list=[logits], scope=scope, quota=64)
+        srv.start()
+        fut = srv.submit("bert", {"x": np.ones((1, 128), np.float32)})
+        logits = fut.result()[0]        # leading dim == submitted rows
+
+    Knobs:
+
+    * ``bucket_edges`` — the padded-batch ladder; the largest edge is the
+      max rows per dispatch.  One compiled executable per (tenant, bucket).
+    * ``max_wait_ms`` — how long the dispatcher holds an underfull bucket
+      open for more rows before dispatching anyway (latency/occupancy
+      trade; 0 dispatches immediately).
+    * ``max_live_programs`` — the tenant-executable LRU bound (tenancy.py).
+    * ``slo`` — an :class:`~paddle_tpu.serving.slo.SLOPolicy`; default is a
+      disabled policy (admit everything, still records latency).
+    * ``device`` — where padded batches are staged (io/prefetch.stage);
+      None = default device.
+    """
+
+    def __init__(self, bucket_edges: Sequence[int] = DEFAULT_BUCKET_EDGES,
+                 max_wait_ms: float = 2.0, max_live_programs: int = 8,
+                 slo: Optional[SLOPolicy] = None, device=None):
+        edges = sorted(set(int(e) for e in bucket_edges))
+        if not edges or edges[0] < 1:
+            raise ValueError(
+                f"bucket_edges must be positive ints, got {bucket_edges!r}")
+        self.bucket_edges = tuple(edges)
+        self.max_batch = edges[-1]
+        self.max_wait_ms = float(max_wait_ms)
+        self.tenants = TenantManager(max_live_programs=max_live_programs)
+        self.slo = slo if slo is not None else SLOPolicy()
+        self.device = device
+        self._queue: "deque[_Request]" = deque()
+        self._queued_rows = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- setup ---------------------------------------------------------------
+    def add_tenant(self, name: str, program, feed_names: Sequence[str],
+                   fetch_list: Sequence, scope,
+                   quota: Optional[int] = None) -> Tenant:
+        return self.tenants.register(
+            Tenant(name, program, feed_names, fetch_list, scope, quota=quota))
+
+    def start(self) -> "Server":
+        with self._cond:
+            if self._closed:
+                raise AdmissionError("server is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop, name="pdtpu-serve-dispatch",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, tenant: str, feeds: Dict[str, np.ndarray]) -> "Future":
+        """Enqueue one request; thread-safe.  ``feeds`` maps every tenant
+        feed name to an array whose leading dim is the request's row count
+        (all feeds must agree).  The Future resolves to the fetch list with
+        exactly those rows (padding stripped)."""
+        t_submit = time.perf_counter()
+        if self._closed:
+            LOAD_SHED.inc(reason="closed")
+            raise AdmissionError("server is closed")
+        t = self.tenants.get(tenant)
+        req = self._validate(t, feeds, t_submit)
+        # quota first (cheap, per-tenant), then SLO projection
+        self.tenants.begin_request(tenant)
+        try:
+            with self._cond:
+                self.slo.admit(tenant, self._queued_rows, self.max_batch)
+                if self._closed:
+                    LOAD_SHED.inc(reason="closed")
+                    raise AdmissionError("server is closed")
+                self._queue.append(req)
+                self._queued_rows += req.rows
+                QUEUE_DEPTH.set(len(self._queue))
+                self._cond.notify_all()
+        except BaseException:
+            self.tenants.end_request(tenant)
+            raise
+        REQUESTS.inc(tenant=tenant)
+        return req.future
+
+    def _validate(self, t: Tenant, feeds: Dict[str, np.ndarray],
+                  t_submit: float) -> _Request:
+        if set(feeds) != set(t.feed_names):
+            raise ValueError(
+                f"tenant {t.name!r} expects feeds {sorted(t.feed_names)}, "
+                f"got {sorted(feeds)}")
+        arrays, rows, sig = {}, None, []
+        for name in t.feed_names:
+            a = np.asarray(feeds[name])
+            if a.ndim < 1:
+                raise ValueError(
+                    f"feed {name!r} must have a leading batch dim, got a "
+                    f"scalar")
+            if rows is None:
+                rows = int(a.shape[0])
+            elif int(a.shape[0]) != rows:
+                raise ValueError(
+                    f"feed {name!r} has {a.shape[0]} rows but "
+                    f"{t.feed_names[0]!r} has {rows}; all feeds in one "
+                    "request must agree")
+            arrays[name] = a
+            sig.append((name, a.shape[1:], a.dtype.str))
+        if rows == 0:
+            raise ValueError("empty request (0 rows)")
+        if rows > self.max_batch:
+            raise ValueError(
+                f"request has {rows} rows > largest bucket "
+                f"{self.max_batch}; split it client-side")
+        return _Request(t.name, arrays, rows, tuple(sig), Future(), t_submit)
+
+    # -- dispatcher side -----------------------------------------------------
+    def _bucket_for(self, rows: int) -> int:
+        for e in self.bucket_edges:
+            if rows <= e:
+                return e
+        return self.max_batch
+
+    def _take_batch(self) -> Optional[list]:
+        """Pop the longest same-(tenant, sig) FIFO run from the queue head
+        that fits max_batch.  Caller holds the lock; returns None when the
+        queue is empty."""
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        batch, rows = [], 0
+        while self._queue:
+            r = self._queue[0]
+            if (r.tenant != head.tenant or r.sig != head.sig
+                    or rows + r.rows > self.max_batch):
+                break
+            batch.append(self._queue.popleft())
+            rows += r.rows
+        self._queued_rows -= rows
+        QUEUE_DEPTH.set(len(self._queue))
+        return batch
+
+    def _compatible_rows_locked(self) -> int:
+        if not self._queue:
+            return 0
+        head, rows = self._queue[0], 0
+        for r in self._queue:
+            if (r.tenant != head.tenant or r.sig != head.sig
+                    or rows + r.rows > self.max_batch):
+                break
+            rows += r.rows
+        return rows
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._queue:
+                    return
+                # hold an underfull bucket open until max_wait_ms after the
+                # head request arrived, or a full batch coalesces
+                head = self._queue[0]
+                deadline = head.t_submit + self.max_wait_ms / 1e3
+                while (not self._closed
+                       and self._compatible_rows_locked() < self.max_batch):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
+                    if not self._queue:
+                        break
+                batch = self._take_batch()
+            if batch:
+                self._run_batch(batch)
+
+    def _run_batch(self, batch: list):
+        tenant_name = batch[0].tenant
+        rows = sum(r.rows for r in batch)
+        bucket = self._bucket_for(rows)
+        t_dispatch = time.perf_counter()
+        for r in batch:
+            TTFT_MS.observe((t_dispatch - r.t_submit) * 1e3)
+        BATCH_SIZE.observe(rows)
+        BATCH_OCCUPANCY.observe(rows / bucket)
+        try:
+            t = self.tenants.acquire(tenant_name)
+            feed = {}
+            for name in t.feed_names:
+                parts = [r.feeds[name] for r in batch]
+                a = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+                if bucket > rows:
+                    pad = np.zeros((bucket - rows,) + a.shape[1:], a.dtype)
+                    a = np.concatenate([a, pad], 0)
+                feed[name] = a
+            with _trace.span("serve::dispatch", tenant=tenant_name,
+                             bucket=bucket, rows=rows, requests=len(batch)):
+                feed = stage(feed, device=self.device)
+                outs = t.executor.run(
+                    t.program, feed=feed, fetch_list=t.fetch_list,
+                    scope=t.scope, entry_key=f"b{bucket}")
+            t_done = time.perf_counter()
+            off = 0
+            for r in batch:
+                sliced = [np.ascontiguousarray(o[off:off + r.rows])
+                          for o in outs]
+                off += r.rows
+                self.slo.observe(tenant_name, str(bucket),
+                                 (t_done - r.t_submit) * 1e3)
+                self.tenants.end_request(tenant_name)
+                r.future.set_result(sliced)
+        except BaseException as e:  # noqa: BLE001 — crosses to submitters
+            for r in batch:
+                if not r.future.done():
+                    self.tenants.end_request(tenant_name)
+                    r.future.set_exception(e)
+
+    # -- shutdown ------------------------------------------------------------
+    def close(self, drain: bool = True):
+        """Stop accepting requests; with ``drain`` (default) the dispatcher
+        finishes everything already queued before exiting, otherwise queued
+        futures fail with :class:`AdmissionError`."""
+        with self._cond:
+            if self._closed and self._thread is None:
+                return
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    self._queued_rows -= r.rows
+                    self.tenants.end_request(r.tenant)
+                    r.future.set_exception(
+                        AdmissionError("server closed before dispatch"))
+                QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=30.0)

@@ -1,0 +1,156 @@
+"""Latency-SLO-aware admission control + the serve.* telemetry family.
+
+Reference parity: the reference serving stack (paddle/fluid/inference/
+server demos, Paddle Serving's brpc frontends) load-sheds at the RPC layer
+with connection limits and brpc's builtin latency breakers; per-request
+latency lands in per-process bvar counters.  TPU-native design: admission
+is a *model* decision, not a socket decision — the frontend knows the
+per-bucket compiled-step latency distribution (exported through the
+``utils/monitor.py`` registry), so it can project what tail latency a new
+request would see *before* accepting it and shed with a typed error the
+client can back off on, instead of letting the queue build until every
+tenant misses its SLO.
+
+Exported metrics (names are part of the ``tools/metricsdump --lint``
+contract):
+
+* ``serve.queue_depth``           — requests admitted but not yet dispatched
+* ``serve.batch_size``            — real rows per dispatched bucket batch
+* ``serve.batch_occupancy``       — real rows / padded bucket rows
+* ``serve.ttft_ms``               — submit -> first dispatch (frontend) or
+                                    first generated token (continuous decode)
+* ``serve.request_ms{tenant,bucket}`` — submit -> result, per tenant×bucket
+* ``serve.requests{tenant}``      — admitted requests
+* ``serve.load_shed{reason}``     — requests refused (slo|quota|closed)
+
+Admission projects p99 from the SAME ``Histogram.percentile`` estimator
+servebench reports (one percentile implementation, satellite contract).
+Collection rides the ``metrics`` flag: with ``PDTPU_FLAGS_metrics=0`` the
+histograms record nothing, so SLO admission has no data and admits
+everything — shedding requires telemetry on (documented contract).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..core.errors import ResourceExhaustedError
+from ..utils import monitor as _monitor
+
+__all__ = ["AdmissionError", "QuotaExceededError", "SLOPolicy",
+           "QUEUE_DEPTH", "BATCH_SIZE", "BATCH_OCCUPANCY", "TTFT_MS",
+           "REQUEST_MS", "REQUESTS", "LOAD_SHED"]
+
+
+class AdmissionError(ResourceExhaustedError):
+    """The serving frontend refused a request at admission time (load shed):
+    accepting it would push the projected p99 past the tenant's latency SLO,
+    the tenant is over quota, or the server is closed.  Clients should back
+    off and retry; nothing was executed."""
+
+
+class QuotaExceededError(AdmissionError):
+    """Per-tenant in-flight request quota exhausted."""
+
+
+# -- the serve.* family (registered at import so metricsdump lists them) -----
+QUEUE_DEPTH = _monitor.gauge(
+    "serve.queue_depth", "Requests admitted by the serving frontend but not "
+    "yet dispatched to the device (all tenants).")
+BATCH_SIZE = _monitor.histogram(
+    "serve.batch_size", "Real request rows per dispatched bucket batch "
+    "(before padding).",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+BATCH_OCCUPANCY = _monitor.histogram(
+    "serve.batch_occupancy", "Real rows / padded bucket rows per dispatch "
+    "(1.0 = the bucket was full; low steady-state occupancy means the "
+    "bucket edges are too coarse or max_wait_ms too short).",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+TTFT_MS = _monitor.histogram(
+    "serve.ttft_ms", "Time to first result activity (ms): submit -> bucket "
+    "dispatch on the frontend; submit -> first generated token on the "
+    "continuous decode path.")
+REQUEST_MS = _monitor.histogram(
+    "serve.request_ms", "End-to-end request latency (ms): submit -> result "
+    "future resolved, labeled by tenant and shape bucket ('decode' for "
+    "continuous-batching streams).", labelnames=("tenant", "bucket"))
+REQUESTS = _monitor.counter(
+    "serve.requests", "Requests admitted by the serving frontend.",
+    labelnames=("tenant",))
+LOAD_SHED = _monitor.counter(
+    "serve.load_shed", "Requests refused at admission (typed "
+    "AdmissionError), by reason.", labelnames=("reason",))
+
+
+class SLOPolicy:
+    """Projected-p99 admission: refuse a request when the latency it is
+    *likely* to see — the observed per-bucket p99 scaled by the backlog in
+    front of it — exceeds ``p99_ms``.
+
+    The projection is deliberately simple and monotone in queue depth::
+
+        projected = worst_bucket_p99 * (1 + queue_depth / max_batch)
+
+    ``queue_depth / max_batch`` is how many full dispatches are already
+    queued ahead; each costs about one bucket step.  The policy only engages
+    once a bucket has ``min_samples`` observations (cold buckets include
+    compile time in their first sample — shedding on that would refuse the
+    warmup traffic that makes the estimate honest).
+
+    ``p99_ms=None`` disables shedding (admit everything); the attribute is
+    mutable so an operator can tighten/relax the SLO on a live server.
+    """
+
+    def __init__(self, p99_ms: Optional[float] = None, min_samples: int = 20):
+        self.p99_ms = p99_ms
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        # (tenant, bucket) label pairs this policy has recorded — the cells
+        # projected_p99 scans (Histogram has no label enumeration by design)
+        self._cells: Dict[Tuple[str, str], None] = {}
+
+    # -- recording -----------------------------------------------------------
+    def observe(self, tenant: str, bucket: str, request_ms: float) -> None:
+        """Record one completed request's end-to-end latency."""
+        REQUEST_MS.observe(request_ms, tenant=str(tenant), bucket=str(bucket))
+        with self._lock:
+            self._cells[(str(tenant), str(bucket))] = None
+
+    # -- projection ----------------------------------------------------------
+    def observed_p99(self, tenant: Optional[str] = None) -> float:
+        """Worst observed per-bucket p99 (ms) across the policy's cells
+        (optionally restricted to one tenant); nan with no mature cell."""
+        with self._lock:
+            cells = list(self._cells)
+        worst = math.nan
+        for t, b in cells:
+            if tenant is not None and t != tenant:
+                continue
+            if REQUEST_MS.count(tenant=t, bucket=b) < self.min_samples:
+                continue
+            p = REQUEST_MS.percentile(99, tenant=t, bucket=b)
+            if math.isnan(worst) or p > worst:
+                worst = p
+        return worst
+
+    def projected_p99(self, tenant: str, queue_depth: int,
+                      max_batch: int) -> float:
+        base = self.observed_p99(tenant)
+        if math.isnan(base):
+            return math.nan
+        return base * (1.0 + queue_depth / max(1, max_batch))
+
+    def admit(self, tenant: str, queue_depth: int, max_batch: int) -> None:
+        """Raise :class:`AdmissionError` when the projection breaches the
+        SLO; silently admit when disabled or without mature data."""
+        if self.p99_ms is None:
+            return
+        projected = self.projected_p99(tenant, queue_depth, max_batch)
+        if not math.isnan(projected) and projected > self.p99_ms:
+            LOAD_SHED.inc(reason="slo")
+            raise AdmissionError(
+                f"load shed: projected p99 {projected:.2f}ms exceeds the "
+                f"{self.p99_ms:.2f}ms SLO for tenant {tenant!r} "
+                f"(queue_depth={queue_depth}, max_batch={max_batch}); "
+                "back off and retry")

@@ -1,0 +1,155 @@
+"""Per-tenant program isolation for the serving frontend.
+
+Reference parity: the reference's multi-model serving story is one
+AnalysisPredictor per model with `PredictorPool` (inference/api/) cloning
+per-thread predictors, and capacity is whatever fits — there is no
+eviction, no quota, and two models contend for memory invisibly.
+TPU-native design: a *tenant* is (program, feed/fetch signature, scope,
+quota) with its own ``static.Executor`` — its compiled executables, hot
+cache and persistable state never mix with another tenant's.  Live
+executables are a bounded LRU (``max_live_programs``): admitting tenant
+N+1 evicts the least-recently-used tenant's compiled state
+(``Executor.close()`` — parameters in the tenant Scope survive; only
+executables drop), the eviction is flight-recorded for post-mortems, and
+an evicted tenant transparently recompiles on its next request (or warm-
+starts from ``static/compile_cache.py`` when a persistent cache dir is
+configured — eviction then costs a deserialize, not an XLA compile).
+
+Per-tenant quotas bound in-flight requests (admission raises the typed
+:class:`~paddle_tpu.serving.slo.QuotaExceededError`), so one chatty tenant
+cannot starve the rest of the batch budget.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from ..core.errors import NotFoundError
+from ..utils import monitor as _monitor
+from ..utils import trace as _trace
+from .slo import LOAD_SHED, QuotaExceededError
+
+__all__ = ["Tenant", "TenantManager"]
+
+_m_evictions = _monitor.counter(
+    "serve.program_evictions", "Tenant executables evicted from the live-"
+    "program LRU (max_live_programs); the tenant recompiles or warm-starts "
+    "from the persistent compile cache on return.", labelnames=("tenant",))
+_m_live = _monitor.gauge(
+    "serve.live_programs", "Tenants with live (compiled) executables in the "
+    "serving LRU.")
+
+
+class Tenant:
+    """One isolated serving principal: a program with its own Executor,
+    Scope (parameters/state), fetch list, and in-flight quota."""
+
+    def __init__(self, name: str, program, feed_names: Sequence[str],
+                 fetch_list: Sequence, scope, quota: Optional[int] = None):
+        from ..static.executor import Executor
+
+        self.name = str(name)
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_list = list(fetch_list)
+        self.scope = scope
+        self.quota = None if quota is None else int(quota)
+        self.executor = Executor()
+        self.inflight = 0
+
+    def __repr__(self):
+        return (f"Tenant({self.name!r}, feeds={self.feed_names}, "
+                f"quota={self.quota}, inflight={self.inflight})")
+
+
+class TenantManager:
+    """Registry + live-executable LRU + quota accounting.  Thread-safe:
+    ``begin_request``/``end_request`` run on submitter threads while
+    ``acquire`` runs on the dispatcher."""
+
+    def __init__(self, max_live_programs: int = 8):
+        if max_live_programs < 1:
+            raise ValueError(
+                f"max_live_programs must be >= 1, got {max_live_programs}")
+        self.max_live_programs = int(max_live_programs)
+        self._tenants: Dict[str, Tenant] = {}
+        self._live: "OrderedDict[str, None]" = OrderedDict()  # LRU, MRU last
+        self._lock = threading.Lock()
+
+    # -- registry ------------------------------------------------------------
+    def register(self, tenant: Tenant) -> Tenant:
+        with self._lock:
+            if tenant.name in self._tenants:
+                raise ValueError(f"tenant {tenant.name!r} already registered")
+            self._tenants[tenant.name] = tenant
+        return tenant
+
+    def get(self, name: str) -> Tenant:
+        try:
+            return self._tenants[name]
+        except KeyError:
+            raise NotFoundError(
+                f"unknown tenant {name!r}; registered: "
+                f"{sorted(self._tenants)}") from None
+
+    def names(self) -> List[str]:
+        return sorted(self._tenants)
+
+    def live(self) -> List[str]:
+        with self._lock:
+            return list(self._live)
+
+    # -- quota (submitter side) ----------------------------------------------
+    def begin_request(self, name: str) -> Tenant:
+        t = self.get(name)
+        with self._lock:
+            if t.quota is not None and t.inflight >= t.quota:
+                LOAD_SHED.inc(reason="quota")
+                raise QuotaExceededError(
+                    f"tenant {name!r} quota exhausted: {t.inflight} requests "
+                    f"in flight >= quota {t.quota}")
+            t.inflight += 1
+        return t
+
+    def end_request(self, name: str) -> None:
+        t = self.get(name)
+        with self._lock:
+            t.inflight = max(0, t.inflight - 1)
+
+    # -- live-executable LRU (dispatcher side) -------------------------------
+    def acquire(self, name: str) -> Tenant:
+        """The tenant with a live-executable slot: touches the LRU and, when
+        the tenant was not live, evicts the LRU victim(s) to make room."""
+        t = self.get(name)
+        evicted: List[str] = []
+        with self._lock:
+            if name in self._live:
+                self._live.move_to_end(name)
+            else:
+                while len(self._live) >= self.max_live_programs:
+                    victim, _ = self._live.popitem(last=False)
+                    evicted.append(victim)
+                self._live[name] = None
+            _m_live.set(len(self._live))
+        for victim in evicted:
+            self._evict(victim)
+        return t
+
+    def _evict(self, name: str) -> None:
+        t = self._tenants.get(name)
+        if t is None:
+            return
+        t.executor.close()
+        _m_evictions.inc(tenant=name)
+        _trace.flight_recorder().record(
+            "serve_program_evicted", name=name,
+            max_live_programs=self.max_live_programs)
+
+    def evict_all(self) -> None:
+        with self._lock:
+            names = list(self._live)
+            self._live.clear()
+            _m_live.set(0)
+        for name in names:
+            self._evict(name)

@@ -120,9 +120,13 @@ def _sig(arrays: Dict[str, Any]) -> str:
 def build_cache_key(program, seed: int, fetch_names: Sequence[str],
                     feed_arrays: Dict[str, Any], donated: Dict[str, Any],
                     carried: Dict[str, Any], donate: bool,
-                    plan_fingerprint: Optional[str]) -> str:
+                    plan_fingerprint: Optional[str],
+                    entry: str = "") -> str:
     """SHA-256 key for one compiled step artifact (see module docstring for
-    what is deliberately included)."""
+    what is deliberately included).  ``entry`` is the Executor's entry-key
+    partition (serving shape buckets): it rides the key only when set, so
+    bucket-keyed artifacts never collide with the default entry's and
+    legacy keys are unchanged."""
     import jax
     import jaxlib
 
@@ -146,6 +150,8 @@ def build_cache_key(program, seed: int, fetch_names: Sequence[str],
         f"donate={int(bool(donate))}",
         f"plan={plan_fingerprint or 'single'}",
     )
+    if entry:
+        parts = parts + (f"entry={entry}",)
     return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
 

@@ -446,14 +446,25 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Tuple, _CacheEntry] = {}
-        self._hot: Dict[int, _CacheEntry] = {}  # program token -> last entry
+        # (program token, entry key) -> last entry; entry keys partition the
+        # hot map so e.g. serving shape buckets each keep a pinned slot
+        self._hot: Dict[Tuple, _CacheEntry] = {}
         self._step = 0
 
     # -- public API ----------------------------------------------------------
     def run(self, program=None, feed: Optional[dict] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
-            return_numpy: bool = True):
+            return_numpy: bool = True, entry_key: Optional[str] = None):
         """Run one step of ``program``.
+
+        ``entry_key`` names an independent steady-state entry point for the
+        same program: each distinct key keeps its own hot-cache slot (and
+        its own persistent-cache artifact), so a caller that legitimately
+        alternates between several compiled shapes of one program — the
+        serving frontend dispatching padded shape *buckets* — stays on the
+        one-dict-lookup fast path for every bucket instead of thrashing the
+        single per-program hot slot.  ``None`` (the default) preserves the
+        historical one-hot-entry-per-program behavior.
 
         Steady-state fast path: with ``return_numpy=False`` the call is
         dispatch-asynchronous — it returns unmaterialized ``jax.Array``
@@ -493,13 +504,16 @@ class Executor:
                   and (plan is None or plan.donate))
         plan_token = plan.token if plan is not None else None
 
-        # hot path: one dict lookup on the program token, then an in-place
-        # feed-shape check — no sorted signature tuple, no program re-walk
-        entry = self._hot.get(getattr(program, "_exec_cache_token", None))
+        # hot path: one dict lookup on (program token, entry key), then an
+        # in-place feed-shape check — no sorted signature tuple, no program
+        # re-walk.  Distinct entry keys (shape buckets) never evict each
+        # other's hot slot.
+        hot_key = (getattr(program, "_exec_cache_token", None), entry_key)
+        entry = self._hot.get(hot_key)
         if entry is None or not entry.matches(program._version, fetch_names,
                                               feed_arrays, plan_token, donate):
             entry = self._cold_lookup(program, fetch_names, feed_arrays,
-                                      plan_token, donate)
+                                      plan_token, donate, entry_key)
 
         state, missing = {}, None
         for n in entry.state_names:
@@ -566,7 +580,8 @@ class Executor:
                     disk_key = _ccache.build_cache_key(
                         program, seed, fetch_names, feed_arrays, d_state,
                         p_state, donate,
-                        plan.fingerprint() if plan is not None else None)
+                        plan.fingerprint() if plan is not None else None,
+                        entry=entry_key or "")
                 entry.compiled, entry.disk_cache, cost = self._build(
                     program, fetch_names, entry.state_names, seed,
                     plan=plan, feed_arrays=feed_arrays, donate=donate,
@@ -642,12 +657,12 @@ class Executor:
         return list(fetches)
 
     def _cold_lookup(self, program, fetch_names, feed_arrays, plan_token,
-                     donate) -> _CacheEntry:
+                     donate, entry_key=None) -> _CacheEntry:
         """Full cache-key build (sorted feed signature + program walk); the
-        resulting entry is pinned on the hot map so steady-state calls skip
-        this entirely."""
+        resulting entry is pinned on the hot map (keyed by program token ×
+        entry key) so steady-state calls skip this entirely."""
         token = _program_token(program)
-        key = (token, program._version, tuple(fetch_names),
+        key = (token, entry_key, program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
                plan_token, donate)
@@ -663,7 +678,7 @@ class Executor:
                 # artifact on spans/flight events
                 fingerprint=f"{token}v{program._version}")
             self._cache[key] = entry
-        self._hot[token] = entry
+        self._hot[(token, entry_key)] = entry
         return entry
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,

@@ -239,14 +239,59 @@ class Histogram(Metric):
         """Context manager observing the block's wall time in ms."""
         return Histogram._Timer(self, labels)
 
+    def _cell_percentile(self, cell: _HistCell, q: float) -> float:
+        """Estimate the q-th percentile (0 <= q <= 100) from one cell's
+        bucket counts: rank the target observation, find its bucket, and
+        interpolate linearly inside it (the Prometheus histogram_quantile
+        estimator), clamped to the observed [min, max] so single-bucket
+        cells report honest bounds instead of bucket edges."""
+        if cell.count == 0:
+            return math.nan
+        rank = (q / 100.0) * cell.count
+        cum, lo = 0, 0.0
+        for bound, n in zip(self.buckets, cell.bucket_counts):
+            prev = cum
+            cum += n
+            if cum >= rank and n:
+                hi = cell.mx if math.isinf(bound) else bound
+                est = lo + (hi - lo) * ((rank - prev) / n)
+                return min(max(est, cell.mn), cell.mx)
+            if not math.isinf(bound):
+                lo = bound
+        return cell.mx
+
+    def percentile(self, q: float, **labels) -> float:
+        """The q-th percentile estimate for one labeled cell, ``nan`` when
+        the cell has no observations.  One shared implementation for every
+        latency consumer (serving SLO admission, servebench reports) — the
+        estimate's error is bounded by the containing bucket's width, so
+        size the ``buckets`` ladder to the precision the decision needs."""
+        if not 0 <= q <= 100:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock:
+            cell = self._cells.get(self._key(labels))
+            if cell is None:
+                return math.nan
+            snap = _HistCell(len(self.buckets))
+            snap.count, snap.total = cell.count, cell.total
+            snap.mn, snap.mx = cell.mn, cell.mx
+            snap.bucket_counts = list(cell.bucket_counts)
+        return self._cell_percentile(snap, q)
+
+    # quantile points the JSON exporter publishes for every histogram cell
+    JSON_QUANTILES = (50.0, 90.0, 95.0, 99.0)
+
     def _stat(self, cell: _HistCell) -> Dict[str, Any]:
         cum, out = 0, {}
         for bound, n in zip(self.buckets, cell.bucket_counts):
             cum += n
             out[_fmt_le(bound)] = cum
+        quantiles = {f"p{q:g}": self._cell_percentile(cell, q)
+                     for q in self.JSON_QUANTILES} if cell.count else {}
         return {"count": cell.count, "sum": cell.total,
                 "min": cell.mn if cell.count else 0.0,
                 "max": cell.mx if cell.count else 0.0,
+                "quantiles": quantiles,
                 "buckets": out}
 
     def samples(self) -> List[Tuple[Dict[str, str], Dict[str, Any]]]:

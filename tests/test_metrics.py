@@ -72,6 +72,53 @@ def test_histogram_observe_stats_and_buckets():
     assert stat["buckets"] == {"1.0": 1, "10.0": 2, "100.0": 3, "+Inf": 4}
 
 
+def test_histogram_percentile_vs_numpy_reference():
+    # fine bucket ladder -> the interpolated estimate must land within one
+    # bucket width of numpy's exact percentile
+    r = monitor.MetricRegistry()
+    edges = tuple(float(b) for b in range(1, 101))  # width-1 buckets
+    h = r.histogram("t.lat", buckets=edges)
+    rng = np.random.default_rng(7)
+    vals = rng.uniform(0.0, 100.0, size=5000)
+    for v in vals:
+        h.observe(float(v))
+    for q in (1, 10, 25, 50, 75, 90, 95, 99):
+        est = h.percentile(q)
+        exact = float(np.percentile(vals, q))
+        assert abs(est - exact) <= 1.0, (q, est, exact)
+
+
+def test_histogram_percentile_edges_and_labels():
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.lat", buckets=(10.0, 20.0), labelnames=("k",))
+    assert np.isnan(h.percentile(50, k="a"))  # no observations yet
+    for v in (12.0, 14.0, 16.0):
+        h.observe(v, k="a")
+    h.observe(1000.0, k="b")  # separate cell, lands past the last edge
+    # estimates are clamped into [min, max] of the cell
+    assert 12.0 <= h.percentile(0, k="a") <= 16.0
+    assert h.percentile(100, k="a") == 16.0
+    assert h.percentile(99, k="b") == 1000.0  # +Inf bucket -> observed max
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    with pytest.raises(ValueError):
+        h.percentile(-1)
+
+
+def test_histogram_json_export_includes_quantiles():
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.lat", buckets=tuple(float(b) for b in range(1, 51)))
+    vals = np.linspace(0.5, 49.5, 200)
+    for v in vals:
+        h.observe(float(v))
+    doc = json.loads(json.dumps(r.to_json()))  # must stay JSON-round-trip
+    sample = doc["metrics"]["t.lat"]["samples"][0]
+    qs = sample["quantiles"]
+    assert set(qs) == {f"p{q:g}" for q in monitor.Histogram.JSON_QUANTILES}
+    for q in monitor.Histogram.JSON_QUANTILES:
+        assert abs(qs[f"p{q:g}"] - float(np.percentile(vals, q))) <= 1.0
+
+
 def test_histogram_time_context_manager():
     r = monitor.MetricRegistry()
     h = r.histogram("t.timer")

@@ -17,8 +17,11 @@ Usage::
 
 `--lint` checks every registered metric name against ``^[a-z0-9_.]+$``
 (the registry enforces this at registration; the lint is the CI backstop
-that keeps exporter output Prometheus-legal) and exits non-zero on any
-violation.
+that keeps exporter output Prometheus-legal), then against the KNOWN-NAMES
+inventory below — dashboards and alerts key on these exact strings, so a
+new instrumented module must add its names here (the lint failing is the
+review prompt) and a typo'd registration fails instead of silently
+splitting a time series.  Exits non-zero on any violation.
 """
 from __future__ import annotations
 
@@ -28,6 +31,56 @@ import re
 import sys
 
 _NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+# The metric-name inventory: every name any instrumented module registers.
+# Grouped by family; keep sorted within each group.
+_KNOWN_NAMES = frozenset({
+    "debug.nan_events",
+    # parallel/collective.py + parallel/compress.py
+    "comm.allreduce_bytes",
+    "comm.allreduce_ms",
+    "comm.compress_ratio",
+    # static/executor.py + static/compile_cache.py
+    "executor.cache_hit",
+    "executor.cache_miss",
+    "executor.cold_start_ms",
+    "executor.compile_cache_hit",
+    "executor.compile_cache_miss",
+    "executor.compile_time_ms",
+    "executor.cost_bytes_accessed",
+    "executor.cost_flops",
+    "executor.dispatch_time_ms",
+    "executor.donated_bytes",
+    "executor.program_ops",
+    "executor.state_size_bytes",
+    "executor.step_time_ms",
+    "executor.traces",
+    # io/prefetch.py
+    "io.prefetch_batches",
+    "io.prefetch_depth",
+    # distributed/ps_server.py
+    "ps.heartbeat_age_seconds",
+    "ps.rpc_count",
+    "ps.rpc_errors",
+    "ps.rpc_latency_ms",
+    "registry.lowering_calls",
+    # serving/ (slo.py, tenancy.py, continuous.py)
+    "serve.batch_occupancy",
+    "serve.batch_size",
+    "serve.decode_active_slots",
+    "serve.live_programs",
+    "serve.load_shed",
+    "serve.program_evictions",
+    "serve.queue_depth",
+    "serve.request_ms",
+    "serve.requests",
+    "serve.ttft_ms",
+    # hapi/callbacks.py MetricsLogger
+    "train.epochs",
+    "train.samples_per_sec",
+    "train.step_time_ms",
+    "train.steps",
+})
 
 
 def run_workload(steps: int = 3) -> None:
@@ -64,14 +117,27 @@ def _register_instrumented_modules() -> None:
     """Import every instrumented layer so its metrics are registered even
     when the workload doesn't exercise it (PS server, hapi loop)."""
     import paddle_tpu.distributed.ps_server  # noqa: F401
+    import paddle_tpu.serving  # noqa: F401 — the serve.* family
+    import paddle_tpu.static.compile_cache  # noqa: F401
     import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
+    import paddle_tpu.utils.debug  # noqa: F401
     from paddle_tpu.hapi.callbacks import MetricsLogger
 
     MetricsLogger()  # registers the train.* family
 
 
 def lint_names(registry) -> list:
-    return [n for n in registry.names() if not _NAME_RE.match(n)]
+    """(name, problem) pairs: names the exporters would reject or that are
+    missing from the _KNOWN_NAMES inventory."""
+    bad = []
+    for n in registry.names():
+        if not _NAME_RE.match(n):
+            bad.append((n, f"must match {_NAME_RE.pattern}"))
+        elif n not in _KNOWN_NAMES and not n.startswith("t."):
+            # "t." is the reserved scratch namespace (tests, ad-hoc probes)
+            bad.append((n, "not in the metricsdump known-names inventory; "
+                           "add it to _KNOWN_NAMES"))
+    return bad
 
 
 def main(argv=None) -> int:
@@ -100,9 +166,9 @@ def main(argv=None) -> int:
     if args.lint:
         bad = lint_names(registry)
         if bad:
-            for name in bad:
-                print(f"metricsdump: illegal metric name {name!r} "
-                      f"(must match {_NAME_RE.pattern})", file=sys.stderr)
+            for name, problem in bad:
+                print(f"metricsdump: bad metric name {name!r}: {problem}",
+                      file=sys.stderr)
             return 1
         print(f"metricsdump: {len(registry.names())} metric names OK")
         return 0
