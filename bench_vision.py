@@ -47,6 +47,32 @@ def _cast_tree(p, dtype):
         if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
 
 
+def _aot_step(step, example):
+    """AOT-compile the jitted step against the bench inputs (the same
+    compile the first jit dispatch would do) so the artifact the loop runs
+    is also the xprof attribution source (BENCH_PROFILE=0 skips)."""
+    if os.environ.get("BENCH_PROFILE", "1") == "0":
+        return step, None
+    try:
+        aot = step.lower(*example).compile()
+        return aot, aot
+    except Exception:
+        return step, None
+
+
+def _roofline_block(aot, measured_ms):
+    """Condensed xprof block for the bench JSON line: per-layer regions
+    (Layer named scopes), MFU, and the top memory-bound regions by name —
+    the ResNet MFU-gap diagnosis the ROADMAP asks for."""
+    from paddle_tpu.utils import xprof
+
+    try:
+        report = xprof.profile_aot(aot, measured_ms=measured_ms)
+        return xprof.summarize(report, top=5)
+    except Exception:
+        return None
+
+
 def _bench_loop(step, params, opt_state, feed, warmup, iters, sync_every):
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, *feed)
@@ -82,11 +108,13 @@ def bench_resnet50(on_tpu):
         def loss_fn(p_):
             logits = autograd.functional_call(
                 model, _cast_tree(p_, compute_dtype), (images,))
-            return jnp.mean(F.cross_entropy(logits.astype(jnp.float32),
-                                            labels))
+            with jax.named_scope("loss"):
+                return jnp.mean(F.cross_entropy(logits.astype(jnp.float32),
+                                                labels))
 
         loss, grads = jax.value_and_grad(loss_fn)(p)
-        p, s = opt.update(grads, s, p)
+        with jax.named_scope("optimizer"):
+            p, s = opt.update(grads, s, p)
         return p, s, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
@@ -95,12 +123,13 @@ def bench_resnet50(on_tpu):
              else (batch, size, size, 3))
     images = jnp.asarray(rng.standard_normal(shape), compute_dtype)
     labels = jnp.asarray(rng.integers(0, 1000, (batch, 1)), jnp.int32)
+    step, aot = _aot_step(step, (params, opt_state, images, labels))
     dt, loss = _bench_loop(step, params, opt_state, (images, labels),
                            warmup, iters,
                            int(os.environ.get("BENCH_SYNC_EVERY", "10")))
     return dict(metric="resnet50_train_throughput", batch=batch,
                 imgs_per_sec=batch * iters / dt, iters=iters, loss=loss,
-                model="resnet50", size=size, layout=layout)
+                model="resnet50", size=size, layout=layout, _aot=aot)
 
 
 def bench_yolov3(on_tpu):
@@ -131,10 +160,12 @@ def bench_yolov3(on_tpu):
             heads = autograd.functional_call(
                 model, _cast_tree(p_, compute_dtype), (images,))
             heads = [h.astype(loss_dtype) for h in heads]
-            return model.loss(heads, gt_box, gt_label)
+            with jax.named_scope("loss"):
+                return model.loss(heads, gt_box, gt_label)
 
         loss, grads = jax.value_and_grad(loss_fn)(p)
-        p, s = opt.update(grads, s, p)
+        with jax.named_scope("optimizer"):
+            p, s = opt.update(grads, s, p)
         return p, s, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
@@ -146,12 +177,13 @@ def bench_yolov3(on_tpu):
     cxy = rng.uniform(0.2, 0.8, (batch, n_gt, 2))
     gt_box = jnp.asarray(np.concatenate([cxy, wh], -1), jnp.float32)
     gt_label = jnp.asarray(rng.integers(0, 80, (batch, n_gt)), jnp.int32)
+    step, aot = _aot_step(step, (params, opt_state, images, gt_box, gt_label))
     dt, loss = _bench_loop(step, params, opt_state,
                            (images, gt_box, gt_label), warmup, iters,
                            int(os.environ.get("BENCH_SYNC_EVERY", "5")))
     return dict(metric="yolov3_train_throughput", batch=batch,
                 imgs_per_sec=batch * iters / dt, iters=iters, loss=loss,
-                model="yolov3", size=size)
+                model="yolov3", size=size, _aot=aot)
 
 
 def main():
@@ -171,6 +203,9 @@ def main():
         mfu = round(ips * flops / _PEAK[platform], 4) \
             if platform in _PEAK else None
         loss = r.pop("loss", None)
+        aot = r.pop("_aot", None)
+        roofline = (_roofline_block(aot, measured_ms=1000.0 * r["batch"] / ips)
+                    if aot is not None else None)
         print(json.dumps({
             "metric": r.pop("metric"),
             "value": round(ips, 2),
@@ -181,6 +216,7 @@ def main():
             **r,
             "loss": round(loss, 4) if loss is not None and np.isfinite(loss)
             else None,  # NaN would break the one-JSON-line contract
+            "roofline": roofline,
         }))
 
 

@@ -126,6 +126,18 @@ define_flag("compile_cache_dir", "", "Directory for the persistent AOT "
             "per-process seed is part of the key).  (ref: no analogue — "
             "the reference recompiles its ProgramDesc per process; jax's "
             "own compilation cache inspired the key discipline.)")
+define_flag("xprof_scopes", True, "Wrap every lowered op in a jax.named_scope "
+            "(\"<op_type>.b<block>.i<idx>\") during Executor tracing, and "
+            "every dygraph Layer.forward in its attribute-path scope, so op "
+            "identity survives into optimized-HLO instruction metadata and "
+            "utils/xprof.py can attribute per-instruction flops/bytes back "
+            "to source ops.  Scopes are HLO metadata only: they change "
+            "neither compiled code, compile-cache keys (program-content "
+            "keyed), nor retrace behavior — pinned by tests.  Off "
+            "(PDTPU_FLAGS_xprof_scopes=0): xprof reports still build but "
+            "regions degrade to <unattributed> (ref: platform/"
+            "device_tracer.h correlates kernels to ops via CUPTI; on TPU "
+            "the HLO metadata layer is the correlation channel).")
 define_flag("check_program", True, "Statically verify Programs before the "
             "Executor traces them (static/analysis.py): dataflow, registry, "
             "structure, and shape/dtype plausibility checks with typed "

@@ -130,6 +130,10 @@ class Layer:
         elif isinstance(value, Layer):
             self._sub_layers[name] = value
             self.__dict__.pop(name, None)
+            # attribute path = profiling identity: forward runs inside
+            # jax.named_scope(<name>), so utils/xprof.py attributes HLO
+            # instructions to "Model/layer1/conv1"-style regions
+            object.__setattr__(value, "_xprof_name", name)
         else:
             if name in self._parameters:
                 del self._parameters[name]
@@ -162,6 +166,7 @@ class Layer:
 
     def add_sublayer(self, name: str, sublayer: "Layer"):
         self._sub_layers[name] = sublayer
+        object.__setattr__(sublayer, "_xprof_name", name)
         return sublayer
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
@@ -337,7 +342,18 @@ class Layer:
             result = hook(self, args)
             if result is not None:
                 args = result if isinstance(result, tuple) else (result,)
-        out = self.forward(*args, **kwargs)
+        # forward under the layer's named scope (xprof_scopes flag): inside
+        # jit tracing the attribute path lands in HLO metadata.op_name, so a
+        # profiled train step attributes flops to "ResNet/layer1/0/conv1"
+        # instead of anonymous fusions; metadata-only, math unchanged
+        from ...core import flags as _flags
+
+        if _flags.get_flag("xprof_scopes"):
+            scope = getattr(self, "_xprof_name", "") or type(self).__name__
+            with jax.named_scope(scope):
+                out = self.forward(*args, **kwargs)
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, args, out)
             if result is not None:

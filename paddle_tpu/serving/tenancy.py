@@ -39,6 +39,15 @@ _m_evictions = _monitor.counter(
 _m_live = _monitor.gauge(
     "serve.live_programs", "Tenants with live (compiled) executables in the "
     "serving LRU.")
+_m_live_temp = _monitor.gauge(
+    "serve.live_temp_bytes", "Sum of memory_analysis() temp (scratch) bytes "
+    "across the live tenants' compiled executables — the XLA-chosen part "
+    "of the serving memory footprint that evicting a tenant actually "
+    "returns (utils/xprof.py over Executor.memory_stats()).")
+_m_peak_temp = _monitor.gauge(
+    "serve.peak_temp_bytes", "High-water mark of serve.live_temp_bytes over "
+    "this manager's lifetime: the temp budget max_live_programs must be "
+    "provisioned for.")
 
 
 class Tenant:
@@ -76,6 +85,7 @@ class TenantManager:
         self._tenants: Dict[str, Tenant] = {}
         self._live: "OrderedDict[str, None]" = OrderedDict()  # LRU, MRU last
         self._lock = threading.Lock()
+        self._peak_temp = 0  # high-water mark of live executables' temp bytes
 
     # -- registry ------------------------------------------------------------
     def register(self, tenant: Tenant) -> Tenant:
@@ -134,7 +144,28 @@ class TenantManager:
             _m_live.set(len(self._live))
         for victim in evicted:
             self._evict(victim)
+        self._update_mem_gauges()
         return t
+
+    def _update_mem_gauges(self) -> None:
+        """Recompute live/peak temp bytes over the live tenants' compiled
+        executables.  Best-effort telemetry: breakdowns exist only when the
+        `metrics` flag was on at compile time, and a tenant whose
+        executable has not compiled yet contributes zero."""
+        with self._lock:
+            names = list(self._live)
+        total = 0
+        for name in names:
+            t = self._tenants.get(name)
+            if t is None:
+                continue
+            try:
+                total += int(t.executor.memory_stats()["temp_bytes"])
+            except Exception:
+                continue
+        self._peak_temp = max(self._peak_temp, total)
+        _m_live_temp.set(total)
+        _m_peak_temp.set(self._peak_temp)
 
     def _evict(self, name: str) -> None:
         t = self._tenants.get(name)
@@ -153,3 +184,4 @@ class TenantManager:
             _m_live.set(0)
         for name in names:
             self._evict(name)
+        self._update_mem_gauges()

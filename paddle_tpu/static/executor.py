@@ -25,6 +25,7 @@ bit-identical to the primal's, so AD is exact.
 from __future__ import annotations
 
 import contextlib
+import re
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -181,6 +182,12 @@ def _op_salt(block_idx: int, op_idx: int) -> int:
     return block_idx * 65536 + op_idx
 
 
+def _xprof_scope_name(op_type: str, block_idx: int, op_idx: int) -> str:
+    from ..utils.xprof import op_scope_name
+
+    return op_scope_name(op_type, block_idx, op_idx)
+
+
 def _trace_ops(program: Program, block_idx: int, ops, env, base_key,
                frozen=None):
     """Trace a list of ops (any block) with control-flow dispatch.
@@ -189,20 +196,34 @@ def _trace_ops(program: Program, block_idx: int, ops, env, base_key,
     (traced) values even when an op writes them — the backward replay
     injects differentiated intermediates this way, so ∂loss/∂v means "v as
     consumed downstream" rather than being recomputed by its producer
-    (reference backward.py gradients() semantics)."""
+    (reference backward.py gradients() semantics).
+
+    With the ``xprof_scopes`` flag on, every op (control-flow included, so
+    sub-block ops nest under their parent's scope) traces inside
+    ``jax.named_scope("<op_type>.b<block>.i<idx>")`` — op identity lands in
+    optimized-HLO instruction metadata, survives fusion and AD, and
+    utils/xprof.py joins per-instruction flops/bytes back to it.  Scopes
+    are metadata-only: same HLO computation, same compile-cache key, same
+    retrace behavior (pinned by tests/test_xprof.py)."""
+    from ..core import flags as _flags
+
+    scoped = bool(_flags.get_flag("xprof_scopes"))
     for idx, op in enumerate(ops):
         if op.type in ("feed", "fetch"):
             continue
-        if op.type == "backward_region":
-            _lower_backward(program, block_idx, ops, idx, env, base_key)
-        elif op.type == "conditional_block":
-            _lower_cond(program, op, env, base_key)
-        elif op.type == "while":
-            _lower_while(program, op, env, base_key)
-        elif op.type == "static_rnn":
-            _lower_static_rnn(program, op, env, base_key)
-        else:
-            _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
+        ctx = (jax.named_scope(_xprof_scope_name(op.type, block_idx, idx))
+               if scoped else contextlib.nullcontext())
+        with ctx:
+            if op.type == "backward_region":
+                _lower_backward(program, block_idx, ops, idx, env, base_key)
+            elif op.type == "conditional_block":
+                _lower_cond(program, op, env, base_key)
+            elif op.type == "while":
+                _lower_while(program, op, env, base_key)
+            elif op.type == "static_rnn":
+                _lower_static_rnn(program, op, env, base_key)
+            else:
+                _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
         if frozen:
             env.update(frozen)
 
@@ -378,6 +399,50 @@ _m_traces = _monitor.counter(
     "and a warm persistent compile-cache start keeps it at 0 (the step "
     "deserializes instead of tracing).  A growing value in steady state is "
     "a retrace bug.")
+# Device-memory profile of the last-compiled executable (utils/xprof.py over
+# XLA memory_analysis(); the TPU-native stand-in for the reference's CUPTI
+# memory counters).  Set whenever telemetry is on and the single-device AOT
+# path compiled.
+_m_mem_args = _monitor.gauge(
+    "executor.device_mem_args_bytes", "memory_analysis() argument bytes of "
+    "the last-compiled executable.", labelnames=("program",))
+_m_mem_out = _monitor.gauge(
+    "executor.device_mem_out_bytes", "memory_analysis() output bytes of the "
+    "last-compiled executable.", labelnames=("program",))
+_m_mem_temp = _monitor.gauge(
+    "executor.device_mem_temp_bytes", "memory_analysis() temp (scratch) "
+    "bytes of the last-compiled executable — the part of the memory "
+    "footprint that is XLA's choice, not the model's.",
+    labelnames=("program",))
+_m_mem_code = _monitor.gauge(
+    "executor.device_mem_code_bytes", "memory_analysis() generated-code "
+    "bytes of the last-compiled executable.", labelnames=("program",))
+_m_mem_total = _monitor.gauge(
+    "executor.device_mem_total_bytes", "args + out + temp + code bytes of "
+    "the last-compiled executable.", labelnames=("program",))
+# Collect-time census of what is actually resident: every live jax.Array in
+# the process (donated state, prefetch staging, stray host copies included).
+_m_mem_live_bytes = _monitor.gauge(
+    "executor.device_mem_live_bytes", "Bytes of all live jax.Arrays in the "
+    "process (jax.live_arrays() census, evaluated at collect time).")
+_m_mem_live_count = _monitor.gauge(
+    "executor.device_mem_live_arrays", "Count of live jax.Arrays in the "
+    "process (jax.live_arrays() census, evaluated at collect time).")
+
+
+def _census_field(field: str):
+    def sample():
+        from ..utils.xprof import live_array_census
+
+        try:
+            return float(live_array_census()[field])
+        except Exception:
+            return 0.0
+    return sample
+
+
+_m_mem_live_bytes.set_function(_census_field("bytes"))
+_m_mem_live_count.set_function(_census_field("count"))
 
 
 _prog_tokens = iter(range(1, 1 << 62))
@@ -403,7 +468,7 @@ class _CacheEntry:
 
     __slots__ = ("key", "compiled", "version", "donate", "plan_token",
                  "fetch_names", "feed_sig", "state_names", "needs_value",
-                 "op_count", "fingerprint", "disk_cache")
+                 "op_count", "fingerprint", "disk_cache", "aot", "mem")
 
     def __init__(self, key, version, donate, plan_token, fetch_names,
                  feed_arrays, state_names, needs_value, op_count, fingerprint):
@@ -420,6 +485,8 @@ class _CacheEntry:
         self.op_count = op_count
         self.fingerprint = fingerprint
         self.disk_cache = "off"  # persistent-cache provenance: hit|miss|off
+        self.aot = None  # AOT executable when telemetry compiled one —
+        self.mem = None  # xprof's attribution source + its memory breakdown
 
     def matches(self, version, fetch_names, feed_arrays, plan_token,
                 donate) -> bool:
@@ -582,7 +649,8 @@ class Executor:
                         p_state, donate,
                         plan.fingerprint() if plan is not None else None,
                         entry=entry_key or "")
-                entry.compiled, entry.disk_cache, cost = self._build(
+                (entry.compiled, entry.disk_cache, cost,
+                 entry.aot) = self._build(
                     program, fetch_names, entry.state_names, seed,
                     plan=plan, feed_arrays=feed_arrays, donate=donate,
                     example=(feed_arrays, d_state, p_state, step_arg),
@@ -594,7 +662,9 @@ class Executor:
                     _ccache._m_cc_miss.inc()
                 if cost:
                     # XLA cost_analysis() of the compiled artifact:
-                    # flops/bytes land on the compile span and as gauges
+                    # flops/bytes land on the compile span and as gauges —
+                    # on persistent-cache hits too (the cost model is
+                    # re-derived from the deserialized executable)
                     flops = cost.get("flops")
                     nbytes = cost.get("bytes accessed")
                     if flops is not None:
@@ -603,6 +673,18 @@ class Executor:
                     if nbytes is not None:
                         sp.set_attr("bytes_accessed", float(nbytes))
                         _m_cost_bytes.set(float(nbytes), program=str(token))
+                if entry.aot is not None:
+                    from ..utils import xprof as _xprof
+
+                    entry.mem = _xprof.memory_stats(entry.aot)
+                    if entry.mem:
+                        prog = str(token)
+                        _m_mem_args.set(entry.mem["args_bytes"], program=prog)
+                        _m_mem_out.set(entry.mem["out_bytes"], program=prog)
+                        _m_mem_temp.set(entry.mem["temp_bytes"], program=prog)
+                        _m_mem_code.set(entry.mem["code_bytes"], program=prog)
+                        _m_mem_total.set(entry.mem["total_bytes"],
+                                         program=prog)
             if _monitor.enabled():
                 _m_prog_ops.set(entry.op_count, program=str(token))
         else:
@@ -789,10 +871,12 @@ class Executor:
         PRNGKey (a small jit dispatch of its own) and never retrace on the
         step counter.  `seed` is captured per compile-cache entry.
 
-        Returns ``(compiled, disk_cache_status, xla_cost)``: status is
+        Returns ``(compiled, disk_cache_status, xla_cost, aot)``: status is
         ``"hit"`` (step deserialized from ``compile_cache_dir`` — no trace,
         no lowering), ``"miss"`` (traced, exported, stored), or ``"off"``
-        (persistent cache disabled or export unavailable)."""
+        (persistent cache disabled or export unavailable); ``aot`` is the
+        AOT-compiled executable when telemetry built one (the xprof
+        attribution source), else None."""
         state_constraints: Dict[str, Any] = {}
 
         def raw(feeds, donated, carried, step):
@@ -864,29 +948,64 @@ class Executor:
                     error=repr(e))
         return jax.jit(raw, donate_argnums=donate_args), "off"
 
+    # named-scope metadata in optimized HLO: op_name="...<type>.b<k>.i<j>..."
+    _SCOPED_META_RE = re.compile(r'op_name="[^"]*\.b\d+\.i\d+')
+
+    @staticmethod
+    def _refresh_stale_metadata(core, example, aot, status):
+        """Guard against jax's compilation caches serving an executable
+        compiled before xprof scopes existed: the persistent cache key
+        strips HLO metadata (cache_key.py runs strip-debuginfo), so a warm
+        cache returns the old artifact and every op attributes to
+        <unattributed> — and once loaded, the in-memory compilation memo
+        pins it for the process, so no cache-config toggle can dislodge it.
+        When scopes are on but none survived into the optimized HLO,
+        recompile once with an explicit (default-valued, semantically
+        no-op) compiler option: compile options ride both the in-memory
+        memo key and the persistent key, so the scoped module resolves to
+        its own entry — a real compile the first time, a cache hit in later
+        processes.  Compile-cache *hits* are exempt: the deserialized
+        artifact is authoritative and a recompile could not change its
+        metadata."""
+        from ..core import flags as _flags
+
+        if (status == "hit" or not _flags.get_flag("xprof_scopes")
+                or Executor._SCOPED_META_RE.search(aot.as_text())):
+            return aot
+        try:
+            fresh = core.lower(*example).compile(
+                compiler_options={"xla_embed_ir_in_executable": False})
+        except Exception:
+            return aot  # a backend rejecting the option keeps the original
+        return (fresh if Executor._SCOPED_META_RE.search(fresh.as_text())
+                else aot)
+
     @staticmethod
     def _build_single(raw, example, donate, disk=None, disk_key=None):
         """jit the traced step (donating the `donated` state subtree when the
         donate_state fast path is on); when telemetry is on, AOT-compile
-        against the example args instead so the compiled artifact's
+        against the example args so the compiled artifact's
         `cost_analysis()` (flops / bytes accessed — XLA's replacement for
-        the reference's per-op cost model) is observable.  The AOT
-        executable is pinned to the example's arg structure; a later call
-        with a different state pytree (a program that grows persistables)
-        falls back to the jitted path, which retraces as usual.  The
-        persistent-cache path skips cost analysis (its artifact was lowered
-        once, possibly in another process)."""
+        the reference's per-op cost model), `memory_analysis()`, and the
+        optimized HLO text (xprof attribution) are observable.  This runs
+        on every persistent-cache status: a cache *hit*'s jitted
+        ``exp.call`` would compile at first dispatch anyway, so AOT-
+        compiling it up front re-derives the cost model at no extra
+        compile — and never re-traces the program (``executor.traces``
+        stays 0 on a warm start; the historical bug was cost gauges set
+        only on the status-"off" path).  The AOT executable is pinned to
+        the example's arg structure; a later call with a different state
+        pytree (a program that grows persistables) falls back to the
+        jitted path, which retraces as usual."""
         core, status = Executor._load_or_export(raw, example, donate, disk,
                                                 disk_key)
-        if status != "off":
-            return core, status, None
-        jitted = core
         if example is None or not _monitor.enabled():
-            return jitted, status, None
+            return core, status, None, None
         try:
-            aot = jitted.lower(*example).compile()
+            aot = core.lower(*example).compile()
+            aot = Executor._refresh_stale_metadata(core, example, aot, status)
         except Exception:
-            return jitted, status, None
+            return core, status, None, None
         cost = None
         try:
             ca = aot.cost_analysis()
@@ -903,9 +1022,9 @@ class Executor:
             except Exception:
                 # structure mismatches raise host-side before execution, so
                 # the donated buffers are still live for the jitted retry
-                return jitted(feeds, donated, carried, step)
+                return core(feeds, donated, carried, step)
 
-        return call, status, cost
+        return call, status, cost, aot
 
     @staticmethod
     def _build_sharded(raw, plan, example, donate, state_constraints,
@@ -961,7 +1080,68 @@ class Executor:
             pf, pd, pc = place_all(feeds, donated, carried)
             return core(pf, pd, pc, step)
 
-        return call, status, None
+        # no AOT handle on the sharded path (GSPMD partitions per mesh; the
+        # per-shard attribution story is an open roadmap item) — xprof
+        # reports and device_mem_* gauges cover single-device entries
+        return call, status, None, None
+
+    # -- observability (utils/xprof.py) --------------------------------------
+    def memory_stats(self) -> Dict[str, int]:
+        """Aggregate device-memory breakdown (memory_analysis()) over this
+        Executor's hot compiled entries: args/out/temp/code/total bytes plus
+        the contributing entry count.  Zeroes when nothing compiled with
+        telemetry on — the serving TenantManager sums this across live
+        tenants for its peak-temp gauges."""
+        agg = {"args_bytes": 0, "out_bytes": 0, "temp_bytes": 0,
+               "code_bytes": 0, "alias_bytes": 0, "total_bytes": 0,
+               "programs": 0}
+        seen = set()
+        for entry in list(self._hot.values()):
+            if id(entry) in seen or not entry.mem:
+                continue
+            seen.add(id(entry))
+            agg["programs"] += 1
+            for k, v in entry.mem.items():
+                agg[k] = agg.get(k, 0) + int(v)
+        return agg
+
+    def xprof_report(self, program=None, entry_key: Optional[str] = None,
+                     measured_ms: Optional[float] = None,
+                     top: Optional[int] = None) -> Dict[str, Any]:
+        """The xprof attribution/roofline report for a compiled entry (see
+        utils/xprof.py): per-source-op regions with flops, bytes,
+        compute/memory bound class, modeled time and MFU, anchored by the
+        measured ``executor.step_time_ms`` median unless ``measured_ms``
+        overrides it.  ``program=None`` with a single hot entry profiles
+        that entry."""
+        import math as _math
+
+        from ..utils import xprof as _xprof
+
+        entry = None
+        if program is None:
+            live = [e for e in self._hot.values() if e.aot is not None]
+            entry = live[0] if len(live) == 1 else None
+            if entry is None and len(live) > 1:
+                raise ValueError(
+                    "xprof_report(program=None) is ambiguous: "
+                    f"{len(live)} profiled entries are live — pass the "
+                    "program (and entry_key for shape buckets)")
+        else:
+            tok = getattr(program, "_exec_cache_token", None)
+            entry = self._hot.get((tok, entry_key))
+        if entry is None or entry.aot is None:
+            raise RuntimeError(
+                "no profiled executable for this program: xprof needs the "
+                "`metrics` flag on at compile time, at least one "
+                "Executor.run, and the single-device path (sharded entries "
+                "are not yet attributable)")
+        if measured_ms is None:
+            p50 = _m_step_ms.percentile(50)
+            if not _math.isnan(p50):
+                measured_ms = p50
+        return _xprof.profile_aot(entry.aot, measured_ms=measured_ms,
+                                  top=top)
 
     def close(self):
         self._cache.clear()

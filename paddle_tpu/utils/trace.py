@@ -41,7 +41,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..core import flags as _flags
 from . import profiler as _profiler
@@ -49,7 +49,7 @@ from . import profiler as _profiler
 __all__ = [
     "SpanContext", "Span", "span", "current_span", "current_context",
     "inject", "extract", "job_trace_id", "FlightRecorder", "flight_recorder",
-    "arm_postmortem", "arm_from_env",
+    "arm_postmortem", "arm_from_env", "register_postmortem_info",
     "TRACE_ID_ENV", "TRACE_DIR_ENV",
 ]
 
@@ -303,12 +303,39 @@ class FlightRecorder:
         """Write the ring as JSON; returns the event count.  Written via a
         temp file + rename so a dump racing a second signal never leaves a
         truncated file."""
+        with _pm_info_lock:
+            providers = list(_pm_info.items())
+        for kind, provider in providers:
+            try:
+                info = provider()
+            except Exception:
+                continue
+            if info is not None:
+                self.record(kind, **{"info": info})
         doc = self.to_json()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
         return len(doc["events"])
+
+
+# -- post-mortem info providers ---------------------------------------------
+# Modules register a zero-arg callable keyed by event kind; dump() calls each
+# one and records its (JSON-safe) snapshot into the ring just before writing,
+# so a crash dump carries live state the ring itself never saw — e.g. xprof
+# registers "xprof.summary" (top regions + MFU of the last profile report).
+_pm_info: Dict[str, Callable[[], Any]] = {}
+_pm_info_lock = threading.Lock()
+
+
+def register_postmortem_info(kind: str, provider: Callable[[], Any]) -> None:
+    """Attach `provider`'s snapshot to every flight-recorder dump as one
+    event of `kind`.  The provider returns a JSON-safe dict (or None to
+    skip); it must not raise — but a dump is a last-gasp path, so failures
+    are swallowed there regardless."""
+    with _pm_info_lock:
+        _pm_info[str(kind)] = provider
 
 
 _flight: Optional[FlightRecorder] = None

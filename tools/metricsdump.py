@@ -49,6 +49,13 @@ _KNOWN_NAMES = frozenset({
     "executor.compile_time_ms",
     "executor.cost_bytes_accessed",
     "executor.cost_flops",
+    "executor.device_mem_args_bytes",
+    "executor.device_mem_code_bytes",
+    "executor.device_mem_live_arrays",
+    "executor.device_mem_live_bytes",
+    "executor.device_mem_out_bytes",
+    "executor.device_mem_temp_bytes",
+    "executor.device_mem_total_bytes",
     "executor.dispatch_time_ms",
     "executor.donated_bytes",
     "executor.program_ops",
@@ -69,7 +76,9 @@ _KNOWN_NAMES = frozenset({
     "serve.batch_size",
     "serve.decode_active_slots",
     "serve.live_programs",
+    "serve.live_temp_bytes",
     "serve.load_shed",
+    "serve.peak_temp_bytes",
     "serve.program_evictions",
     "serve.queue_depth",
     "serve.request_ms",
@@ -80,6 +89,10 @@ _KNOWN_NAMES = frozenset({
     "train.samples_per_sec",
     "train.step_time_ms",
     "train.steps",
+    # utils/xprof.py
+    "xprof.attribution_coverage",
+    "xprof.mfu",
+    "xprof.reports",
 })
 
 
@@ -121,6 +134,7 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.static.compile_cache  # noqa: F401
     import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
     import paddle_tpu.utils.debug  # noqa: F401
+    import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
     from paddle_tpu.hapi.callbacks import MetricsLogger
 
     MetricsLogger()  # registers the train.* family

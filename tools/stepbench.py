@@ -253,8 +253,48 @@ def _cache_bench(steps: int, batch: int, hidden: int, cache_dir: str) -> dict:
     }
 
 
+def _run_profile(steps: int, batch: int, hidden: int) -> dict:
+    """xprof roofline block for the bench program: a separate short run
+    with metrics ON (the timed modes force metrics off, so this pass owns
+    the step_time_ms anchor), condensed via ``xprof.summarize`` — coverage,
+    MFU, drift, top regions and the memory-bound ones by name."""
+    import numpy as np
+
+    import paddle_tpu.static as static
+    from paddle_tpu.core import flags
+    from paddle_tpu.static import layers as L
+    from paddle_tpu.utils import xprof
+
+    main, startup = static.Program(), static.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    scope = static.Scope()
+    saved = flags.get_flags(["metrics"])
+    try:
+        flags.set_flags({"metrics": True})
+        with static.program_guard(main, startup), static.scope_guard(scope):
+            x = L.data("x", [hidden])
+            y = L.data("y", [1])
+            h = L.fc(x, hidden, act="relu")
+            pred = L.fc(h, 1)
+            loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+            static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+            exe = static.Executor()
+            exe.run(startup)
+            rng = np.random.default_rng(0)
+            feed = {"x": rng.normal(0, 1, (batch, hidden)).astype(np.float32),
+                    "y": rng.normal(0, 1, (batch, 1)).astype(np.float32)}
+            for _ in range(max(2, min(steps, 8))):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            report = exe.xprof_report(main)
+        return xprof.summarize(report)
+    finally:
+        flags.set_flags(saved)
+
+
 def run_bench(steps: int = 50, batch: int = 64, hidden: int = 256,
-              mesh: int = 0, cache_dir=None) -> dict:
+              mesh: int = 0, cache_dir=None, profile: bool = False) -> dict:
     import jax
 
     fast_ms, fast_losses = _run_mode(donate=True, async_dispatch=True,
@@ -285,6 +325,9 @@ def run_bench(steps: int = 50, batch: int = 64, hidden: int = 256,
     if cache_dir is not None:
         result.update(_cache_bench(steps=min(steps, 8), batch=batch,
                                    hidden=hidden, cache_dir=cache_dir))
+    if profile:
+        result["roofline"] = _run_profile(steps=steps, batch=batch,
+                                          hidden=hidden)
     return result
 
 
@@ -293,8 +336,18 @@ def selfcheck() -> int:
     parity, a 2-device sharded pass, and a cache cold/warm round-trip."""
     _ensure_cpu_devices(2)
     with tempfile.TemporaryDirectory(prefix="pdtpu_stepbench_cc_") as cc:
-        r = run_bench(steps=8, batch=8, hidden=32, mesh=2, cache_dir=cc)
+        r = run_bench(steps=8, batch=8, hidden=32, mesh=2, cache_dir=cc,
+                      profile=True)
     ok = True
+    roof = r.get("roofline") or {}
+    if not (roof.get("attribution_coverage", 0) >= 0.9):
+        print(f"selfcheck: roofline attribution coverage "
+              f"{roof.get('attribution_coverage')} < 0.9", file=sys.stderr)
+        ok = False
+    if not roof.get("top_regions"):
+        print("selfcheck: roofline block has no top_regions",
+              file=sys.stderr)
+        ok = False
     for k in ("host_ms_fast", "host_ms_sync", "speedup", "parity",
               "host_ms_sharded", "sharded_parity", "cold_start_ms",
               "warm_start_ms", "cache_parity"):
@@ -349,6 +402,9 @@ def main(argv=None) -> int:
                         help="also measure the persistent executable cache: "
                              "cold vs warm start against DIR (default: a "
                              "temp directory)")
+    parser.add_argument("--profile", action="store_true",
+                        help="also attach an xprof roofline block (coverage, "
+                             "MFU, top regions; see tools/xprof.py)")
     parser.add_argument("--selfcheck", action="store_true",
                         help="tiny smoke run with field/parity checks")
     args = parser.parse_args(argv)
@@ -359,10 +415,12 @@ def main(argv=None) -> int:
     if args.cache == "":
         with tempfile.TemporaryDirectory(prefix="pdtpu_stepbench_cc_") as cc:
             r = run_bench(steps=args.steps, batch=args.batch,
-                          hidden=args.hidden, mesh=args.mesh, cache_dir=cc)
+                          hidden=args.hidden, mesh=args.mesh, cache_dir=cc,
+                          profile=args.profile)
     else:
         r = run_bench(steps=args.steps, batch=args.batch, hidden=args.hidden,
-                      mesh=args.mesh, cache_dir=args.cache)
+                      mesh=args.mesh, cache_dir=args.cache,
+                      profile=args.profile)
     print(json.dumps(r))
     return 0
 
