@@ -143,3 +143,14 @@ define_flag("check_program", True, "Statically verify Programs before the "
             "structure, and shape/dtype plausibility checks with typed "
             "diagnostics (ref: the framework/ir + inference/analysis "
             "pre-execution pass stage).")
+define_flag("check_sharding", True, "Statically verify Program x "
+            "ShardingPlan pairings before the Executor traces them "
+            "(static/shardcheck.py, SC001-SC009): feed batch divisibility, "
+            "mesh-axis validity, state-placement conflicts, donation "
+            "aliasing, comm_quantize applicability, sub-block aval "
+            "consistency, and ZeRO/annotation conflicts, plus a static "
+            "communication estimate.  Memoized by plan token x program "
+            "version x feed shapes, so it runs only on compile-cache "
+            "misses — steady-state steps never re-check (ref: the "
+            "compile-time InferShape/InferVarType pass stage, extended "
+            "with GSPMD layout knowledge).")

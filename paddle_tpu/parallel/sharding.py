@@ -32,14 +32,53 @@ from . import mesh as _mesh
 Rules = Sequence[Tuple[str, Sequence[Optional[Union[str, Tuple[str, ...]]]]]]
 
 
+def _axis_name_error(axis: str, mesh: Mesh, where: str) -> ValueError:
+    """ValueError naming the bad axis with a difflib nearest-name hint —
+    raised here, at the Python layer, instead of surfacing as a KeyError
+    deep inside jax's NamedSharding machinery at trace time."""
+    from ..static.registry import suggest_names  # lazy: avoids import cycle
+
+    candidates = list(mesh.axis_names) + [
+        a for a in _mesh._CANONICAL_ORDER if a not in mesh.axis_names]
+    suggestion = suggest_names(axis, candidates=candidates)
+    msg = (f"{where} references axis {axis!r}, which is neither in the "
+           f"mesh {tuple(mesh.axis_names)} nor a canonical axis "
+           f"{_mesh._CANONICAL_ORDER}")
+    if suggestion:
+        msg += f" — {suggestion}"
+    return ValueError(msg)
+
+
+def _validate_axes(axes: Optional[Sequence], mesh: Optional[Mesh],
+                   where: str) -> None:
+    """Reject axis names that are neither mesh axes nor canonical names
+    (a canonical name absent from the mesh is the legitimate degree-1
+    collapse and stays legal)."""
+    if axes is None or mesh is None:
+        return
+    valid = set(mesh.axis_names) | set(_mesh._CANONICAL_ORDER)
+    for a in axes:
+        if a is None:
+            continue
+        for x in (a if isinstance(a, (tuple, list)) else (a,)):
+            if isinstance(x, str) and x not in valid:
+                raise _axis_name_error(x, mesh, where)
+
+
 class ShardingRules:
     """Ordered regex→axes table applied to structured parameter names."""
 
     def __init__(self, rules: Rules = ()):
-        self.rules: List[Tuple[re.Pattern, Tuple]] = [
-            (re.compile(pat), tuple(axes)) for pat, axes in rules]
+        self.rules: List[Tuple[re.Pattern, Tuple]] = []
+        for pat, axes in rules:
+            self.add(pat, axes)
 
     def add(self, pattern: str, axes: Sequence):
+        # eager typo check against the ambient mesh (if one is active):
+        # fails here with a nearest-name suggestion instead of silently
+        # replicating via _clean_spec or erroring inside jax later
+        _validate_axes(tuple(axes), _mesh.get_mesh(),
+                       f"sharding rule {pattern!r}")
         self.rules.append((re.compile(pattern), tuple(axes)))
         return self
 
@@ -218,6 +257,28 @@ class ShardingPlan:
         self.batch_axes = tuple(batch_axes)
         self.seq_axis = seq_axis
         self.donate = bool(donate)
+        # eager typo checks against whichever mesh is known at build time
+        # (explicit beats ambient); unknown non-canonical axis names would
+        # otherwise silently replicate (_clean_spec) or fail inside jax
+        known_mesh = mesh if mesh is not None else _mesh.get_mesh()
+        _validate_axes(self.batch_axes, known_mesh, "batch_axes")
+        if seq_axis is not None:
+            _validate_axes((seq_axis,), known_mesh, "seq_axis")
+        if self.annotations:
+            for _name, _spec in self.annotations.items():
+                _validate_axes(_spec, known_mesh,
+                               f"annotation for {_name!r}")
+        if comm_quantize and comm_quantize != "none":
+            from . import compress as _compress
+            if comm_quantize not in _compress.COMPRESS_KINDS:
+                from ..static.registry import suggest_names
+                suggestion = suggest_names(
+                    comm_quantize,
+                    candidates=list(_compress.COMPRESS_KINDS) + ["none"])
+                raise ValueError(
+                    f"comm_quantize={comm_quantize!r} is not a known kind "
+                    f"{_compress.COMPRESS_KINDS}"
+                    + (f" — {suggestion}" if suggestion else ""))
         # gradient-communication options: made ambient (compress.comm_scope)
         # while the Executor traces the step, so axis-bound collectives —
         # collective.all_reduce / the static c_allreduce_* lowerings — pick

@@ -114,6 +114,29 @@ class Server:
     def add_tenant(self, name: str, program, feed_names: Sequence[str],
                    fetch_list: Sequence, scope,
                    quota: Optional[int] = None) -> Tenant:
+        """Register a tenant program.  The program and its feed names are
+        statically verified against this server's bucket ladder right here
+        (static/shardcheck.py SC007 + the PV program checks) — a bad feed
+        name or a batch dim no bucket can hold fails at registration with a
+        named diagnostic instead of at the first submit."""
+        from ..core import flags as _flags
+
+        if _flags.get_flag("check_sharding"):
+            from ..static.shardcheck import _check_serving_buckets
+            from ..core import errors as _errors
+
+            out = []
+            _check_serving_buckets(program, feed_names, self.bucket_edges,
+                                   out)
+            errs = [d for d in out if d.severity == "error"]
+            if errs:
+                raise _errors.ProgramVerificationError(
+                    f"tenant {name!r} rejected at registration:\n"
+                    + _errors.render_diagnostics(errs), diagnostics=errs)
+        if _flags.get_flag("check_program"):
+            from ..static.analysis import check_program_cached
+
+            check_program_cached(program, feed_names=set(feed_names))
         return self.tenants.register(
             Tenant(name, program, feed_names, fetch_list, scope, quota=quota))
 

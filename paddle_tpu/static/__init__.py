@@ -52,7 +52,9 @@ from .io import (
     save_persistables,
 )
 from . import nets
-from .analysis import Diagnostic, check_program, verify_program
+from .analysis import (Diagnostic, check_program, check_program_cached,
+                       infer_program, shape_rule_coverage, verify_program)
+from .shardcheck import check_plan, estimate_comm, verify_plan
 from .registry import register_op, registered_ops
 from . import op_version
 

@@ -629,12 +629,21 @@ class Executor:
                              ops=entry.op_count) as sp:
                 if _flags.get_flag("check_program"):
                     # pre-trace static analysis (SURVEY §7: fail fast and
-                    # legibly before jit) — once per compile-cache entry, so
-                    # steady-state steps never re-verify
-                    from .analysis import check_program as _check_program
+                    # legibly before jit) — memoized by program version ×
+                    # feed/fetch signature, so neither steady-state steps
+                    # nor a second cold entry for the same program re-walk
+                    from .analysis import check_program_cached \
+                        as _check_program
 
                     _check_program(program, feed_names=set(feed_arrays),
                                    fetch_names=fetch_names)
+                if plan is not None and _flags.get_flag("check_sharding"):
+                    # tier-two: Program × ShardingPlan checks (SC001–SC009)
+                    # — memoized by plan token × program version × feed
+                    # shapes, zero steady-state cost
+                    from .shardcheck import check_with_plan as _check_plan
+
+                    _check_plan(program, plan, feed_arrays)
                 seed = program.random_seed or _random_seed()
                 # persistent AOT cache (static/compile_cache.py): key the
                 # artifact by program content × mesh/plan × versions; a hit

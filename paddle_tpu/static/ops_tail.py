@@ -244,7 +244,7 @@ def _interp(mode):
         x = _one(ins, "X")
         size = _one(ins, "OutSize")
         if size is not None:
-            size = tuple(int(v) for v in np.asarray(size))
+            size = tuple(int(v) for v in np.asarray(size))  # proglint: host-sync-ok — static-shape contract: OutSize must be compile-time constant
         elif attrs.get("out_shape"):
             size = tuple(attrs["out_shape"])
         elif mode == "trilinear":
@@ -943,7 +943,7 @@ def _linspace(ins, attrs, op):
             raise ValueError(
                 "linspace: Num must be a static attr (or compile-time "
                 "constant) — it determines the output shape under jit")
-        num = int(np.asarray(num_in))
+        num = int(np.asarray(num_in))  # proglint: host-sync-ok — static-shape contract enforced by the ValueError above
     return {"Out": [jnp.linspace(
         _one(ins, "Start").reshape(()), _one(ins, "Stop").reshape(()),
         int(num),

@@ -53,3 +53,29 @@ def _seed():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _session_verifier_sweep():
+    """End-of-session gate: every program the suite ran through the
+    Executor (i.e. that passed check_program_cached) must still verify
+    with zero errors at teardown — catches tests that mutate a program
+    into an invalid state after its memoized check, and any
+    nondeterminism in the verifier itself."""
+    yield
+    from paddle_tpu.static import analysis
+
+    failures = []
+    for prog, version, _feeds, _fetches in analysis.session_passed_programs():
+        # feed/fetch-agnostic recheck: data vars are assumed feedable, so
+        # only structural/shape/dtype regressions can fire
+        diags, _eng = analysis.infer_program(prog)
+        errs = [d for d in diags if d.severity == "error"]
+        if errs:
+            failures.append(
+                f"program (checked at version {version}, now "
+                f"{prog._version}): "
+                + "; ".join(f"{d.code} {d.message}" for d in errs[:3]))
+    assert not failures, (
+        "programs that passed the verifier during the session now fail:\n"
+        + "\n".join(failures))

@@ -432,3 +432,171 @@ def test_diagnostic_structure_and_rendering():
     with pytest.raises(errors.ProgramVerificationError) as ei:
         static.check_program(p, feed_names={"x"}, fetch_names=["out"])
     assert [x.code for x in ei.value.diagnostics] == ["PV003"]
+
+
+# ---------------------------------------------------------------------------
+# PV009 -> whole-program inference engine: wildcard dims flow through
+# multi-op chains and concrete mismatches surface ops downstream
+# ---------------------------------------------------------------------------
+
+def test_engine_conv_pool_reshape_chain_infers(_fresh_programs):
+    """A wildcard batch dim rides conv2d->pool2d->reshape->fc: every
+    trailing dim comes out concrete, the batch stays one shared symbol,
+    and the whole chain verifies clean."""
+    main, _ = _fresh_programs
+    img = L.data("img", [1, 28, 28])
+    c = L.conv2d(img, num_filters=4, filter_size=3, padding=1, act="relu")
+    p = L.pool2d(c, pool_size=2, pool_stride=2, pool_type="max")
+    f = L.reshape(p, [-1, 4 * 14 * 14])
+    h = L.fc(f, 10)
+    assert _errors_of(main) == []
+    _diags, eng = static.infer_program(main)
+    assert tuple(eng.shapes[c.name][1:]) == (4, 28, 28)
+    assert tuple(eng.shapes[p.name][1:]) == (4, 14, 14)
+    assert eng.shapes[f.name][1] == 784
+    assert eng.shapes[h.name][1] == 10
+    # the batch symbol is shared where jnp would share it
+    assert eng.shapes[f.name][0] is eng.shapes[h.name][0]
+
+
+def test_engine_catches_mismatch_behind_declared_wildcard(_fresh_programs):
+    """The tentpole regression: reshape to (2, -1) *declares* a wildcard
+    contracted dim, so the old per-op plausibility table (declared shapes
+    only) passed this program and it died inside the jax trace.  The
+    engine infers the -1 to 784 from the conv/pool chain and pins the
+    PV009 on the mul four ops downstream."""
+    main, _ = _fresh_programs
+    img = L.data("img", [2, 1, 28, 28], append_batch_size=False)
+    c = L.conv2d(img, num_filters=4, filter_size=3, padding=1)
+    p = L.pool2d(c, pool_size=2, pool_stride=2)
+    f = L.reshape(p, [2, -1])
+    assert tuple(f.shape) == (2, -1)       # declared: invisible to PV009
+    b = main.current_block()
+    b.create_parameter("w_bad", (700, 10))
+    b.create_var(name="mm", shape=(-1, 10))
+    b.append_op("mul", {"X": [f.name], "Y": ["w_bad"]}, {"Out": ["mm"]},
+                {"x_num_col_dims": 1, "y_num_col_dims": 1})
+    diags = _errors_of(main)
+    assert _codes(diags) == ["PV009"]
+    assert diags[0].op_type == "mul"
+    assert diags[0].op_index == len(main.global_block().ops) - 1
+
+
+def test_engine_ops_tail_families(_fresh_programs):
+    """slice/expand/tile (the ops_tail families) carry symbolic dims."""
+    main, _ = _fresh_programs
+    x = L.data("x", [16])
+    sl = L.slice(x, axes=[1], starts=[0], ends=[8])
+    t = L.tile(sl, [1, 3])
+    _diags, eng = static.infer_program(main)
+    assert eng.shapes[sl.name][1] == 8
+    assert eng.shapes[t.name][1] == 24
+    # the batch dim stays symbolic (no invented concrete value) throughout
+    assert not isinstance(eng.shapes[sl.name][0], int)
+    assert not isinstance(eng.shapes[t.name][0], int)
+    assert _errors_of(main) == []
+
+
+def test_shape_rule_coverage_report():
+    cov = static.shape_rule_coverage()
+    assert cov["registered"] >= 400
+    assert cov["covered"] == cov["inference_rules"] or \
+        cov["covered"] >= cov["inference_rules"]
+    assert cov["coverage"] >= 0.4          # the declared-coverage floor
+    assert all(isinstance(n, str) for n in cov["uncovered"])
+    # every covered op really is registered
+    assert cov["covered"] + len(cov["uncovered"]) == cov["registered"]
+
+
+# ---------------------------------------------------------------------------
+# check_program_cached: one walk per program version x feed/fetch signature
+# ---------------------------------------------------------------------------
+
+def test_check_program_cached_memoizes(_fresh_programs):
+    from paddle_tpu.static import analysis
+    from paddle_tpu.utils import monitor
+
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    loss = L.mean(L.fc(x, 2))
+    saved = flags.get_flags(["metrics"])
+    flags.set_flags({"metrics": True})
+    try:
+        c = monitor.default_registry().get("analysis.programs_checked")
+        before = c.value() if c is not None else 0
+        static.check_program_cached(main, feed_names={"x"})
+        static.check_program_cached(main, feed_names={"x"})
+        c = monitor.default_registry().get("analysis.programs_checked")
+        assert c.value() == before + 1      # second call was a pure hit
+        # mutation bumps the version -> one more real walk
+        L.mean(loss)
+        static.check_program_cached(main, feed_names={"x"})
+        assert c.value() == before + 2
+    finally:
+        flags.set_flags(saved)
+    # the session log feeds conftest's end-of-session sweep
+    assert any(prog is main
+               for prog, _v, _fe, _ft in analysis.session_passed_programs())
+
+
+# ---------------------------------------------------------------------------
+# proglint PL005: host-sync calls inside traced lowerings
+# ---------------------------------------------------------------------------
+
+_SEEDED_HOST_SYNC = textwrap.dedent('''
+    import numpy as np
+    import jax
+    from .registry import register_op
+
+    @register_op("sync_in_trace")
+    def _bad(ins, attrs, op):
+        x = ins["X"][0]
+        host = np.asarray(x)              # forces a device sync mid-trace
+        jax.device_get(x)
+        x.block_until_ready()
+        return {"Out": [host]}
+
+    @register_op("attrs_only_ok")
+    def _ok(ins, attrs, op):
+        shape = np.asarray(attrs["shape"])      # attrs are host data
+        size = tuple(int(v) for v in np.asarray(list(attrs.get("s", []))))
+        return {"Out": [ins["X"][0].reshape(tuple(shape))]}
+
+    @register_op("waived_ok")
+    def _waived(ins, attrs, op):
+        n = int(np.asarray(ins["N"][0]))  # proglint: host-sync-ok
+        return {"Out": [ins["X"][0][:n]]}
+
+    @register_op("callback_ok")
+    def _callback(ins, attrs, op):
+        def host_cb(v):
+            return np.asarray(v)          # runs on host, not in trace
+        return {"Out": [jax.pure_callback(host_cb, ins["X"][0], ins["X"][0])]}
+''')
+
+
+def test_proglint_pl005_host_sync(tmp_path):
+    from tools.proglint import lint_file
+
+    f = tmp_path / "ops_sync.py"
+    f.write_text(_SEEDED_HOST_SYNC)
+    violations = [v for v in lint_file(f)]
+    pl005 = [v for v in violations if v.code == "PL005"]
+    assert len(pl005) == 3, violations     # asarray + device_get + block
+    assert all(v.code == "PL005" for v in violations)
+    lines = {v.line for v in pl005}
+    text = _SEEDED_HOST_SYNC.splitlines()
+    for ln in lines:
+        assert "_bad" in "\n".join(text[max(0, ln - 6):ln])
+
+
+def test_proglint_pl005_does_not_disturb_existing_codes(tmp_path):
+    """The original seeded fixture's codes stay exactly PL001-PL004 —
+    np.random.normal inside a lowering is host-side randomness (PL001),
+    not a device sync."""
+    from tools.proglint import lint_file
+
+    bad = tmp_path / "ops_seeded.py"
+    bad.write_text(_SEEDED_BAD)
+    codes = sorted({v.code for v in lint_file(bad)})
+    assert codes == ["PL001", "PL002", "PL003", "PL004"]

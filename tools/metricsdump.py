@@ -35,6 +35,10 @@ _NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 # The metric-name inventory: every name any instrumented module registers.
 # Grouped by family; keep sorted within each group.
 _KNOWN_NAMES = frozenset({
+    # static/analysis.py + static/shardcheck.py (the two-tier verifier)
+    "analysis.plans_checked",
+    "analysis.programs_checked",
+    "analysis.violations",
     "debug.nan_events",
     # parallel/collective.py + parallel/compress.py
     "comm.allreduce_bytes",
@@ -131,6 +135,8 @@ def _register_instrumented_modules() -> None:
     when the workload doesn't exercise it (PS server, hapi loop)."""
     import paddle_tpu.distributed.ps_server  # noqa: F401
     import paddle_tpu.serving  # noqa: F401 — the serve.* family
+    import paddle_tpu.static.analysis  # noqa: F401 — analysis.* counters
+    import paddle_tpu.static.shardcheck  # noqa: F401 — analysis.plans_checked
     import paddle_tpu.static.compile_cache  # noqa: F401
     import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
     import paddle_tpu.utils.debug  # noqa: F401

@@ -23,6 +23,16 @@ trace-time heisenbugs.  Checks:
 - ``PL004`` the same op name registered twice across the scanned files
   (the runtime registry raises at import; the lint catches it without
   importing).
+- ``PL005`` host-sync APIs inside traced code: ``np.asarray``/``np.array``
+  on traced values, ``jax.device_get``, or ``.block_until_ready()`` in the
+  body of a lowering (any function with the universal ``(ins, attrs, op)``
+  signature, however it is registered).  Under jit these either concretize
+  a tracer (ConcretizationTypeError at trace time) or stall the dispatch
+  pipeline per step.  Calls whose argument subtree only touches ``attrs``
+  are exempt (attrs are compile-time constants), nested helper functions
+  are exempt (host callbacks run outside the trace), and a deliberate
+  static-shape-contract site is waived with a ``# proglint: host-sync-ok``
+  comment on the same line.
 
 CLI:  ``python -m tools.proglint [files...]`` — defaults to every
 ``paddle_tpu/static/ops*.py`` in the repo; exits 0 when clean, 1 when any
@@ -167,6 +177,77 @@ def _check_return_contract(path: str, fn: ast.FunctionDef, op_name: str,
                 "dict {slot: [arrays]}"))
 
 
+_HOST_SYNC_WAIVER = "proglint: host-sync-ok"
+_LOWERING_ARGS = ("ins", "attrs", "op")
+
+
+def _is_lowering_fn(node) -> bool:
+    """A lowering rule is any function with the universal registry
+    signature (ins, attrs, op) — decorator-registered, call-registered, a
+    factory's nested `rule`, or a lambda."""
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+        return False
+    args = node.args
+    names = tuple(a.arg for a in args.args)
+    return (names == _LOWERING_ARGS and not args.posonlyargs
+            and not args.kwonlyargs)
+
+
+def _touches_only_attrs(call: ast.Call) -> bool:
+    """True when every Name the call's arguments read is `attrs` (or a
+    builtin-looking constant path): attrs are compile-time constants, so
+    np.asarray over them never syncs a tracer."""
+    loads = [n for a in call.args + [kw.value for kw in call.keywords]
+             for n in ast.walk(a) if isinstance(n, ast.Name)]
+    return bool(loads) and all(
+        n.id in ("attrs", "np", "numpy", "jnp", "list", "tuple", "int",
+                 "float", "len", "sorted") for n in loads)
+
+
+def _check_host_sync(path: str, fn, aliases: Dict[str, str], lines,
+                     out: List[Violation]) -> None:
+    body = fn.body if isinstance(fn, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue                    # host callbacks run off-trace
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        finding = None
+        if isinstance(func, ast.Attribute):
+            if (isinstance(func.value, ast.Name)
+                    and aliases.get(func.value.id) == "numpy"
+                    and func.attr in ("asarray", "array")):
+                finding = (f"np.{func.attr} on a traced value forces a "
+                           "host sync / concretization inside the trace")
+            elif (isinstance(func.value, ast.Name)
+                  and func.value.id == "jax"
+                  and func.attr == "device_get"):
+                finding = ("jax.device_get inside a lowering blocks on "
+                           "device work every trace")
+            elif func.attr == "block_until_ready":
+                finding = (".block_until_ready() inside a lowering stalls "
+                           "the dispatch pipeline")
+        if finding is None:
+            continue
+        if _touches_only_attrs(node):
+            continue                    # attrs are compile-time constants
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _HOST_SYNC_WAIVER in line:
+            continue
+        out.append(Violation(
+            path, node.lineno, "PL005",
+            finding + " — hoist to attrs, use jnp, or move it into a host "
+            f"callback (waive a deliberate static-shape contract with "
+            f"`# {_HOST_SYNC_WAIVER}`)"))
+
+
 def _own_statements(fn: ast.FunctionDef):
     """Walk fn's statements WITHOUT descending into nested function defs
     (a nested helper's returns are not the lowering's returns)."""
@@ -188,10 +269,15 @@ def lint_file(path, descoped: Optional[Dict[str, str]] = None,
     rel = str(path)
     descoped = _load_descoped() if descoped is None else descoped
     seen_names = {} if seen_names is None else seen_names
-    tree = ast.parse(path.read_text(), filename=rel)
+    source = path.read_text()
+    tree = ast.parse(source, filename=rel)
+    lines = source.splitlines()
     out: List[Violation] = []
     _check_forbidden_idioms(rel, tree, out)
+    aliases = _module_aliases(tree)
     for node in ast.walk(tree):
+        if _is_lowering_fn(node):
+            _check_host_sync(rel, node, aliases, lines, out)
         if isinstance(node, ast.FunctionDef):
             for dec in node.decorator_list:
                 name = _register_op_name(dec)
