@@ -143,6 +143,20 @@ define_flag("check_program", True, "Statically verify Programs before the "
             "structure, and shape/dtype plausibility checks with typed "
             "diagnostics (ref: the framework/ir + inference/analysis "
             "pre-execution pass stage).")
+define_flag("opt_passes", "", "Verified graph-rewrite pass pipeline applied "
+            "to Programs on the Executor's compile path (static/passes.py). "
+            "Empty (default): off.  '1'/'default': the default pipeline — "
+            "constant_folding, cse, conv+BN+act and matmul+bias+act fusion, "
+            "NHWC layout propagation, dce.  A comma list (e.g. 'cse,dce') "
+            "runs exactly those passes.  Every rewrite is verified — fetch "
+            "interface preserved (PV011) and the full program checker "
+            "re-run — and any failure rolls back to the original program "
+            "(passes.rollbacks metric + flight-recorder event), so the flag "
+            "is always safe to enable.  The pipeline fingerprint joins the "
+            "persistent compile-cache key; it runs only on compile-cache "
+            "misses, so steady-state steps and warm starts never pay for it "
+            "(ref: the framework/ir fusion/optimization pass stage, run by "
+            "the inference analysis predictor before execution).")
 define_flag("check_sharding", True, "Statically verify Program x "
             "ShardingPlan pairings before the Executor traces them "
             "(static/shardcheck.py, SC001-SC009): feed batch divisibility, "

@@ -110,8 +110,22 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         new_rm = momentum * running_mean + (1 - momentum) * mean
         new_rv = momentum * running_var + (1 - momentum) * var
         return out, new_rm, new_rv
-    mean, var = running_mean, running_var
-    # inference: fold to per-channel a·x+b (input-fuses into the consumer)
+    a, b = bn_inference_scale_bias(running_mean, running_var, weight, bias,
+                                   epsilon)
+    out = x * a.astype(x.dtype).reshape(shape) \
+        + b.astype(x.dtype).reshape(shape)
+    return out, running_mean, running_var
+
+
+def bn_inference_scale_bias(mean, var, weight, bias, epsilon):
+    """Fold inference-mode BN to per-channel ``a·x + b`` (fp32 a, b).
+
+    This is the r05 fold: the apply input-fuses into the producing conv's
+    consumer.  Shared by F.batch_norm's inference path and the graph-level
+    conv+BN+act fusion pass (static/passes.py) — the pass replaces the
+    conv2d→batch_norm op pair with one ``fused_conv2d_bn_act`` op whose
+    lowering scales the conv filter by ``a`` and biases by ``b``, so the
+    fold happens once on weights instead of per activation."""
     inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + epsilon)
     a = inv
     if weight is not None:
@@ -119,9 +133,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     b = -mean.astype(jnp.float32) * a
     if bias is not None:
         b = b + bias.astype(jnp.float32)
-    out = x * a.astype(x.dtype).reshape(shape) \
-        + b.astype(x.dtype).reshape(shape)
-    return out, running_mean, running_var
+    return a, b
 
 
 def _use_fused_ln(x, normalized_shape) -> bool:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -86,8 +87,7 @@ def _chunk_bounds(labels, lengths, num_chunk_types, scheme):
 
     # end index of the chunk covering position i: first j >= i with ends[j]
     idx = jnp.where(ends, pos[None, :], T + 1)
-    end_idx = jnp.flip(
-        jnp.minimum.accumulate(jnp.flip(idx, axis=1), axis=1), axis=1)
+    end_idx = jax.lax.cummin(idx, axis=1, reverse=True)
     return begins, typ, end_idx
 
 

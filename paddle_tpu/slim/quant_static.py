@@ -83,8 +83,7 @@ class QuantizationTransformPass:
                             quantized[name] = qname
                         op.inputs[slot][i] = qname
             new_ops.append(op)
-        block.ops = new_ops
-        program._version += 1
+        block.set_ops(new_ops)
         return program
 
     def _insert_weight_quant(self, block, new_ops, name: str) -> str:
@@ -187,8 +186,7 @@ class QuantizationFreezePass:
                 if wname in scales:
                     op.attrs["weight_scale"] = scales[wname].tolist()
                     op.attrs["weight_bits"] = self.weight_bits
-        block.ops = kept
-        program._version += 1
+        block.set_ops(kept)
         return program
 
 
@@ -296,8 +294,7 @@ class PostTrainingQuantization:
                             qname = quantized[name] = out.name
                         op.inputs[slot][i] = qname
             new_ops.append(op)
-        block.ops = new_ops
-        self.program._version += 1
+        block.set_ops(new_ops)
         return self.program
 
     def save_quantized_model(self, model_prefix: str) -> None:

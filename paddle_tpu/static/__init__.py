@@ -54,6 +54,8 @@ from .io import (
 from . import nets
 from .analysis import (Diagnostic, check_program, check_program_cached,
                        infer_program, shape_rule_coverage, verify_program)
+from .passes import (DEFAULT_PIPELINE, PassManager, available_passes,
+                     golden_parity, optimize_for_executor)
 from .shardcheck import check_plan, estimate_comm, verify_plan
 from .registry import register_op, registered_ops
 from . import op_version

@@ -37,6 +37,11 @@ Checks (diagnostic codes):
   shape contradicts the variable's *declared* shape — the declaration is
   stale or wrong (the trace would still succeed; downstream PV009 checks
   run on the inferred shape, not the stale declaration).
+- ``PV011`` rewrite safety (emitted by static/passes.py, not by this
+  walker): a graph-rewrite pass broke the fetch-reachable interface — a
+  fetch vanished or its inferred shape/dtype changed between the
+  ``infer_program`` snapshots taken before and after the rewrite.  The
+  pass manager raises ``ProgramVerificationError`` carrying these.
 
 The PV009 table is fed by a forward **symbolic inference engine**
 (``_ShapeEnv``): every ``-1``/undeclared dim becomes a stable symbol
@@ -1277,23 +1282,28 @@ def _rule_pool2d(ctx):
     if x is None or len(x) != 4:
         ctx.set_out("Out", None, ctx.in_dtype("X"))
         return
+    nchw = ctx.attr("data_format", "NCHW") == "NCHW"
+    c = x[1] if nchw else x[3]
+    h_in, w_in = (x[2], x[3]) if nchw else (x[1], x[2])
+
+    def _emit(h, w):
+        out = (x[0], c, h, w) if nchw else (x[0], h, w, c)
+        ctx.set_out("Out", out, ctx.in_dtype("X"))
+
     if ctx.attr("global_pooling", False):
-        ctx.set_out("Out", (x[0], x[1], 1, 1), ctx.in_dtype("X"))
-        return
-    if ctx.attr("adaptive", False):
-        ks = tuple(int(k) for k in ctx.attr("ksize", (1, 1)))
-        ctx.set_out("Out", (x[0], x[1]) + ks, ctx.in_dtype("X"))
+        _emit(1, 1)
         return
     ks = tuple(int(k) for k in ctx.attr("ksize", (1, 1)))
+    if ctx.attr("adaptive", False):
+        _emit(*ks)
+        return
     st = tuple(int(s) for s in ctx.attr("strides", ks))
     pd = tuple(int(p) for p in ctx.attr("paddings", (0, 0)))
     if ctx.attr("ceil_mode", False):
-        ctx.set_out("Out", (x[0], x[1], Sym("pool"), Sym("pool")),
-                    ctx.in_dtype("X"))
+        _emit(Sym("pool"), Sym("pool"))
         return
-    h = _conv_spatial(x[2], ks[0], st[0], pd[0])
-    w = _conv_spatial(x[3], ks[1], st[1], pd[1])
-    ctx.set_out("Out", (x[0], x[1], h, w), ctx.in_dtype("X"))
+    _emit(_conv_spatial(h_in, ks[0], st[0], pd[0]),
+          _conv_spatial(w_in, ks[1], st[1], pd[1]))
 
 
 def _rule_batch_norm(ctx):
@@ -1775,6 +1785,492 @@ for _name in ("less_than", "less_equal", "greater_than", "greater_equal",
 for _name in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
               "reduce_prod"):
     _INFER_RULES[_name] = _rule_reduce
+
+
+# -- pass-relevant op families (PR 11 satellite): conv/pool/transpose
+#    variants the fusion+layout passes rewrite, matmul variants, scalar
+#    reductions, fills, and data movement.  Every rule mirrors its
+#    registered lowering (static/ops*.py) — shapes first, declared-dtype
+#    fallback where the lowering preserves input dtype. ----------------------
+
+def _tuplen(v, n):
+    """Scalar-or-sequence attr -> n-tuple (the F.* layer convention)."""
+    if v is None:
+        return (0,) * n
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t * n if len(t) == 1 else t
+
+
+def _deconv_spatial(size, k, s, p, d=1, op_=0):
+    if not _known(size):
+        return Sym("deconv")
+    return (int(size) - 1) * s - 2 * p + d * (k - 1) + 1 + op_
+
+
+def _rule_conv_nd(spatial, transpose=False):
+    """conv3d / conv*_transpose: filter (O, I/g, *k) — transposed filters
+    are (I, O/g, *k), so out channels = w[1] * groups."""
+    def rule(ctx):
+        x, w = ctx.in_shape("Input"), ctx.in_shape("Filter")
+        rank = 2 + spatial
+        if x is None or w is None or len(x) != rank or len(w) != rank:
+            ctx.set_out("Output", None, ctx.in_dtype("Input"))
+            return
+        if not all(_known(w[2 + i]) for i in range(spatial)):
+            ctx.set_out("Output", None, ctx.in_dtype("Input"))
+            return
+        st = _tuplen(ctx.attr("strides", 1), spatial)
+        pd = _tuplen(ctx.attr("paddings", 0), spatial)
+        dl = _tuplen(ctx.attr("dilations", 1), spatial)
+        if transpose:
+            g = ctx.attr("groups", 0) or (
+                int(x[1]) if _known(x[1]) else None)
+            op_ = _tuplen(ctx.attr("output_padding", 0), spatial)
+            ch = int(w[1]) * int(g) if g and _known(w[1]) else Sym("deconv_c")
+            dims = tuple(_deconv_spatial(x[2 + i], int(w[2 + i]), st[i],
+                                         pd[i], dl[i], op_[i])
+                         for i in range(spatial))
+        else:
+            ch = w[0]
+            dims = tuple(_conv_spatial(x[2 + i], int(w[2 + i]), st[i],
+                                       pd[i], dl[i])
+                         for i in range(spatial))
+        ctx.set_out("Output", (x[0], ch) + dims, ctx.in_dtype("Input"))
+
+    return rule
+
+
+def _rule_pool3d(ctx):
+    x = ctx.in_shape("X")
+    if x is None or len(x) != 5:
+        ctx.set_out("Out", None, ctx.in_dtype("X"))
+        return
+    if ctx.attr("global_pooling", False):
+        ctx.set_out("Out", (x[0], x[1], 1, 1, 1), ctx.in_dtype("X"))
+        return
+    ks = _tuplen(ctx.attr("ksize", 1), 3)
+    st = _tuplen(ctx.attr("strides", None), 3) if ctx.attr("strides") else ks
+    pd = _tuplen(ctx.attr("paddings", 0), 3)
+    dims = tuple(_conv_spatial(x[2 + i], ks[i], st[i], pd[i])
+                 for i in range(3))
+    ctx.set_out("Out", (x[0], x[1]) + dims, ctx.in_dtype("X"))
+
+
+def _rule_pool_with_index(spatial):
+    def rule(ctx):
+        x = ctx.in_shape("X")
+        rank = 2 + spatial
+        if x is None or len(x) != rank:
+            ctx.set_out("Out", None, ctx.in_dtype("X"))
+            ctx.set_out("Mask", None)
+            return
+        ks = _tuplen(ctx.attr("ksize", 1), spatial)
+        st = _tuplen(ctx.attr("strides", None), spatial) \
+            if ctx.attr("strides") else ks
+        pd = _tuplen(ctx.attr("paddings", 0), spatial)
+        dims = tuple(_conv_spatial(x[2 + i], ks[i], st[i], pd[i])
+                     for i in range(spatial))
+        out = (x[0], x[1]) + dims
+        ctx.set_out("Out", out, ctx.in_dtype("X"))
+        ctx.set_out("Mask", out)
+
+    return rule
+
+
+def _rule_unfold(ctx):
+    x = ctx.in_shape("X")
+    if x is None or len(x) != 4:
+        ctx.set_out("Y", None, ctx.in_dtype("X"))
+        return
+    kh, kw = _tuplen(ctx.attr("kernel_sizes"), 2)
+    sh, sw = _tuplen(ctx.attr("strides", 1), 2)
+    dh, dw = _tuplen(ctx.attr("dilations", 1), 2)
+    p = list(ctx.attr("paddings", (0, 0, 0, 0)))
+    if len(p) == 2:
+        pads = (p[0], p[1])
+    else:                    # (up, left, down, right): symmetric sums halved
+        pads = None
+    c = int(x[1]) if _known(x[1]) else None
+    if pads is not None:
+        ho = _conv_spatial(x[2], kh, sh, pads[0], dh)
+        wo = _conv_spatial(x[3], kw, sw, pads[1], dw)
+        length = (int(ho) * int(wo)
+                  if _known(ho) and _known(wo) else Sym("unfold"))
+    else:
+        length = Sym("unfold")
+    ctx.set_out("Y", (x[0], c * kh * kw if c else Sym("unfold_c"), length),
+                ctx.in_dtype("X"))
+
+
+def _rule_pad3d(ctx):
+    x, p = ctx.in_shape("X"), ctx.attr("paddings")
+    out = None
+    if x is not None and len(x) == 5 and p is not None and len(p) >= 6:
+        # NCDHW with paddings (l, r, t, b, front, back)
+        out = (x[0], x[1], _bdim(x[2], int(p[4]) + int(p[5])),
+               _bdim(x[3], int(p[2]) + int(p[3])),
+               _bdim(x[4], int(p[0]) + int(p[1])))
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_spp(ctx):
+    x, h = ctx.in_shape("X"), ctx.attr("pyramid_height")
+    out = None
+    if x is not None and len(x) == 4 and h:
+        c = x[1]
+        feat = (int(c) * (4 ** int(h) - 1) // 3 if _known(c)
+                else Sym("spp"))
+        out = (x[0], feat)
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_pixel_shuffle(ctx):
+    x, r = ctx.in_shape("X"), ctx.attr("upscale_factor")
+    out = None
+    if x is not None and len(x) == 4 and r:
+        r = int(r)
+        c = int(x[1]) // (r * r) if _known(x[1]) else Sym("pxs")
+        out = (x[0], c, _scaled(x[2], r), _scaled(x[3], r))
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _scaled(d, mult):
+    return int(d) * mult if _known(d) else Sym("scaled")
+
+
+def _rule_space_to_depth(ctx):
+    x, b = ctx.in_shape("X"), ctx.attr("blocksize")
+    out = None
+    if x is not None and len(x) == 4 and b:
+        b = int(b)
+        c = int(x[1]) * b * b if _known(x[1]) else Sym("s2d")
+        h = int(x[2]) // b if _known(x[2]) else Sym("s2d")
+        w = int(x[3]) // b if _known(x[3]) else Sym("s2d")
+        out = (x[0], c, h, w)
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_dot(ctx):
+    x = ctx.in_shape("X")
+    ctx.set_out("Out", tuple(x[:-1]) if x is not None and len(x) else None,
+                ctx.in_dtype("X"))
+
+
+def _rule_addmm(ctx):
+    x, y = ctx.in_shape("X"), ctx.in_shape("Y")
+    out = None
+    if x is not None and y is not None and len(x) == 2 and len(y) == 2:
+        out = (x[0], y[1])
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_batch_fc(ctx):
+    x, w = ctx.in_shape("Input"), ctx.in_shape("W")
+    out = None
+    if x is not None and w is not None and len(x) == 3 and len(w) == 3:
+        out = (x[0], x[1], w[2])
+    ctx.set_out("Out", out, ctx.in_dtype("Input"))
+
+
+def _rule_bilinear_tp(ctx):
+    x, w = ctx.in_shape("X"), ctx.in_shape("Weight")
+    out = None
+    if x is not None and w is not None and len(x) == 2 and len(w) == 3:
+        out = (x[0], w[0])
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_scalar(out_slot="Out", dtype=None):
+    def rule(ctx):
+        ctx.set_out(out_slot, (),
+                    dtype if dtype is not None else ctx.in_dtype("X"))
+
+    return rule
+
+
+def _rule_keepdim_reduce(axis_attr, keep_attr):
+    """logsumexp/frobenius_norm-style: axis list attr or all-dims."""
+    def rule(ctx):
+        x = ctx.in_shape("X")
+        if x is None or not len(x):
+            ctx.set_out("Out", None if x is None else (), ctx.in_dtype("X"))
+            return
+        ax = ctx.attr(axis_attr)
+        dims = set(range(len(x))) if not ax else \
+            {int(d) % len(x) for d in
+             ((ax,) if isinstance(ax, (int, np.integer)) else tuple(ax))}
+        if ctx.attr(keep_attr, False):
+            out = tuple(1 if i in dims else d for i, d in enumerate(x))
+        else:
+            out = tuple(d for i, d in enumerate(x) if i not in dims)
+        ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+    return rule
+
+
+def _rule_p_norm(ctx):
+    x = ctx.in_shape("X")
+    if x is None:
+        ctx.set_out("Out", None, ctx.in_dtype("X"))
+        return
+    ax = ctx.attr("axis")
+    keep = ctx.attr("keepdim", False)
+    if ax is None:                       # ravel() then reduce axis 0
+        ctx.set_out("Out", (1,) if keep else (), ctx.in_dtype("X"))
+        return
+    dims = {int(ax) % len(x)} if len(x) else set()
+    out = tuple(1 if i in dims else d for i, d in enumerate(x)) if keep \
+        else tuple(d for i, d in enumerate(x) if i not in dims)
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_trace_op(ctx):
+    x = ctx.in_shape("Input")
+    out = None
+    if x is not None and len(x) >= 2:
+        a1 = int(ctx.attr("axis1", 0)) % len(x)
+        a2 = int(ctx.attr("axis2", 1)) % len(x)
+        out = tuple(d for i, d in enumerate(x) if i not in (a1, a2))
+    ctx.set_out("Out", out, ctx.in_dtype("Input"))
+
+
+def _rule_histogram(ctx):
+    ctx.set_out("Out", (int(ctx.attr("bins", 100)),), np.dtype(np.int64))
+
+
+def _rule_eye(ctx):
+    rows = ctx.attr("num_rows")
+    if rows is None:
+        return
+    cols = int(ctx.attr("num_columns", -1) or -1)
+    out = (int(rows), cols if cols > 0 else int(rows))
+    ctx.set_out("Out", out, _attr_dtype(ctx, "float32"))
+
+
+def _attr_dtype(ctx, default=None):
+    dt = ctx.attr("dtype", default)
+    try:
+        return np.dtype(dt) if dt is not None else None
+    except TypeError:
+        return None
+
+
+def _rule_fill_values(ctx):
+    shape = ctx.attr("shape")
+    ctx.set_out("Out",
+                None if shape is None else tuple(int(d) for d in shape),
+                _attr_dtype(ctx, "float32"))
+
+
+def _rule_diag(ctx):
+    x = ctx.in_shape("Diagonal")
+    out = None
+    if x is not None and len(x) == 1 and _known(x[0]):
+        out = (int(x[0]), int(x[0]))
+    ctx.set_out("Out", out, ctx.in_dtype("Diagonal"))
+
+
+def _rule_diag_v2(ctx):
+    x = ctx.in_shape("X")
+    off = abs(int(ctx.attr("offset", 0)))
+    out = None
+    if x is not None and len(x) == 1:
+        n = int(x[0]) + off if _known(x[0]) else Sym("diag")
+        out = (n, n)
+    elif x is not None and len(x) == 2:
+        out = None                      # diagonal length: declared fallback
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_diag_embed(ctx):
+    x = ctx.in_shape("X")
+    out = None
+    if (x is not None and len(x) >= 1
+            and int(ctx.attr("dim1", -2)) == -2
+            and int(ctx.attr("dim2", -1)) == -1):
+        off = abs(int(ctx.attr("offset", 0)))
+        n = int(x[-1]) + off if _known(x[-1]) else Sym("diag")
+        out = tuple(x[:-1]) + (n, n)
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_randperm(ctx):
+    n = ctx.attr("n")
+    ctx.set_out("Out", (int(n),) if n else None,
+                _attr_dtype(ctx, "int64"))
+
+
+def _rule_linspace(ctx):
+    num = ctx.attr("num")
+    ctx.set_out("Out", (int(num),) if num else (Sym("linspace"),),
+                _attr_dtype(ctx, "float32"))
+
+
+def _rule_range_op(ctx):
+    # bounds are value-dependent: rank/dtype only
+    ctx.set_out("Out", (Sym("range"),), ctx.in_dtype("Start"))
+
+
+def _rule_meshgrid(ctx):
+    n = ctx.n_inputs("X")
+    shapes = [ctx.in_shape("X", i) for i in range(n)]
+    if any(s is None or len(s) != 1 for s in shapes):
+        return
+    grid = tuple(s[0] for s in shapes)
+    for i in range(len(ctx.op.outputs.get("Out", ()))):
+        ctx.set_out("Out", grid, ctx.in_dtype("X", min(i, n - 1)), i=i)
+
+
+def _rule_split(ctx):
+    x = ctx.in_shape("X")
+    outs = ctx.op.outputs.get("Out", ())
+    if x is None or not len(x):
+        for i in range(len(outs)):
+            ctx.set_out("Out", None, ctx.in_dtype("X"), i=i)
+        return
+    axis = int(ctx.attr("axis", 0)) % len(x)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections")
+    for i in range(len(outs)):
+        out = list(x)
+        if sections:
+            out[axis] = int(sections[i]) if i < len(sections) else None
+        elif num:
+            out[axis] = (int(x[axis]) // int(num) if _known(x[axis])
+                         else Sym("split"))
+        else:
+            out[axis] = Sym("split")
+        ctx.set_out("Out", tuple(out), ctx.in_dtype("X"), i=i)
+
+
+def _rule_flatten_range(ctx):
+    x = ctx.in_shape("X")
+    if x is None or not len(x):
+        ctx.set_out("Out", None, ctx.in_dtype("X"))
+        return
+    start = int(ctx.attr("start_axis", 1)) % len(x)
+    stop = int(ctx.attr("stop_axis", -1)) % len(x)
+    mid = x[start:stop + 1]
+    flat = int(np.prod([int(d) for d in mid])) \
+        if all(_known(d) for d in mid) else Sym("flatten")
+    ctx.set_out("Out", tuple(x[:start]) + (flat,) + tuple(x[stop + 1:]),
+                ctx.in_dtype("X"))
+
+
+def _rule_gather_nd(ctx):
+    x, idx = ctx.in_shape("X"), ctx.in_shape("Index")
+    out = None
+    if (x is not None and idx is not None and len(idx) >= 1
+            and _known(idx[-1]) and int(idx[-1]) <= len(x)):
+        out = tuple(idx[:-1]) + tuple(x[int(idx[-1]):])
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_sequence_mask(ctx):
+    x, maxlen = ctx.in_shape("X"), ctx.attr("maxlen")
+    out = None
+    if x is not None and maxlen:
+        out = tuple(x) + (int(maxlen),)
+    ctx.set_out("Y", out)
+
+
+def _rule_multiplex(ctx):
+    ctx.set_out("Out", ctx.in_shape("X", 0), ctx.in_dtype("X", 0))
+
+
+def _rule_quant_cast(dtype):
+    def rule(ctx):
+        ctx.set_out("Output", ctx.in_shape("Input"), np.dtype(dtype))
+
+    return rule
+
+
+_INFER_RULES.update({
+    # conv/pool variants (the layout + fusion pass families)
+    "conv3d": _rule_conv_nd(3),
+    "conv2d_transpose": _rule_conv_nd(2, transpose=True),
+    "depthwise_conv2d_transpose": _rule_conv_nd(2, transpose=True),
+    "conv3d_transpose": _rule_conv_nd(3, transpose=True),
+    "pool3d": _rule_pool3d,
+    "max_pool2d_with_index": _rule_pool_with_index(2),
+    "max_pool3d_with_index": _rule_pool_with_index(3),
+    "unfold": _rule_unfold,
+    "pad3d": _rule_pad3d,
+    "spp": _rule_spp,
+    "pixel_shuffle": _rule_pixel_shuffle,
+    "space_to_depth": _rule_space_to_depth,
+    # BN/affine/channel-wise variants: value-wise in X
+    "sync_batch_norm": _rule_same_as("X", "Y"),
+    "affine_channel": _rule_same_as("X", "Out"),
+    "temporal_shift": _rule_same_as("X", "Out"),
+    "shuffle_channel": _rule_same_as("X", "Out"),
+    "lrn": _rule_same_as("X", "Out"),
+    "spectral_norm": _rule_same_as("Weight", "Out"),
+    "conv_shift": _rule_same_as("X", "Out"),
+    "pad_constant_like": _rule_same_as("X", "Out"),
+    "lod_reset": _rule_same_as("X", "Out"),
+    "fill_zeros_like2": _rule_same_as("X", "Out"),
+    "cvm": _rule_same_as("X", "Y"),
+    # collectives: shape-preserving on every member
+    "allreduce": _rule_same_as("X", "Out"),
+    "broadcast": _rule_same_as("X", "Out"),
+    "c_broadcast": _rule_same_as("X", "Out"),
+    "c_reduce_sum": _rule_same_as("X", "Out"),
+    "c_reduce_max": _rule_same_as("X", "Out"),
+    "c_reduce_min": _rule_same_as("X", "Out"),
+    "c_reduce_prod": _rule_same_as("X", "Out"),
+    # matmul variants
+    "dot": _rule_dot,
+    "addmm": _rule_addmm,
+    "batch_fc": _rule_batch_fc,
+    "bilinear_tensor_product": _rule_bilinear_tp,
+    "cos_sim": _rule_keepdim_batch("Out"),
+    "minus": _rule_elementwise,
+    "smooth_l1": _rule_keepdim_batch("Out", extra_slots=("Diff",)),
+    "squared_l2_distance": _rule_keepdim_batch(
+        "Out", extra_slots=("sub_result",)),
+    "rank_loss": _rule_same_as("Label", "Out"),
+    # reductions to scalars / reduced shapes
+    "reduce_all": _rule_reduce,
+    "reduce_any": _rule_reduce,
+    "logsumexp": _rule_keepdim_reduce("axis", "keepdim"),
+    "frobenius_norm": _rule_keepdim_reduce("dim", "keep_dim"),
+    "p_norm": _rule_p_norm,
+    "l1_norm": _rule_scalar(),
+    "dist": _rule_scalar(),
+    "allclose": _rule_scalar(dtype=np.dtype(np.bool_)),
+    "trace": _rule_trace_op,
+    "histogram": _rule_histogram,
+    # fills / generators
+    "eye": _rule_eye,
+    "fill": _rule_fill_values,
+    "assign_value": _rule_fill_values,
+    "diag": _rule_diag,
+    "diag_v2": _rule_diag_v2,
+    "diag_embed": _rule_diag_embed,
+    "randint": _rule_fill_values,
+    "randperm": _rule_randperm,
+    "linspace": _rule_linspace,
+    "range": _rule_range_op,
+    "meshgrid": _rule_meshgrid,
+    # data movement
+    "split": _rule_split,
+    "flatten_contiguous_range": _rule_flatten_range,
+    "gather_nd": _rule_gather_nd,
+    "sequence_mask": _rule_sequence_mask,
+    "multiplex": _rule_multiplex,
+    # int8 deployment path
+    "quantize": _rule_quant_cast(np.int8),
+    "dequantize": _rule_quant_cast(np.float32),
+    "requantize": _rule_quant_cast(np.int8),
+    # pass-emitted fused ops (static/passes.py): the fusion absorbs only
+    # value-wise act + channel-wise BN / 1-D bias, so the output contract
+    # is exactly the anchor op's (conv2d / mul respectively)
+    "fused_conv2d_bn_act": _rule_conv2d,
+    "fused_matmul_bias_act": _rule_mul,
+})
 
 
 def shape_rule_coverage() -> Dict[str, object]:

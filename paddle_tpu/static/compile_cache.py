@@ -121,12 +121,14 @@ def build_cache_key(program, seed: int, fetch_names: Sequence[str],
                     feed_arrays: Dict[str, Any], donated: Dict[str, Any],
                     carried: Dict[str, Any], donate: bool,
                     plan_fingerprint: Optional[str],
-                    entry: str = "") -> str:
+                    entry: str = "", passes: str = "") -> str:
     """SHA-256 key for one compiled step artifact (see module docstring for
     what is deliberately included).  ``entry`` is the Executor's entry-key
-    partition (serving shape buckets): it rides the key only when set, so
-    bucket-keyed artifacts never collide with the default entry's and
-    legacy keys are unchanged."""
+    partition (serving shape buckets); ``passes`` is the graph-rewrite
+    pipeline fingerprint (static/passes.py) the program was compiled under.
+    Each rides the key only when set, so bucket-keyed / pass-optimized
+    artifacts never collide with the default's and legacy keys are
+    unchanged."""
     import jax
     import jaxlib
 
@@ -152,6 +154,8 @@ def build_cache_key(program, seed: int, fetch_names: Sequence[str],
     )
     if entry:
         parts = parts + (f"entry={entry}",)
+    if passes:
+        parts = parts + (f"passes={passes}",)
     return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
 

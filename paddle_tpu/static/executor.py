@@ -223,7 +223,9 @@ def _trace_ops(program: Program, block_idx: int, ops, env, base_key,
             elif op.type == "static_rnn":
                 _lower_static_rnn(program, op, env, base_key)
             else:
-                _run_op_traced(op, env, base_key, _op_salt(block_idx, idx))
+                salt = op.rng_salt if getattr(op, "rng_salt", None) \
+                    is not None else _op_salt(block_idx, idx)
+                _run_op_traced(op, env, base_key, salt)
         if frozen:
             env.update(frozen)
 
@@ -644,7 +646,21 @@ class Executor:
                     from .shardcheck import check_with_plan as _check_plan
 
                     _check_plan(program, plan, feed_arrays)
-                seed = program.random_seed or _random_seed()
+                # verified graph-rewrite pipeline (static/passes.py):
+                # compile-path only — hot-path steps never re-enter this
+                # branch, and a verification failure rolls back to the
+                # caller's program, so the step always compiles
+                exec_program, passes_fp = program, ""
+                _opt = _flags.get_flag("opt_passes")
+                if _opt:
+                    from . import passes as _passes
+
+                    exec_program, passes_fp = _passes.optimize_for_executor(
+                        program, _opt, feed_names=set(feed_arrays),
+                        fetch_names=fetch_names, plan=plan,
+                        feed_arrays=feed_arrays)
+                    sp.set_attr("opt_passes", passes_fp or "rollback")
+                seed = exec_program.random_seed or _random_seed()
                 # persistent AOT cache (static/compile_cache.py): key the
                 # artifact by program content × mesh/plan × versions; a hit
                 # deserializes the compiled step instead of tracing it
@@ -654,13 +670,13 @@ class Executor:
                 disk_key = None
                 if disk is not None:
                     disk_key = _ccache.build_cache_key(
-                        program, seed, fetch_names, feed_arrays, d_state,
-                        p_state, donate,
+                        exec_program, seed, fetch_names, feed_arrays,
+                        d_state, p_state, donate,
                         plan.fingerprint() if plan is not None else None,
-                        entry=entry_key or "")
+                        entry=entry_key or "", passes=passes_fp)
                 (entry.compiled, entry.disk_cache, cost,
                  entry.aot) = self._build(
-                    program, fetch_names, entry.state_names, seed,
+                    exec_program, fetch_names, entry.state_names, seed,
                     plan=plan, feed_arrays=feed_arrays, donate=donate,
                     example=(feed_arrays, d_state, p_state, step_arg),
                     disk=disk, disk_key=disk_key)

@@ -127,6 +127,11 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # Stable PRNG salt: the Executor salts per-op randomness by (block,
+        # op index) unless this is set.  The pass manager stamps rewritten
+        # programs with each op's pre-rewrite index so random draws survive
+        # op insertion/removal (golden parity depends on it).
+        self.rng_salt: Optional[int] = None
 
     def input_names(self) -> List[str]:
         return [n for vs in self.inputs.values() for n in vs]
@@ -159,15 +164,17 @@ class Block:
     def create_var(self, name=None, shape=(), dtype="float32", **kw) -> Variable:
         name = name or unique_name("tmp")
         v = Variable(self, name, shape, dtype, **kw)
-        self.vars[name] = v
+        self.vars[name] = v  # proglint: raw-mutation-ok — Block IS the API
+        self.program._version += 1
         return v
 
     def create_parameter(self, name, shape, dtype="float32", trainable=True,
                          initializer=None, regularizer=None) -> Parameter:
         p = Parameter(self, name, shape, dtype, trainable, initializer,
                       regularizer)
-        self.vars[name] = p
+        self.vars[name] = p  # proglint: raw-mutation-ok — Block IS the API
         self.program._parameters[name] = p
+        self.program._version += 1
         return p
 
     def var(self, name: str) -> Variable:
@@ -189,9 +196,53 @@ class Block:
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None
                   ) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
-        self.ops.append(op)
+        self.ops.append(op)  # proglint: raw-mutation-ok — Block IS the API
         self.program._version += 1
         return op
+
+    # -- sanctioned structural mutation (the pass-manager API) --------------
+    # Every mutation bumps `program._version`: the analysis memo
+    # (check_program_cached), the shardcheck memo, and the Executor's hot
+    # cache are all version-keyed, so a mutated program can never be served
+    # a stale verdict or a stale executable.  Mutating `block.ops` directly
+    # bypasses that invalidation — proglint PL006 flags it.
+
+    def insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                  attrs=None) -> Operator:
+        """Insert an op at `index` (ref BlockDesc::InsertOp)."""
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)  # proglint: raw-mutation-ok
+        self.program._version += 1
+        return op
+
+    def remove_op(self, index: int) -> Operator:
+        """Remove and return the op at `index` (ref BlockDesc::RemoveOp)."""
+        op = self.ops.pop(index)  # proglint: raw-mutation-ok
+        self.program._version += 1
+        return op
+
+    def replace_op(self, index: int, type: str, inputs=None, outputs=None,
+                   attrs=None) -> Operator:
+        """Replace the op at `index` in place, preserving its position (and
+        therefore the PRNG salts of every other op)."""
+        op = Operator(self, type, inputs, outputs, attrs)
+        op.rng_salt = self.ops[index].rng_salt
+        self.ops[index] = op  # proglint: raw-mutation-ok
+        self.program._version += 1
+        return op
+
+    def set_ops(self, new_ops) -> None:
+        """Bulk-replace this block's op list — for whole-graph rewrites
+        that rebuild the list in one sweep (slim's quant passes)."""
+        self.ops = list(new_ops)  # proglint: raw-mutation-ok
+        self.program._version += 1
+
+    def remove_var(self, name: str) -> None:
+        """Drop a var from this block's table (dead-var elimination)."""
+        if name in self.vars:
+            del self.vars[name]  # proglint: raw-mutation-ok
+            self.program._parameters.pop(name, None)
+            self.program._version += 1
 
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
@@ -208,6 +259,14 @@ class Program:
         self.random_seed: Optional[int] = None
         self._current_block_idx = 0
 
+    def bump_version(self) -> int:
+        """Explicitly invalidate version-keyed caches (analysis memo,
+        shardcheck memo, Executor hot cache).  The Block mutation API calls
+        this path implicitly; passes that edit op slots/attrs in place must
+        call it themselves."""
+        self._version += 1
+        return self._version
+
     def global_block(self) -> Block:
         return self.blocks[0]
 
@@ -220,7 +279,7 @@ class Program:
         wrap callbacks with this."""
         parent = self._current_block_idx if parent_idx is None else parent_idx
         b = Block(self, len(self.blocks), parent)
-        self.blocks.append(b)
+        self.blocks.append(b)  # proglint: raw-mutation-ok — Program IS the API
         self._current_block_idx = b.idx
         self._version += 1
         return b
@@ -245,6 +304,7 @@ class Program:
         here."""
         import copy
         p = Program()
+        p.random_seed = self.random_seed
         b = p.global_block()
         src = self.global_block()
         for name, v in src.vars.items():
@@ -260,7 +320,8 @@ class Program:
             attrs = dict(op.attrs)
             if for_test and op.type in ("dropout", "batch_norm"):
                 attrs["is_test"] = True
-            b.append_op(op.type, op.inputs, op.outputs, attrs)
+            b.append_op(op.type, op.inputs, op.outputs,
+                        attrs).rng_salt = op.rng_salt
         return p
 
     def to_string(self, throw_on_error=False) -> str:

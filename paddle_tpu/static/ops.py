@@ -272,7 +272,8 @@ def _conv2d(ins, attrs, op):
                    stride=attrs.get("strides", 1),
                    padding=attrs.get("paddings", 0),
                    dilation=attrs.get("dilations", 1),
-                   groups=attrs.get("groups", 1))
+                   groups=attrs.get("groups", 1),
+                   data_format=attrs.get("data_format", "NCHW"))
     return {"Output": [out]}
 
 
@@ -280,17 +281,19 @@ def _conv2d(ins, attrs, op):
 def _pool2d(ins, attrs, op):
     x = _one(ins, "X")
     ptype = attrs.get("pooling_type", "max")
+    fmt = attrs.get("data_format", "NCHW")
     if attrs.get("global_pooling", False):
+        axes = (1, 2) if fmt == "NHWC" else (2, 3)
         out = (jnp.max if ptype == "max" else jnp.mean)(
-            x, axis=(2, 3), keepdims=True)
+            x, axis=axes, keepdims=True)
     elif attrs.get("adaptive", False):
         fn = (F.adaptive_max_pool2d if ptype == "max"
               else F.adaptive_avg_pool2d)
-        out = fn(x, attrs["ksize"])
+        out = fn(x, attrs["ksize"], data_format=fmt)
     else:
         fn = F.max_pool2d if ptype == "max" else F.avg_pool2d
         out = fn(x, attrs["ksize"], stride=attrs.get("strides", None),
-                 padding=attrs.get("paddings", 0))
+                 padding=attrs.get("paddings", 0), data_format=fmt)
     return {"Out": [out]}
 
 
@@ -1060,3 +1063,4 @@ from . import ops_tail4  # noqa: E402,F401 — batch-4 lowerings (registry side 
 from . import ops_tail5  # noqa: E402,F401 — batch-5 lowerings (registry side effects)
 from . import ops_tail6  # noqa: E402,F401 — batch-6 lowerings (registry side effects)
 from . import ops_tail7  # noqa: E402,F401 — batch-7 lowerings (registry side effects)
+from . import ops_fused  # noqa: E402,F401 — pass-emitted fused-op lowerings

@@ -1,0 +1,81 @@
+"""Lowerings for the fused ops emitted by the graph-rewrite passes.
+
+Reference parity: the `framework/ir` fusion passes materialize fused op
+types (conv_bn_fuse_pass -> conv2d with folded weights, fc_fuse_pass ->
+`fc`, conv_elementwise_add_act_fuse_pass -> `conv2d_fusion`).  Here the
+pass manager (static/passes.py) rewrites op *patterns* into these two op
+types; their lowerings fold at trace time, so XLA sees one region:
+
+- ``fused_conv2d_bn_act``: conv2d -> batch_norm(is_test) -> act collapsed
+  into one conv with BN folded INTO THE FILTER (``w' = w * a`` per output
+  channel, ``b' = conv_bias * a + b``) — the r05 per-activation a·x+b
+  hand-fold (nn/functional/norm.py bn_inference_scale_bias) promoted to a
+  weight-space fold: the scale multiplies O(C·k·k) filter values once
+  instead of riding every activation.
+- ``fused_matmul_bias_act``: mul -> elementwise_add(1-D bias) -> act (the
+  `fc`/transformer-MLP pattern, gelu included) as one op.
+
+Both lowerings reproduce the unfused op chain's math (same primitive
+sequence modulo the weight-space refactor), so golden parity holds bitwise
+for ints and within float tolerance for the BN fold.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.functional.norm import bn_inference_scale_bias
+from .registry import get_lowering, register_op
+from .ops import _one
+
+# Activations a fusion pattern may absorb: value-wise, attr-free in the
+# emitted-by-layers form, with a registered X->Out lowering.
+FUSABLE_ACTS = frozenset({
+    "relu", "gelu", "sigmoid", "tanh", "relu6", "silu", "swish",
+    "leaky_relu", "hard_swish", "softplus", "mish", "elu",
+})
+
+
+def _apply_act(out, act, attrs, op):
+    if not act:
+        return out
+    return get_lowering(act)({"X": [out]}, attrs, op)["Out"][0]
+
+
+@register_op("fused_conv2d_bn_act")
+def _fused_conv2d_bn_act(ins, attrs, op):
+    x = _one(ins, "Input")
+    w = _one(ins, "Filter")
+    conv_bias = _one(ins, "Bias")
+    a, b = bn_inference_scale_bias(
+        _one(ins, "Mean"), _one(ins, "Variance"),
+        _one(ins, "Scale"), _one(ins, "BnBias"),
+        attrs.get("epsilon", 1e-5))
+    # weight-space fold: scale each OUTPUT channel's filter (OIHW axis 0)
+    w = w * a.astype(w.dtype).reshape(-1, 1, 1, 1)
+    if conv_bias is not None:
+        b = b + conv_bias.astype(jnp.float32) * a
+    out = F.conv2d(x, w, bias=b.astype(x.dtype),
+                   stride=attrs.get("strides", 1),
+                   padding=attrs.get("paddings", 0),
+                   dilation=attrs.get("dilations", 1),
+                   groups=attrs.get("groups", 1),
+                   data_format=attrs.get("data_format", "NCHW"))
+    return {"Output": [_apply_act(out, attrs.get("act", ""), attrs, op)]}
+
+
+@register_op("fused_matmul_bias_act")
+def _fused_matmul_bias_act(ins, attrs, op):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    # identical math to the mul lowering (ops.py _mul)
+    x2 = x.reshape(int(np.prod(xs[:xd])), int(np.prod(xs[xd:])))
+    y2 = y.reshape(int(np.prod(ys[:yd])), int(np.prod(ys[yd:])))
+    out = (x2 @ y2).reshape(xs[:xd] + ys[yd:])
+    bias = _one(ins, "Bias")
+    if bias is not None:
+        out = out + bias          # 1-D bias broadcasts on the last axis
+    return {"Out": [_apply_act(out, attrs.get("act", ""), attrs, op)]}

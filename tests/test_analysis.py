@@ -502,7 +502,9 @@ def test_shape_rule_coverage_report():
     assert cov["registered"] >= 400
     assert cov["covered"] == cov["inference_rules"] or \
         cov["covered"] >= cov["inference_rules"]
-    assert cov["coverage"] >= 0.4          # the declared-coverage floor
+    # the declared-coverage RATCHET: currently ~60.8%; raise this floor
+    # when coverage grows, never lower it (PR 11 moved it 0.4 -> 0.55)
+    assert cov["coverage"] >= 0.55
     assert all(isinstance(n, str) for n in cov["uncovered"])
     # every covered op really is registered
     assert cov["covered"] + len(cov["uncovered"]) == cov["registered"]

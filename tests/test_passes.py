@@ -1,0 +1,563 @@
+"""Verified graph-rewrite pipeline (static/passes.py) + tools/passes CLI.
+
+Covers the PR-11 contract:
+  * every rewrite pass holds golden execution parity on a fixture that
+    actually exercises it (conv+BN+act fusion, matmul+bias+act fusion,
+    CSE, DCE, constant folding, NHWC layout propagation) and strictly
+    shrinks or fuses — never just reshuffles;
+  * an interface-breaking rewrite trips PV011 (both through the
+    PassManager and the standalone `verify_rewrite` checker) and the
+    Executor-facing `optimize_for_executor` rolls back instead of
+    shipping a broken program;
+  * RNG-bearing ops are pinned: their pre-rewrite salts survive op
+    renumbering, and CSE never merges two textually-identical random ops;
+  * the Executor behind `opt_passes` keeps one compile, zero steady-state
+    retraces, a working persistent compile cache (warm start re-traces
+    nothing), and the pipeline fingerprint rides the cache key;
+  * the `check_program_cached` memo invalidates through the sanctioned
+    mutation API (`set_ops`/`remove_op`/...);
+  * proglint PL006 flags raw Program mutation outside that API and the
+    repo self-lints clean;
+  * `python -m tools.passes --selfcheck` passes in a child process.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core import errors, flags
+from paddle_tpu.static import layers as L
+from paddle_tpu.static import passes as P
+from paddle_tpu.static.control_flow import cond, less_than
+from paddle_tpu.utils import monitor
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = flags.get_flags(["donate_state", "metrics", "compile_cache_dir",
+                             "opt_passes"])
+    yield
+    flags.set_flags(saved)
+
+
+def _init_state(startup):
+    """Run startup in a throwaway scope; return {name: ndarray}."""
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        static.Executor().run(startup)
+        return {k: np.asarray(scope.find_var(k)) for k in scope.keys()}
+
+
+def _img_feed(shape=(4, 3, 8, 8), seed=0):
+    return np.random.default_rng(seed).normal(0, 1, shape).astype(np.float32)
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+# ---------------------------------------------------------------------------
+# analyses: use-def chains and liveness
+# ---------------------------------------------------------------------------
+
+def test_use_def_chains_and_liveness(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    a = L.scale(x, 2.0)
+    dead = L.scale(a, 3.0)                    # never reaches the fetch
+    out = L.scale(a, -1.0)
+
+    blk = main.global_block()
+    defs, uses = P.use_def_chains(blk)
+    assert [i for i, _slot in defs[a.name]] == [0]
+    assert {i for i, _slot in uses[a.name]} == {1, 2}
+
+    live_ops, live_after = P.liveness(blk, [out.name])
+    assert live_ops[0] and live_ops[2]
+    assert not live_ops[1]                    # the dead scale
+    assert dead.name not in live_after[len(blk.ops) - 1]
+
+
+# ---------------------------------------------------------------------------
+# golden-parity fixtures, one per rewrite pass
+# ---------------------------------------------------------------------------
+
+def test_fuse_conv_bn_act_golden_parity(_fresh_programs):
+    main, startup = _fresh_programs
+    img = L.data("img", [3, 8, 8])
+    c = L.conv2d(img, 4, 3, padding=1)
+    out = L.batch_norm(c, act="relu", is_test=True)
+
+    rewritten, report = P.PassManager(("fuse_conv_bn_act",)).apply(
+        main, feed_names={"img"}, fetch_names=[out.name])
+    assert "fused_conv2d_bn_act" in _op_types(rewritten)
+    assert "batch_norm" not in _op_types(rewritten)
+    assert report.ops_after < report.ops_before
+    # apply() clones — the original keeps its hand-written form
+    assert "batch_norm" in _op_types(main)
+
+    parity = P.golden_parity(main, rewritten, {"img": _img_feed()},
+                             [out.name], state=_init_state(startup),
+                             rtol=1e-4, atol=1e-5)
+    assert parity.ok, parity.to_text()
+
+
+def test_fuse_matmul_bias_act_golden_parity(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [8])
+    out = L.fc(x, 16, act="gelu")
+
+    rewritten, report = P.PassManager(("fuse_matmul_bias_act",)).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert "fused_matmul_bias_act" in _op_types(rewritten)
+    assert "mul" not in _op_types(rewritten)
+    assert report.ops_after < report.ops_before
+
+    feed = {"x": np.random.default_rng(1).normal(
+        0, 1, (4, 8)).astype(np.float32)}
+    parity = P.golden_parity(main, rewritten, feed, [out.name],
+                             state=_init_state(startup),
+                             rtol=1e-4, atol=1e-5)
+    assert parity.ok, parity.to_text()
+
+
+def test_cse_dce_golden_parity(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    d1 = L.scale(x, 2.0)
+    d2 = L.scale(x, 2.0)                      # duplicate subexpression
+    merged = L.elementwise_add(d1, d2)
+    dead = L.scale(merged, 3.0)               # never fetched
+    out = L.scale(merged, -1.0)
+
+    rewritten, report = P.PassManager(("cse", "dce")).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert report.ops_after < report.ops_before
+    assert _op_types(rewritten).count("scale") == 2   # one dup + dead gone
+    # DCE sweeps the dead op's output var from the block's var table
+    with pytest.raises(KeyError):
+        rewritten.global_block().var(dead.name)
+
+    feed = {"x": np.random.default_rng(2).normal(
+        0, 1, (4, 4)).astype(np.float32)}
+    parity = P.golden_parity(main, rewritten, feed, [out.name],
+                             state=_init_state(startup))
+    assert parity.ok, parity.to_text()
+
+
+def test_constant_folding_golden_parity(_fresh_programs):
+    main, startup = _fresh_programs
+    x = L.data("x", [4])
+    base = L.fill_constant([1], "float32", 2.0)
+    off = L.scale(base, 0.5)                  # foldable to a constant 1.0
+    out = L.elementwise_add(x, off)
+
+    rewritten, report = P.PassManager(("constant_folding", "dce")).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert "scale" not in _op_types(rewritten)
+    assert "assign_value" in _op_types(rewritten)
+    assert report.ops_after < report.ops_before
+
+    feed = {"x": np.random.default_rng(3).normal(
+        0, 1, (2, 4)).astype(np.float32)}
+    parity = P.golden_parity(main, rewritten, feed, [out.name],
+                             state=_init_state(startup))
+    assert parity.ok, parity.to_text()
+
+
+def test_layout_nhwc_golden_parity(_fresh_programs):
+    main, startup = _fresh_programs
+    img = L.data("img", [3, 8, 8])
+    c = L.conv2d(img, 4, 3, padding=1, act="relu")
+    out = L.pool2d(c, 2)
+
+    rewritten, _report = P.PassManager(("layout_nhwc",)).apply(
+        main, feed_names={"img"}, fetch_names=[out.name])
+    blk = rewritten.global_block()
+    convs = [op for op in blk.ops if op.type == "conv2d"]
+    assert convs and all(
+        op.attrs.get("data_format") == "NHWC" for op in convs)
+    # the conv->pool chain shares one layout region: interior transpose
+    # pairs cancel, only the boundary transposes remain
+    assert _op_types(rewritten).count("transpose2") == 2
+
+    parity = P.golden_parity(main, rewritten, {"img": _img_feed(seed=4)},
+                             [out.name], state=_init_state(startup),
+                             rtol=1e-4, atol=1e-5)
+    assert parity.ok, parity.to_text()
+
+
+def test_default_pipeline_end_to_end(_fresh_programs):
+    """The whole DEFAULT_PIPELINE over a net with every pattern seeded."""
+    main, startup = _fresh_programs
+    img = L.data("img", [3, 8, 8])
+    b = L.batch_norm(L.conv2d(img, 4, 3, padding=1), act="relu",
+                     is_test=True)
+    flat = L.flatten(L.pool2d(b, 2))
+    h = L.fc(flat, 8, act="gelu")
+    d1, d2 = L.scale(h, 2.0), L.scale(h, 2.0)
+    merged = L.elementwise_add(d1, d2)
+    L.scale(merged, 3.0)                      # dead
+    out = L.elementwise_add(merged, L.scale(
+        L.fill_constant([1], "float32", 2.0), 0.5))
+
+    rewritten, report = P.PassManager(P.DEFAULT_PIPELINE).apply(
+        main, feed_names={"img"}, fetch_names=[out.name])
+    types = _op_types(rewritten)
+    assert "fused_conv2d_bn_act" in types
+    assert "fused_matmul_bias_act" in types
+    assert report.ops_after < report.ops_before
+
+    parity = P.golden_parity(main, rewritten, {"img": _img_feed(seed=5)},
+                             [out.name], state=_init_state(startup),
+                             rtol=1e-4, atol=1e-5)
+    assert parity.ok, parity.to_text()
+
+
+# ---------------------------------------------------------------------------
+# RNG pinning: salts survive renumbering, CSE never merges random ops
+# ---------------------------------------------------------------------------
+
+def test_rng_salts_survive_dce_renumbering(_fresh_programs):
+    """DCE removes an op BEFORE a dropout, shifting its index; the
+    pre-rewrite salt stamp must keep the dropout's mask bitwise stable."""
+    main, startup = _fresh_programs
+    main.random_seed = 7
+    x = L.data("x", [64])
+    L.scale(x, 3.0)                           # dead, precedes the dropout
+    out = L.dropout(L.scale(x, 1.0), 0.5)
+
+    rewritten, _ = P.PassManager(("dce",)).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert len(rewritten.global_block().ops) < len(main.global_block().ops)
+    drop = next(op for op in rewritten.global_block().ops
+                if op.type == "dropout")
+    assert getattr(drop, "rng_salt", None) is not None
+
+    feed = {"x": np.random.default_rng(6).normal(
+        0, 1, (8, 64)).astype(np.float32)}
+    parity = P.golden_parity(main, rewritten, feed, [out.name],
+                             state=_init_state(startup), rtol=0.0, atol=0.0)
+    assert parity.ok, parity.to_text()
+
+
+def test_cse_never_merges_random_ops(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [16])
+    a = L.dropout(x, 0.5)
+    b = L.dropout(x, 0.5)                     # textually identical, distinct
+    out = L.elementwise_add(a, b)
+
+    rewritten, _ = P.PassManager(("cse",)).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert _op_types(rewritten).count("dropout") == 2
+
+
+# ---------------------------------------------------------------------------
+# VerifiedRewrite: PV011 + rollback
+# ---------------------------------------------------------------------------
+
+def test_verify_rewrite_pv011_on_broken_interface(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    out = L.scale(L.scale(x, 2.0), -1.0)
+
+    broken = main.clone()
+    blk = broken.global_block()
+    blk.remove_op(len(blk.ops) - 1)           # drop the fetch producer
+    with pytest.raises(errors.ProgramVerificationError, match="PV011") as ei:
+        P.verify_rewrite(main, broken, feed_names={"x"},
+                         fetch_names=[out.name])
+    assert any(d.code == "PV011" for d in ei.value.diagnostics)
+
+    # an honest no-op rewrite verifies clean
+    P.verify_rewrite(main, main.clone(), feed_names={"x"},
+                     fetch_names=[out.name])
+
+
+class _BreakFetchPass(P.Pass):
+    name = "break_fetch"
+
+    def run(self, program, ctx):
+        blk = program.global_block()
+        blk.remove_op(len(blk.ops) - 1)
+        return {"changed": True}
+
+
+def test_bad_pass_raises_and_executor_path_rolls_back(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    out = L.scale(L.scale(x, 2.0), -1.0)
+
+    P._REGISTRY["break_fetch"] = _BreakFetchPass()
+    try:
+        pm = P.PassManager(("break_fetch",))
+        with pytest.raises(errors.ProgramVerificationError, match="PV011"):
+            pm.apply(main, feed_names={"x"}, fetch_names=[out.name])
+        # the Executor-facing wrapper must swallow + roll back, not raise
+        prog, fp = P.optimize_for_executor(main, "break_fetch", {"x"},
+                                           [out.name])
+        assert prog is main and fp == ""
+    finally:
+        del P._REGISTRY["break_fetch"]
+
+
+def test_multiblock_program_is_skipped(_fresh_programs):
+    main, _ = _fresh_programs
+    x = L.data("x", [2])
+    pred = less_than(L.reduce_sum(x), L.fill_constant([1], "float32", 0.0))
+    out = cond(pred,
+               lambda: L.scale(x, scale=2.0),
+               lambda: L.scale(x, scale=-1.0))
+
+    prog, report = P.PassManager(P.DEFAULT_PIPELINE).apply(
+        main, feed_names={"x"}, fetch_names=[out.name])
+    assert prog is main                       # returned untouched, unclonned
+    assert report.skipped
+    assert report.ops_after == report.ops_before
+
+
+def test_pipeline_from_flag_parsing():
+    assert P.pipeline_from_flag("") is None
+    assert P.pipeline_from_flag(None) is None
+    assert P.pipeline_from_flag("default").pass_names == P.DEFAULT_PIPELINE
+    assert P.pipeline_from_flag("1").pass_names == P.DEFAULT_PIPELINE
+    assert P.pipeline_from_flag("cse, dce").pass_names == ("cse", "dce")
+    with pytest.raises(ValueError, match="unknown pass"):
+        P.pipeline_from_flag("cse,no_such_pass")
+    assert set(P.DEFAULT_PIPELINE) <= set(P.available_passes())
+
+
+# ---------------------------------------------------------------------------
+# Executor integration: fingerprint in the cache key, zero retraces,
+# persistent-cache warm start
+# ---------------------------------------------------------------------------
+
+def _build_net(seed: int = 7):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with static.program_guard(main, startup):
+        x = L.data("x", [8])
+        y = L.data("y", [1])
+        pred = L.fc(L.fc(x, 16, act="relu"), 1)
+        loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+        static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(batch: int = 16):
+    rng = np.random.default_rng(3)
+    return {"x": rng.normal(size=(batch, 8)).astype(np.float32),
+            "y": rng.normal(size=(batch, 1)).astype(np.float32)}
+
+
+def _train(main, startup, loss, steps: int = 5):
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        out = [exe.run(main, feed=feed, fetch_list=[loss],
+                       return_numpy=False)[0] for _ in range(steps)]
+        return [float(np.asarray(v)) for v in out]
+
+
+def test_cache_key_carries_pipeline_fingerprint():
+    from paddle_tpu.static import compile_cache as cc
+
+    main, _startup, loss = _build_net()
+    feed = _feed(4)
+    common = dict(seed=7, fetch_names=[loss.name], feed_arrays=feed,
+                  donated={}, carried={}, donate=False,
+                  plan_fingerprint=None)
+    base = cc.build_cache_key(main, **common)
+    fp = P.PassManager(P.DEFAULT_PIPELINE).fingerprint()
+    assert cc.build_cache_key(main, **common, passes=fp) != base
+    # empty fingerprint leaves legacy keys byte-identical
+    assert cc.build_cache_key(main, **common, passes="") == base
+
+
+def test_executor_opt_passes_zero_steady_state_retraces(_flags_guard):
+    """Acceptance: opt_passes must not break the steady-state fast path —
+    one compile, zero retraces after the first step, and the optimized
+    run matches the unoptimized one."""
+    flags.set_flags({"metrics": True, "opt_passes": ""})
+    baseline = _train(*_build_net(seed=7))
+
+    flags.set_flags({"opt_passes": "default"})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        runs0 = reg.get("passes.runs").value() \
+            if reg.get("passes.runs") is not None else 0
+        miss0 = reg.get("executor.cache_miss").value()
+        losses = [float(np.asarray(exe.run(
+            main, feed=feed, fetch_list=[loss], return_numpy=False)[0]))]
+        traces1 = reg.get("executor.traces").value()
+        for _ in range(4):
+            losses.append(float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss],
+                return_numpy=False)[0])))
+        assert reg.get("executor.cache_miss").value() - miss0 == 1
+        assert reg.get("executor.traces").value() == traces1
+        assert reg.get("passes.runs").value() - runs0 >= 1
+    np.testing.assert_allclose(losses, baseline, rtol=1e-4, atol=1e-5)
+
+
+def _cc_counters(reg):
+    def val(name):
+        m = reg.get(name)
+        return m.value() if m is not None else 0
+    return (val("executor.compile_cache_hit"),
+            val("executor.compile_cache_miss"),
+            val("executor.traces"))
+
+
+def test_compile_cache_warm_start_under_opt_passes(_flags_guard, tmp_path):
+    """Acceptance: the persistent AOT cache round-trips the OPTIMIZED
+    program (the pipeline fingerprint rides the key) and a warm run
+    deserializes without re-tracing the pass pipeline's output."""
+    flags.set_flags({"metrics": True, "opt_passes": "default",
+                     "compile_cache_dir": str(tmp_path)})
+    reg = monitor.default_registry()
+    main, startup, loss = _build_net(seed=7)
+
+    cold = _train(main, startup, loss)
+    assert sorted(tmp_path.glob("*.pdtc")), "cold run stored no executables"
+    h0, _m0, t0 = _cc_counters(reg)
+    warm = _train(main, startup, loss)        # fresh Executor, same program
+    h1, _m1, t1 = _cc_counters(reg)
+    assert warm == cold                       # bitwise: same executable
+    assert h1 - h0 >= 1
+    assert t1 - t0 == 0                       # warm start never re-traces
+
+
+def test_rewritten_fingerprint_is_deterministic(_fresh_programs):
+    """Pass-minted var names must not draw from the process-global
+    unique_name counter: two pipeline runs over the same program must
+    produce byte-identical fingerprints, or the compile-cache key drifts
+    and a warm start silently misses."""
+    from paddle_tpu.static import compile_cache as cc
+
+    main, _ = _fresh_programs
+    img = L.data("img", [3, 8, 8])
+    c = L.conv2d(img, 4, 3, padding=1, act="relu")
+    out = L.pool2d(c, 2)
+
+    pm = P.PassManager(P.DEFAULT_PIPELINE)
+    r1, _ = pm.apply(main, feed_names={"img"}, fetch_names=[out.name])
+    r2, _ = pm.apply(main, feed_names={"img"}, fetch_names=[out.name])
+    assert r1 is not r2
+    assert cc.program_fingerprint(r1) == cc.program_fingerprint(r2)
+
+
+# ---------------------------------------------------------------------------
+# check_program_cached memo vs the sanctioned mutation API
+# ---------------------------------------------------------------------------
+
+def test_mutation_api_invalidates_check_memo(_fresh_programs, _flags_guard):
+    flags.set_flags({"metrics": True})
+    main, _ = _fresh_programs
+    x = L.data("x", [4])
+    loss = L.mean(L.fc(x, 2))
+    reg = monitor.default_registry()
+
+    static.check_program_cached(main, feed_names={"x"},
+                                fetch_names=[loss.name])
+    c = reg.get("analysis.programs_checked")
+    base = c.value()
+    static.check_program_cached(main, feed_names={"x"},
+                                fetch_names=[loss.name])
+    assert c.value() == base                  # pure memo hit
+
+    blk = main.global_block()
+    v0 = main._version
+    blk.set_ops(list(blk.ops))                # bulk-replace bumps version
+    assert main._version > v0
+    static.check_program_cached(main, feed_names={"x"},
+                                fetch_names=[loss.name])
+    assert c.value() == base + 1              # stale memo -> fresh walk
+
+    # a mutation that BREAKS the program gets a fresh (failing) verdict,
+    # not yesterday's cached pass
+    blk.remove_op(0)                          # later ops now read undefined
+    with pytest.raises(errors.ProgramVerificationError):
+        static.check_program_cached(main, feed_names={"x"},
+                                    fetch_names=[loss.name])
+
+
+# ---------------------------------------------------------------------------
+# proglint PL006: raw graph mutation outside the pass-manager API
+# ---------------------------------------------------------------------------
+
+def test_pl006_flags_raw_mutation(tmp_path):
+    from tools import proglint
+
+    src = textwrap.dedent("""\
+        def rewrite(block, program):
+            block.ops.append(make_op())
+            block.ops[0] = other
+            del block.ops[1]
+            block.ops = []
+            program._version += 1
+            program.blocks.pop()
+            block.ops.insert(0, op)  # proglint: raw-mutation-ok
+            n = len(block.ops)
+            for op in block.ops:
+                use(op)
+    """)
+    bad = tmp_path / "bad_rewrite.py"
+    bad.write_text(src)
+    violations = proglint.lint_raw_mutation(bad)
+    assert len(violations) == 6
+    assert all(v.code == "PL006" for v in violations)
+    assert {v.line for v in violations} == {2, 3, 4, 5, 6, 7}
+
+    # framework.py IS the mutation API — always exempt
+    fw = tmp_path / "framework.py"
+    fw.write_text(src)
+    assert proglint.lint_raw_mutation(fw) == []
+
+
+def test_pl006_repo_self_lint_clean():
+    from tools import proglint
+
+    targets = proglint.mutation_targets()
+    assert targets, "PL006 target glob matched nothing"
+    assert any(p.name == "passes.py" for p in targets)
+    bad = [str(v) for p in targets for v in proglint.lint_raw_mutation(p)]
+    assert bad == [], "\n".join(bad)
+
+
+# ---------------------------------------------------------------------------
+# the CLI selfcheck rides tier-1
+# ---------------------------------------------------------------------------
+
+def test_passes_cli_selfcheck():
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, "-m", "tools.passes", "--selfcheck"],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=570)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "passes selfcheck: OK" in r.stdout

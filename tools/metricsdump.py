@@ -69,6 +69,12 @@ _KNOWN_NAMES = frozenset({
     # io/prefetch.py
     "io.prefetch_batches",
     "io.prefetch_depth",
+    # static/passes.py (graph-rewrite pipeline)
+    "passes.ops_fused",
+    "passes.ops_removed",
+    "passes.pipeline_ms",
+    "passes.rollbacks",
+    "passes.runs",
     # distributed/ps_server.py
     "ps.heartbeat_age_seconds",
     "ps.rpc_count",
@@ -139,6 +145,7 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.static.shardcheck  # noqa: F401 — analysis.plans_checked
     import paddle_tpu.static.compile_cache  # noqa: F401
     import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
+    import paddle_tpu.static.passes  # noqa: F401 — the passes.* family
     import paddle_tpu.utils.debug  # noqa: F401
     import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
     from paddle_tpu.hapi.callbacks import MetricsLogger

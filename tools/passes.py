@@ -1,0 +1,221 @@
+"""passes — CLI front-end for the verified graph-rewrite pipeline.
+
+Runs the ``static/passes.py`` pass manager over a Program and prints a
+per-pass diff report (op counts, fusions, transposes cancelled).  Every
+rewrite runs under the VerifiedRewrite contract: the fetch interface is
+proven preserved (PV011 on violation) and the full program checker re-runs
+on the result; ``--verify`` additionally executes original vs rewritten
+with identical feeds/state and compares fetches (bitwise for ints,
+tolerance for floats).
+
+Usage::
+
+    python -m tools.passes                      # demo inference net, report
+    python -m tools.passes --verify             # + execution golden parity
+    python -m tools.passes --pipeline cse,dce   # a specific pass list
+    python -m tools.passes --model DIR          # a saved inference model
+    python -m tools.passes --format json
+    python -m tools.passes --selfcheck          # CI probe (rides tier-1)
+
+Without ``--model`` the CLI runs against a built-in demo: a small
+inference-mode conv+BN+relu / fc+gelu tower (the exact patterns the fusion
+passes target) with a duplicated subexpression and a dead branch seeded so
+constant folding, CSE, and DCE all have work to do.  ``--selfcheck``
+asserts the pipeline fuses both patterns, strictly shrinks the op count,
+holds golden parity, and that a deliberately interface-breaking rewrite
+trips PV011 — then prints ``passes selfcheck: OK``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _build_demo():
+    """(main, startup, feed, fetch_names): inference conv tower with
+    fusible patterns plus dead/duplicate ops for the cleanup passes."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = L.data("img", [3, 16, 16])
+        c1 = L.conv2d(img, 8, 3, padding=1)
+        b1 = L.batch_norm(c1, act="relu", is_test=True)
+        p1 = L.pool2d(b1, 2)
+        flat = L.flatten(p1)
+        h = L.fc(flat, 32, act="gelu")
+        d1 = L.scale(h, 2.0)
+        d2 = L.scale(h, 2.0)                 # duplicate subexpression
+        merged = L.elementwise_add(d1, d2)
+        L.scale(merged, 3.0)                 # dead: never fetched
+        base = L.fill_constant([1], "float32", 2.0)
+        off = L.scale(base, 0.5)             # constant-foldable
+        out = L.elementwise_add(L.fc(merged, 10), off)
+    feed = {"img": np.random.default_rng(0).normal(
+        0, 1, (4, 3, 16, 16)).astype(np.float32)}
+    return main, startup, feed, [out.name]
+
+
+def _demo_feed_for(program, feed_names, batch=4):
+    """Random feeds shaped from the program's data vars (-1 -> batch)."""
+    rng = np.random.default_rng(0)
+    block = program.global_block()
+    feed = {}
+    for name in feed_names:
+        v = block.var(name)
+        shape = tuple(batch if d == -1 else int(d) for d in v.shape)
+        dt = np.dtype(v.dtype)
+        if dt.kind in ("i", "u"):
+            feed[name] = rng.integers(0, 2, shape).astype(dt)
+        else:
+            feed[name] = rng.normal(0, 1, shape).astype(dt)
+    return feed
+
+
+def _run(program, startup, feed, fetch_names, pipeline, verify,
+         scope=None):
+    """Apply the pipeline; returns (report, parity|None, rewritten)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import passes as P
+
+    pm = P.PassManager(pipeline)
+    rewritten, report = pm.apply(program, feed_names=set(feed),
+                                 fetch_names=fetch_names)
+    parity = None
+    if verify:
+        if scope is None:
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                if startup is not None:
+                    static.Executor().run(startup)
+        state = {k: np.asarray(scope.find_var(k)) for k in scope.keys()}
+        parity = P.golden_parity(program, rewritten, feed, fetch_names,
+                                 state=state, rtol=1e-4, atol=1e-5)
+    return report, parity, rewritten
+
+
+def selfcheck() -> int:
+    """Assert the default pipeline earns its keep on the demo net and that
+    verification actually rejects a broken rewrite.  Non-zero exit on any
+    deviation — rides tier-1 via subprocess."""
+    from paddle_tpu.static import passes as P
+
+    main, startup, feed, fetch_names = _build_demo()
+    report, parity, rewritten = _run(main, startup, feed, fetch_names,
+                                     P.DEFAULT_PIPELINE, verify=True)
+    print(report.to_text())
+    types = [op.type for op in rewritten.global_block().ops]
+    problems = []
+    if "fused_conv2d_bn_act" not in types:
+        problems.append("conv+BN+act did not fuse")
+    if "fused_matmul_bias_act" not in types:
+        problems.append("matmul+bias+act did not fuse")
+    if report.ops_after >= report.ops_before:
+        problems.append(f"op count did not shrink "
+                        f"({report.ops_before} -> {report.ops_after})")
+    if parity is None or not parity.ok:
+        problems.append("golden parity failed: "
+                        + (parity.to_text() if parity else "no report"))
+
+    # a rewrite that breaks the fetch interface must trip PV011
+    broken = main.clone()
+    blk = broken.global_block()
+    blk.remove_op(len(blk.ops) - 1)          # drop the fetch producer
+    try:
+        P.verify_rewrite(main, broken, feed_names=set(feed),
+                         fetch_names=fetch_names)
+        problems.append("PV011 did not fire on an interface-breaking "
+                        "rewrite")
+    except Exception as e:
+        if "PV011" not in str(e):
+            problems.append(f"broken rewrite raised without PV011: {e!r}")
+
+    if problems:
+        for p in problems:
+            print(f"passes selfcheck: {p}", file=sys.stderr)
+        return 1
+    print(parity.to_text())
+    print("passes selfcheck: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.passes", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--pipeline", default="default",
+                        help="comma-separated pass list, or 'default'")
+    parser.add_argument("--verify", action="store_true",
+                        help="execute original vs rewritten and compare "
+                        "(bitwise ints / tolerance floats)")
+    parser.add_argument("--model", default=None, metavar="DIR",
+                        help="run over a saved inference model instead of "
+                        "the built-in demo")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="CI probe: assert fusions, parity, and PV011 "
+                        "on the built-in demo")
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+
+    from paddle_tpu.static import passes as P
+
+    pipeline = (P.DEFAULT_PIPELINE if args.pipeline in ("default", "1", "")
+                else tuple(s.strip() for s in args.pipeline.split(",")
+                           if s.strip()))
+
+    scope = None
+    startup = None
+    if args.model:
+        import paddle_tpu.static as static
+
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            program, feed_names, fetch_names = static.load_inference_model(
+                args.model, static.Executor())
+        feed = _demo_feed_for(program, feed_names)
+    else:
+        program, startup, feed, fetch_names = _build_demo()
+
+    report, parity, rewritten = _run(program, startup, feed, fetch_names,
+                                     pipeline, args.verify, scope=scope)
+
+    if args.format == "json":
+        payload = {
+            "fingerprint": report.fingerprint,
+            "ops_before": report.ops_before,
+            "ops_after": report.ops_after,
+            "elapsed_ms": report.elapsed_ms,
+            "skipped": report.skipped,
+            "passes": [{"name": p.name, "changed": p.changed,
+                        "ops_before": p.ops_before,
+                        "ops_after": p.ops_after,
+                        "stats": {k: v for k, v in p.stats.items()
+                                  if k != "changed"}}
+                       for p in report.passes],
+            "parity": None if parity is None else {
+                "ok": parity.ok, "max_abs_err": parity.max_abs_err,
+                "state_max_err": parity.state_max_err,
+                "message": parity.message},
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(report.to_text())
+        print("rewritten ops: "
+              + " ".join(op.type for op in rewritten.global_block().ops))
+        if parity is not None:
+            print(parity.to_text())
+    if parity is not None and not parity.ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

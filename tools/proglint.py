@@ -34,11 +34,26 @@ trace-time heisenbugs.  Checks:
   static-shape-contract site is waived with a ``# proglint: host-sync-ok``
   comment on the same line.
 
+- ``PL006`` raw Program graph mutation outside the sanctioned Block/
+  Program API: calling list mutators (append/insert/pop/remove/clear/
+  extend/sort/reverse) on a ``.ops``/``.blocks`` attribute, assigning or
+  ``del``-ing into them, rebinding them, or writing ``._version``
+  directly.  Every sanctioned mutation (framework.py append_op/insert_op/
+  remove_op/replace_op/set_ops/remove_var/bump_version) bumps
+  ``Program._version``, which keys the analysis memo, the shardcheck
+  memo, and the Executor's hot cache — a raw mutation silently serves
+  stale verdicts and stale executables.  framework.py itself (the API) is
+  exempt; a deliberate site is waived with ``# proglint: raw-mutation-ok``
+  on the same line.  This check scans the whole static-graph surface
+  (``paddle_tpu/static/``, ``paddle_tpu/slim/``, ``tools/``), not just
+  lowering modules.
+
 CLI:  ``python -m tools.proglint [files...]`` — defaults to every
-``paddle_tpu/static/ops*.py`` in the repo; exits 0 when clean, 1 when any
-violation is found.  Dependency-free: op_coverage.py is exec'd standalone
-(it is a pure data module) rather than imported through the package, so
-the lint runs without jax.
+``paddle_tpu/static/ops*.py`` in the repo for PL001–PL005 plus the
+static-graph surface for PL006; exits 0 when clean, 1 when any violation
+is found.  Dependency-free: op_coverage.py is exec'd standalone (it is a
+pure data module) rather than imported through the package, so the lint
+runs without jax.
 """
 from __future__ import annotations
 
@@ -248,6 +263,81 @@ def _check_host_sync(path: str, fn, aliases: Dict[str, str], lines,
             f"`# {_HOST_SYNC_WAIVER}`)"))
 
 
+_RAW_MUTATION_WAIVER = "proglint: raw-mutation-ok"
+_MUTATING_LIST_METHODS = frozenset((
+    "append", "insert", "pop", "remove", "clear", "extend", "sort",
+    "reverse"))
+_GRAPH_ATTRS = ("ops", "blocks")
+
+
+def _is_graph_list(expr) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr in _GRAPH_ATTRS
+
+
+def _check_raw_mutation(path: str, tree: ast.Module, lines,
+                        out: List[Violation]) -> None:
+    """PL006: Program graph state must change through the sanctioned
+    mutation API so ``Program._version`` tracks every change."""
+
+    def flag(node, what: str) -> None:
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _RAW_MUTATION_WAIVER in line:
+            return
+        out.append(Violation(
+            path, node.lineno, "PL006",
+            f"{what} bypasses the Block/Program mutation API — "
+            "program._version will not track the change, so the analysis "
+            "memo, shardcheck memo, and Executor hot cache go stale "
+            "(use append_op/insert_op/remove_op/replace_op/set_ops/"
+            "remove_var/bump_version, or waive a deliberate site with "
+            f"`# {_RAW_MUTATION_WAIVER}`)"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATING_LIST_METHODS
+                    and _is_graph_list(f.value)):
+                flag(node, f"`.{f.value.attr}.{f.attr}()`")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and _is_graph_list(t.value):
+                    flag(node, f"item assignment into `.{t.value.attr}`")
+                elif isinstance(t, ast.Attribute) and t.attr in _GRAPH_ATTRS:
+                    flag(node, f"rebinding `.{t.attr}`")
+                elif isinstance(t, ast.Attribute) and t.attr == "_version":
+                    flag(node, "a direct `._version` write")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and _is_graph_list(t.value):
+                    flag(node, f"`del` on `.{t.value.attr}`")
+
+
+def lint_raw_mutation(path) -> List[Violation]:
+    """Run only the PL006 check over one file (any static-graph module,
+    not just lowerings).  framework.py is the API itself — exempt."""
+    path = Path(path)
+    if path.name == "framework.py":
+        return []
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    out: List[Violation] = []
+    _check_raw_mutation(str(path), tree, source.splitlines(), out)
+    return out
+
+
+def mutation_targets() -> List[Path]:
+    """The static-graph surface PL006 scans by default: everywhere
+    Programs are built or rewritten."""
+    out = []
+    for pattern in ("paddle_tpu/static/**/*.py", "paddle_tpu/slim/**/*.py",
+                    "tools/*.py"):
+        out.extend(REPO_ROOT.glob(pattern))
+    return sorted(p for p in out if p.name != "framework.py")
+
+
 def _own_statements(fn: ast.FunctionDef):
     """Walk fn's statements WITHOUT descending into nested function defs
     (a nested helper's returns are not the lowering's returns)."""
@@ -313,12 +403,22 @@ def default_targets() -> List[Path]:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    targets = [Path(a) for a in argv] or default_targets()
-    violations = lint_paths(targets)
+    if argv:
+        targets = [Path(a) for a in argv]
+        violations = lint_paths(targets)
+        for p in targets:
+            violations.extend(lint_raw_mutation(p))
+        n_files = len(targets)
+    else:
+        ops_targets = default_targets()
+        mt = mutation_targets()
+        violations = lint_paths(ops_targets)
+        for p in mt:
+            violations.extend(lint_raw_mutation(p))
+        n_files = len(set(ops_targets) | set(mt))
     for v in violations:
         print(v)
-    print(f"proglint: {len(targets)} file(s), {len(violations)} "
-          f"violation(s)")
+    print(f"proglint: {n_files} file(s), {len(violations)} violation(s)")
     return 1 if violations else 0
 
 
