@@ -157,6 +157,20 @@ define_flag("opt_passes", "", "Verified graph-rewrite pass pipeline applied "
             "misses, so steady-state steps and warm starts never pay for it "
             "(ref: the framework/ir fusion/optimization pass stage, run by "
             "the inference analysis predictor before execution).")
+define_flag("elastic_save_every", 0, "Periodic elastic checkpointing in "
+            "hapi Model.fit: every N global train steps the params + "
+            "optimizer state are written as a resharding-capable manifest "
+            "checkpoint (elastic/checkpoint.py) under elastic_ckpt_dir.  "
+            "0 (default): off.  Set by fleet.DistributedStrategy's "
+            "ElasticConfig, or directly (ref: the fleet elastic "
+            "checkpoint cadence).")
+define_flag("elastic_ckpt_dir", "", "Directory for the periodic elastic "
+            "checkpoints Model.fit writes when elastic_save_every > 0; a "
+            "restarted or resharded job resumes via "
+            "elastic.restore_model / elastic.restore_checkpoint.")
+define_flag("elastic_keep_last", 2, "How many elastic step checkpoints to "
+            "retain under elastic_ckpt_dir (older step directories are "
+            "garbage-collected after each save).")
 define_flag("check_sharding", True, "Statically verify Program x "
             "ShardingPlan pairings before the Executor traces them "
             "(static/shardcheck.py, SC001-SC009): feed batch divisibility, "

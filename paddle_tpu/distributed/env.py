@@ -11,6 +11,20 @@ import jax
 
 _data_axis_stack = []
 
+# elastic world override: after an eviction the surviving job's logical
+# world is smaller than what jax.distributed / the launcher env said at
+# startup.  elastic/membership.py's record_resume sets this so every
+# world-size consumer (fleet role queries, ParallelEnv) agrees with the
+# rebuilt mesh.  None = no override.
+_elastic_world: Optional[int] = None
+
+
+def set_elastic_world(world: Optional[int]) -> None:
+    """Override (or clear, with None) the process's logical world size
+    after an elastic membership change."""
+    global _elastic_world
+    _elastic_world = None if world is None else int(world)
+
 
 def get_rank() -> int:
     try:
@@ -20,6 +34,8 @@ def get_rank() -> int:
 
 
 def get_world_size() -> int:
+    if _elastic_world is not None:
+        return _elastic_world
     try:
         return jax.process_count()
     except Exception:

@@ -44,7 +44,8 @@ def _free_port() -> int:
 def launch(training_script: str, script_args: List[str],
            nproc: int = 1, started_port: Optional[int] = None,
            log_dir: Optional[str] = None, backend_env: str = "",
-           trace_dir: Optional[str] = None) -> int:
+           trace_dir: Optional[str] = None, max_restarts: int = 0,
+           elastic_dir: Optional[str] = None) -> int:
     """Spawn `nproc` worker processes with the trainer-env contract.
     Returns the first nonzero exit code, or 0.
 
@@ -54,7 +55,16 @@ def launch(training_script: str, script_args: List[str],
     PDTPU_TRACE_DIR: each rank atexit-dumps a chrome trace
     (trace.rank<r>.json, mergeable via `python -m tools.tracecat`) and arms
     a flight-recorder post-mortem (flight.rank<r>.json) on crash/SIGTERM —
-    a dead rank leaves more than an exit code."""
+    a dead rank leaves more than an exit code; the launcher prints that
+    dump's path when a rank dies.
+
+    Elastic relaunch: with ``max_restarts > 0`` a crashed rank is respawned
+    in place (same rank env, PDTPU_RESTART_COUNT incremented) up to
+    ``max_restarts`` total restarts across the job before the default
+    abort-everyone behavior kicks in — the ref fleet elastic relaunch loop.
+    ``elastic_dir`` is exported as PDTPU_ELASTIC_DIR so workers can join
+    the elastic membership (elastic/membership.py ``ElasticMember.from_env``)
+    and evict ranks the launcher gave up on."""
     base_port = started_port or _free_port()
     endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
     job_trace_id = uuid.uuid4().hex
@@ -62,59 +72,91 @@ def launch(training_script: str, script_args: List[str],
         os.makedirs(log_dir, exist_ok=True)
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
+    if elastic_dir:
+        os.makedirs(elastic_dir, exist_ok=True)
     procs: List[subprocess.Popen] = []
     logs = []
     exit_code = 0
+    restart_counts = {rank: 0 for rank in range(nproc)}
+
+    def _spawn(rank: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
+            "PADDLE_COORDINATOR": f"127.0.0.1:{base_port}",
+            "PDTPU_TRACE_ID": job_trace_id,
+            "PDTPU_RESTART_COUNT": str(restart_counts[rank]),
+        })
+        if trace_dir:
+            env["PDTPU_TRACE_DIR"] = trace_dir
+        if elastic_dir:
+            env["PDTPU_ELASTIC_DIR"] = elastic_dir
+        for kv in backend_env.split(","):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        cmd = [sys.executable, "-u", training_script] + list(script_args)
+        if log_dir:
+            # append so a restarted rank's output lands after its crash log
+            out = open(os.path.join(log_dir, f"worker.{rank}.log"), "a")
+            logs.append(out)
+            p = subprocess.Popen(cmd, env=env, stdout=out,
+                                 stderr=subprocess.STDOUT)
+        else:
+            p = subprocess.Popen(cmd, env=env)
+        procs.append(p)
+        return p
+
+    def _report_death(rank: int, rc: int) -> None:
+        msg = f"[launch] worker rank {rank} exited with code {rc}"
+        if trace_dir:
+            msg += (" — flight dump: "
+                    + os.path.join(trace_dir, f"flight.rank{rank}.json"))
+        print(msg, file=sys.stderr)
+
     # spawn AND watch under one try/finally: a failure while spawning rank k
     # must not orphan ranks 0..k-1 or leak log handles
     try:
-        for rank in range(nproc):
-            env = dict(os.environ)
-            env.update({
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(nproc),
-                "PADDLE_TRAINER_ENDPOINTS": endpoints,
-                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
-                "PADDLE_COORDINATOR": f"127.0.0.1:{base_port}",
-                "PDTPU_TRACE_ID": job_trace_id,
-            })
-            if trace_dir:
-                env["PDTPU_TRACE_DIR"] = trace_dir
-            for kv in backend_env.split(","):
-                if "=" in kv:
-                    k, v = kv.split("=", 1)
-                    env[k] = v
-            cmd = [sys.executable, "-u", training_script] + list(script_args)
-            if log_dir:
-                out = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
-                logs.append(out)
-                procs.append(subprocess.Popen(cmd, env=env, stdout=out,
-                                              stderr=subprocess.STDOUT))
-            else:
-                procs.append(subprocess.Popen(cmd, env=env))
+        watching = {rank: _spawn(rank) for rank in range(nproc)}
+        restarts_left = max(0, int(max_restarts))
 
-        # watch loop (ref launch_utils.py: abort everyone on first failure)
-        watching = list(procs)
+        # watch loop (ref launch_utils.py: abort everyone on first failure;
+        # with a restart budget, respawn the dead rank in place first)
         while watching:
-            alive = []
-            for p in watching:
+            failed = None
+            for rank, p in list(watching.items()):
                 rc = p.poll()
                 if rc is None:
-                    alive.append(p)
-                elif rc != 0:
-                    exit_code = rc
-                    for q in watching:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-                    for q in watching:
-                        try:  # escalate to SIGKILL if SIGTERM is ignored
-                            q.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                            q.wait()
-                    alive = []
+                    continue
+                if rc == 0:
+                    del watching[rank]
+                    continue
+                _report_death(rank, rc)
+                if restarts_left > 0:
+                    restarts_left -= 1
+                    restart_counts[rank] += 1
+                    print(f"[launch] restarting rank {rank} "
+                          f"(restart {restart_counts[rank]}, "
+                          f"{restarts_left} left)", file=sys.stderr)
+                    watching[rank] = _spawn(rank)
+                else:
+                    failed = rc
                     break
-            watching = alive
+            if failed is not None:
+                exit_code = failed
+                alive = [q for q in watching.values() if q.poll() is None]
+                for q in alive:
+                    q.send_signal(signal.SIGTERM)
+                for q in alive:
+                    try:  # escalate to SIGKILL if SIGTERM is ignored
+                        q.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                        q.wait()
+                watching = {}
             if watching:
                 time.sleep(0.2)
     finally:
@@ -142,12 +184,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="directory for per-rank chrome traces + "
                         "flight-recorder post-mortems (merge with "
                         "`python -m tools.tracecat`)")
+    parser.add_argument("--max-restarts", "--max_restarts", type=int,
+                        default=0, dest="max_restarts",
+                        help="elastic relaunch budget: respawn a crashed "
+                        "rank in place up to this many times before "
+                        "aborting the job (default 0 = classic "
+                        "fail-fast)")
+    parser.add_argument("--elastic_dir", type=str, default=None,
+                        help="shared membership/heartbeat directory "
+                        "exported to workers as PDTPU_ELASTIC_DIR "
+                        "(elastic/membership.py)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.training_script, args.script_args, args.nproc,
                   args.started_port, args.log_dir, args.backend_env,
-                  args.trace_dir)
+                  args.trace_dir, args.max_restarts, args.elastic_dir)
 
 
 if __name__ == "__main__":

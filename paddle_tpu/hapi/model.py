@@ -106,6 +106,7 @@ class Model:
         self._eval_step = None
         self._pred_step = None
         self._opt_state = None
+        self._fit_params = None  # live jit-path params, mid-epoch
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------------
@@ -194,6 +195,20 @@ class Model:
         if self._opt_state is None and not _tape.enabled():
             self._opt_state = self._optimizer.init(params)
 
+        # periodic elastic checkpointing rides the callback list when the
+        # elastic flags are set (fleet's ElasticConfig sets them)
+        from ..core import flags as _flags
+
+        if (int(_flags.get_flag("elastic_save_every")) > 0
+                and _flags.get_flag("elastic_ckpt_dir")):
+            from ..elastic.checkpoint import ElasticCheckpoint
+
+            callbacks = list(callbacks) if callbacks else []
+            if not any(isinstance(c, ElasticCheckpoint) for c in callbacks):
+                callbacks.append(ElasticCheckpoint(
+                    _flags.get_flag("elastic_ckpt_dir"),
+                    save_every=int(_flags.get_flag("elastic_save_every")),
+                    keep_last=int(_flags.get_flag("elastic_keep_last"))))
         cbs = cb_mod.CallbackList(callbacks, model=self,
                                   params={"epochs": epochs, "verbose": verbose,
                                           "steps": _safe_len(loader),
@@ -226,6 +241,9 @@ class Model:
                     params, self._opt_state, loss, metric_outs = \
                         self._train_step(params, self._opt_state, rng, inputs,
                                          labels)
+                    # the jit path carries params outside the network until
+                    # epoch end; checkpoint callbacks need the live values
+                    self._fit_params = params
                 # lazy logs: float(loss) is a device sync — defer it until a
                 # callback/verbose consumer actually reads the value so the
                 # steady-state dispatch chain stays asynchronous

@@ -99,6 +99,21 @@ class DGCConfig:  # proto :47 DGCConfig
 
 
 @dataclasses.dataclass
+class ElasticConfig:
+    """Elastic fault-tolerance knobs (ref: the fleet elastic manager +
+    incubate checkpoint saver; paddle_tpu/elastic/).  ``save_every`` > 0
+    with a ``ckpt_dir`` turns on periodic resharding-capable manifest
+    checkpoints inside hapi Model.fit (via the elastic_* flags);
+    ``dead_after_s``/``heartbeat_s`` parameterize membership when a worker
+    builds an ``ElasticMember`` from this config."""
+    ckpt_dir: str = ""
+    save_every: int = 0
+    keep_last: int = 2
+    heartbeat_s: float = 0.5
+    dead_after_s: float = 3.0
+
+
+@dataclasses.dataclass
 class CommConfig:
     """Gradient-sync communication knobs (parallel/compress.py): bucket
     coalescing size (the reducer.cc `comm_buffer_size` analogue), quantized
@@ -131,6 +146,8 @@ class DistributedStrategy:
         self.pipeline = False
         self.pipeline_configs = PipelineConfig()
         self.hybrid_configs = HybridConfig()
+        self.elastic = False
+        self.elastic_configs = ElasticConfig()
         self.sequence_parallel = False
         # Gradient-sync ownership: "" leaves sync to the train-step builder
         # (legacy psum/pmean); "none" makes update() own a bucketed
@@ -190,6 +207,16 @@ class Fleet:
             dp=None if hc.dp_degree == -1 else hc.dp_degree,
             pp=hc.pp_degree, tp=hc.mp_degree, sp=hc.sp_degree,
             ep=hc.ep_degree)
+        ec = self._strategy.elastic_configs
+        if self._strategy.elastic and ec.save_every > 0 and ec.ckpt_dir:
+            # surface the cadence through the flags Model.fit reads, so
+            # strategy-driven jobs get periodic elastic checkpoints without
+            # touching their fit() call
+            from ..core import flags as _flags
+
+            _flags.set_flags({"elastic_save_every": int(ec.save_every),
+                              "elastic_ckpt_dir": ec.ckpt_dir,
+                              "elastic_keep_last": int(ec.keep_last)})
         return self
 
     @property

@@ -33,16 +33,27 @@ _ENV_JOB_ID = "PDTPU_JOB_ID"  # ref: the cloud job-id env the checker reads
 _ENV_CKPT_DIR = "PDTPU_CHECKPOINT_DIR"
 
 
+def _is_flat_array_dict(state: Any) -> bool:
+    return isinstance(state, dict) and all(
+        hasattr(v, "shape") and hasattr(v, "dtype") for v in state.values())
+
+
 class AutoCheckpoint:
     """Epoch-granular checkpoint/resume manager."""
 
     def __init__(self, ckpt_dir: Optional[str] = None,
-                 job_id: Optional[str] = None, keep_last: int = 2):
+                 job_id: Optional[str] = None, keep_last: int = 2,
+                 plan=None):
         self.ckpt_dir = ckpt_dir or os.environ.get(_ENV_CKPT_DIR)
         if not self.ckpt_dir:
             raise ValueError("pass ckpt_dir or set $" + _ENV_CKPT_DIR)
         self.job_id = job_id or os.environ.get(_ENV_JOB_ID, "default")
         self.keep_last = keep_last
+        # with a ShardingPlan, flat dict states are written in the elastic
+        # manifest format (elastic/checkpoint.py) so a relaunched job can
+        # resume on a different mesh; other pytrees and plan=None keep the
+        # legacy npz+tree layout, and load() reads either
+        self.plan = plan
         self.root = os.path.join(self.ckpt_dir, self.job_id)
         os.makedirs(self.root, exist_ok=True)
         self.restored_state: Any = None
@@ -77,7 +88,13 @@ class AutoCheckpoint:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        _ckpt.save(state, os.path.join(tmp, "state"))
+        if self.plan is not None and _is_flat_array_dict(state):
+            from ..elastic import checkpoint as _eckpt
+
+            _eckpt.write_state(os.path.join(tmp, "state"), state,
+                               step=epoch, plan=self.plan)
+        else:
+            _ckpt.save(state, os.path.join(tmp, "state"))
         final = self._epoch_dir(epoch)
         if os.path.exists(final):
             shutil.rmtree(final)
@@ -96,7 +113,14 @@ class AutoCheckpoint:
             shutil.rmtree(d)
 
     def load(self, epoch: int) -> Any:
-        return _ckpt.load(os.path.join(self._epoch_dir(epoch), "state"))
+        path = os.path.join(self._epoch_dir(epoch), "state")
+        if self.plan is not None and os.path.isdir(path):
+            from ..elastic import checkpoint as _eckpt
+
+            if os.path.exists(os.path.join(path, _eckpt.MANIFEST_NAME)):
+                state, _meta = _eckpt.read_state(path, plan=self.plan)
+                return state
+        return _ckpt.load(path)
 
     @property
     def last_epoch(self) -> int:

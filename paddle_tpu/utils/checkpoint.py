@@ -3,13 +3,21 @@
 Reference parity: fluid/io.py save/load_persistables (:598), dygraph
 save_dygraph/load_dygraph state-dict pickles, save_op/load_op tensor
 serialization.  TPU-native: state dicts (arbitrary pytrees of arrays) are
-written as .npz plus a structure pickle — host-side, no device involvement;
-async/sharded checkpointing (orbax-style) can layer on top later.
+written as .npz plus a structure pickle — host-side, no device involvement.
+
+Writes are atomic (tmp file in the target directory + ``os.replace`` per
+file; the .npz — the file ``load`` keys its existence check on — lands
+last), so a crashed saver never leaves a load-able half checkpoint.
+Sharded/resharding checkpoints live in elastic/checkpoint.py; ``load``
+recognizes that manifest layout when handed one (a directory containing
+``manifest.json``) and returns the gathered flat state dict, so callers
+migrating formats keep a single load entry point.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import tempfile
 from typing import Any, Dict
 
 import jax
@@ -22,15 +30,45 @@ def _flatten(tree) -> Dict[str, np.ndarray]:
     return arrays, treedef
 
 
+def _atomic_write(path: str, writer) -> None:
+    """Write via a tempfile in the destination directory + os.replace."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save(state: Any, path: str) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     arrays, treedef = _flatten(state)
-    np.savez(path + ".npz" if not path.endswith(".npz") else path, **arrays)
-    with open(path + ".tree", "wb") as f:
-        pickle.dump(treedef, f)
+    npz_path = path + ".npz" if not path.endswith(".npz") else path
+    # tree first, npz last: load() keys on the npz existing, so a crash in
+    # between leaves nothing load() would accept
+    _atomic_write(path + ".tree", lambda f: pickle.dump(treedef, f))
+    _atomic_write(npz_path, lambda f: np.savez(f, **arrays))
+
+
+def _manifest_dir(path: str) -> bool:
+    from ..elastic import checkpoint as _eckpt
+
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, _eckpt.MANIFEST_NAME))
 
 
 def load(path: str) -> Any:
+    if _manifest_dir(path):
+        from ..elastic import checkpoint as _eckpt
+
+        state, _meta = _eckpt.read_state(path)
+        return state
     npz_path = path + ".npz" if not path.endswith(".npz") else path
     if not os.path.exists(npz_path):
         raise FileNotFoundError(npz_path)

@@ -1,0 +1,482 @@
+"""Elastic membership, PS hot failover, launcher restarts, and THE chaos
+test: kill a worker mid-epoch, survivors detect -> evict -> resume.
+
+Covers the PR-12 recovery contract:
+  * membership (elastic/membership.py): stale heartbeats flip ranks to
+    dead after dead_after_s, eviction markers are claimed exactly once
+    (O_EXCL) even with many observers, never-started ranks get a grace
+    window, stragglers are flagged from heartbeat step lag, and
+    record_resume mirrors the shrunken world into distributed.env;
+  * failover (elastic/failover.py): table snapshots are digest-verified
+    blobs (corruption raises), and a StandbyServer promotes on primary
+    death serving the last durable snapshot bitwise;
+  * launcher: --max-restarts respawns a crashed rank in place
+    (PDTPU_RESTART_COUNT increments) before the classic abort-everyone
+    path, and a dead rank's flight-dump path is printed;
+  * chaos: three workers train against a shared membership dir; the
+    parent SIGKILLs one mid-run; survivors detect the silence, evict,
+    rebuild their mesh at the smaller world, restore the latest elastic
+    checkpoint, and finish ALL steps with a loss curve that stays on the
+    single-process reference trajectory — and their flight dumps pin the
+    worker_dead -> worker_evicted (exactly one winner) -> elastic_resume
+    chain.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed.launch import launch
+from paddle_tpu.distributed.ps import SparseTable
+from paddle_tpu.distributed.ps_server import PSServer, RemoteSparseTable
+from paddle_tpu.elastic.failover import (
+    SnapshotError, StandbyServer, TableSnapshotter, load_table_snapshot,
+    save_table_snapshot)
+from paddle_tpu.elastic.membership import ElasticMember
+from paddle_tpu.utils import monitor
+from paddle_tpu.utils import trace as trace_mod
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# membership unit tests (in-process: members are just directory handles)
+# ---------------------------------------------------------------------------
+
+def _age_heartbeat(directory, rank, by_s: float):
+    p = os.path.join(directory, f"hb.{rank}.json")
+    with open(p) as f:
+        hb = json.load(f)
+    hb["ts"] -= by_s
+    with open(p, "w") as f:
+        json.dump(hb, f)
+
+
+def test_membership_detects_stale_heartbeat(tmp_path):
+    d = str(tmp_path)
+    m0 = ElasticMember(d, rank=0, world_size=2, dead_after_s=1.0)
+    m1 = ElasticMember(d, rank=1, world_size=2, dead_after_s=1.0)
+    m0.beat()
+    m1.beat()
+    v = m0.view()
+    assert v.live == (0, 1) and v.dead == () and v.world_size == 2
+    _age_heartbeat(d, 1, by_s=5.0)             # rank 1 goes silent
+    m0.beat()
+    v = m0.view()
+    assert v.live == (0,) and v.dead == (1,)
+
+
+def test_membership_evicts_exactly_once_across_observers(tmp_path):
+    d = str(tmp_path)
+    reg = monitor.default_registry()
+    deaths0 = reg.get("elastic.worker_deaths").value()
+    members = [ElasticMember(d, rank=r, world_size=3, dead_after_s=0.5)
+               for r in (0, 2)]
+    for m in members:
+        m.beat()
+    ElasticMember(d, rank=1, world_size=3).beat()
+    _age_heartbeat(d, 1, by_s=5.0)
+    # every observer sees the eviction once; the marker is claimed once
+    assert members[0].detect_and_evict() == [1]
+    assert members[1].detect_and_evict() == [1]
+    assert members[0].detect_and_evict() == []   # idempotent per observer
+    assert (tmp_path / "evicted.1").exists()
+    assert reg.get("elastic.worker_deaths").value() - deaths0 == 1
+    assert members[0].world_size() == 2
+    assert members[0].view().evicted == (1,)
+    assert members[0].view().generation == 1
+
+
+def test_membership_grace_period_for_slow_starters(tmp_path):
+    m0 = ElasticMember(str(tmp_path), rank=0, world_size=2,
+                       dead_after_s=0.4)
+    m0.beat()                                   # rank 1 never wrote
+    assert m0.view().dead == ()                 # inside the grace window
+    time.sleep(0.5)
+    m0.beat()                                   # keep our own heartbeat fresh
+    assert m0.view().dead == (1,)               # grace expired
+
+
+def test_membership_straggler_flagged_once(tmp_path):
+    d = str(tmp_path)
+    m0 = ElasticMember(d, rank=0, world_size=2, straggler_steps=2)
+    m1 = ElasticMember(d, rank=1, world_size=2, straggler_steps=2)
+    m0.set_step(10)
+    m1.set_step(1)
+    rec = trace_mod.flight_recorder()
+    n0 = sum(1 for e in rec.events() if e["kind"] == "straggler")
+    assert m0.stragglers() == [1]
+    assert m0.stragglers() == [1]               # still lagging...
+    n1 = sum(1 for e in rec.events() if e["kind"] == "straggler")
+    assert n1 - n0 == 1                         # ...but recorded once
+    m1.set_step(10)                             # catches up, flag rearms
+    assert m0.stragglers() == []
+    m1.set_step(10)
+    m0.set_step(20)
+    assert m0.stragglers() == [1]
+    n2 = sum(1 for e in rec.events() if e["kind"] == "straggler")
+    assert n2 - n1 == 1
+
+
+def test_record_resume_overrides_world_size(tmp_path):
+    m = ElasticMember(str(tmp_path), rank=0, world_size=4)
+    try:
+        m.record_resume(step=7, world=3)
+        assert dist_env.get_world_size() == 3
+        ev = [e for e in trace_mod.flight_recorder().events()
+              if e["kind"] == "elastic_resume"]
+        assert ev and ev[-1]["world"] == 3 and ev[-1]["step"] == 7
+    finally:
+        dist_env.set_elastic_world(None)
+
+
+def test_member_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PDTPU_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "5")
+    m = ElasticMember.from_env(dead_after_s=9.0)
+    assert (m.dir, m.rank, m.initial_world) == (str(tmp_path), 2, 5)
+    assert m.dead_after_s == 9.0
+    with pytest.raises(ValueError, match="PDTPU_ELASTIC_DIR"):
+        monkeypatch.delenv("PDTPU_ELASTIC_DIR")
+        ElasticMember.from_env()
+
+
+# ---------------------------------------------------------------------------
+# PS failover
+# ---------------------------------------------------------------------------
+
+def test_table_snapshot_roundtrip_and_corruption(tmp_path):
+    t = SparseTable(dim=4, num_shards=2, optimizer="sgd", seed=3)
+    ids = np.arange(6, dtype=np.int64)
+    t.push(ids, np.ones((6, 4), np.float32), lr=0.5)
+    path = str(tmp_path / "t.snap")
+    save_table_snapshot(t, path)
+    t2 = SparseTable(dim=4, num_shards=2, optimizer="sgd", seed=99)
+    t2.load_state_dict(load_table_snapshot(path))
+    np.testing.assert_array_equal(t2.pull(ids), t.pull(ids))
+    blob = bytearray(Path(path).read_bytes())
+    blob[-3] ^= 0xFF
+    Path(path).write_bytes(bytes(blob))
+    with pytest.raises(SnapshotError, match="digest mismatch"):
+        load_table_snapshot(path)
+    with pytest.raises(SnapshotError, match="unreadable"):
+        load_table_snapshot(str(tmp_path / "missing.snap"))
+
+
+def test_standby_promotes_on_primary_death(tmp_path):
+    """The hot-failover path end to end: primary serves + snapshots, dies;
+    the standby notices, replays the last durable snapshot, and serves the
+    same rows bitwise from its pre-announced endpoint."""
+    reg = monitor.default_registry()
+    f0 = reg.get("elastic.failovers").value()
+    snap = str(tmp_path / "table.snap")
+    primary_table = SparseTable(dim=8, num_shards=2, optimizer="sgd", seed=3)
+    primary = PSServer(primary_table).start()
+    standby = StandbyServer(
+        SparseTable(dim=8, num_shards=2, optimizer="sgd", seed=77),
+        snapshot_path=snap, primary_endpoint=primary.endpoint,
+        probe_interval_s=0.15, max_missed=2)
+    try:
+        remote = RemoteSparseTable([primary.endpoint], dim=8)
+        ids = np.array([1, 5, 9], np.int64)
+        remote.pull(ids)                         # initialize rows
+        remote.apply_delta(ids, np.full((3, 8), 2.0, np.float32))
+        expect = remote.pull(ids)
+        snapshotter = TableSnapshotter(primary_table, snap, every_s=0.2)
+        snapshotter.snapshot_now()
+        remote.close()
+        snapshotter.stop()
+
+        standby.start()
+        time.sleep(0.4)
+        assert not standby.promoted              # primary healthy: no action
+        primary.stop()                           # chaos: primary dies
+        assert standby.wait_promoted(timeout=10), "standby never promoted"
+
+        failover_remote = RemoteSparseTable([standby.endpoint], dim=8)
+        np.testing.assert_array_equal(failover_remote.pull(ids), expect)
+        failover_remote.close()
+        assert reg.get("elastic.failovers").value() - f0 == 1
+        kinds = [e["kind"] for e in trace_mod.flight_recorder().events()]
+        assert "ps_probe_missed" in kinds and "failover" in kinds
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+def test_standby_without_snapshot_promotes_empty(tmp_path):
+    standby = StandbyServer(
+        SparseTable(dim=4, num_shards=1, optimizer="sgd", seed=1),
+        snapshot_path=str(tmp_path / "never.snap"),
+        primary_endpoint="127.0.0.1:1")          # nothing listens there
+    try:
+        standby.promote()
+        assert standby.promoted and standby.endpoint
+        ev = [e for e in trace_mod.flight_recorder().events()
+              if e["kind"] == "failover_snapshot_missing"]
+        assert ev, "missing-snapshot promotion must leave a flight event"
+    finally:
+        standby.stop()
+
+
+# ---------------------------------------------------------------------------
+# launcher: restart budget + flight-dump pointer
+# ---------------------------------------------------------------------------
+
+def _worker_script(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launch_max_restarts_respawns_crashed_rank(tmp_path):
+    marker = tmp_path / "second_life.txt"
+    script = _worker_script(tmp_path, f"""
+        import os, sys
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            if os.environ["PDTPU_RESTART_COUNT"] == "0":
+                sys.exit(3)                      # first incarnation crashes
+            open({str(marker)!r}, "w").write("restarted")
+    """)
+    rc = launch(script, [], nproc=2, max_restarts=1)
+    assert rc == 0
+    assert marker.read_text() == "restarted"
+    # without a budget the same crash keeps its classic fail-fast semantics
+    marker.unlink()
+    rc = launch(script, [], nproc=2, max_restarts=0)
+    assert rc == 3
+    assert not marker.exists()
+
+
+def test_launch_prints_flight_dump_path(tmp_path, capfd):
+    script = _worker_script(tmp_path, """
+        import os, sys
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(5)
+    """)
+    rc = launch(script, [], nproc=2, trace_dir=str(tmp_path / "tr"))
+    assert rc == 5
+    err = capfd.readouterr().err
+    assert "worker rank 1 exited with code 5" in err
+    assert "flight.rank1.json" in err
+
+
+# ---------------------------------------------------------------------------
+# THE chaos test
+# ---------------------------------------------------------------------------
+
+_CHAOS_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+import jax
+from jax.sharding import Mesh
+import paddle_tpu.static as static
+from paddle_tpu.core import flags
+from paddle_tpu.elastic import checkpoint as eckpt
+from paddle_tpu.elastic.membership import ElasticMember
+from paddle_tpu.parallel.mesh import DP_AXIS
+from paddle_tpu.parallel.sharding import ShardingPlan
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import trace as trace_mod
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+ckpt_dir, out_dir, cache_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+STEPS = int(sys.argv[4])
+flags.set_flags({"metrics": True, "compile_cache_dir": cache_dir})
+
+main, startup = static.Program(), static.Program()
+main.random_seed = 7
+startup.random_seed = 7
+with static.program_guard(main, startup):
+    x = L.data("x", [8])
+    y = L.data("y", [1])
+    pred = L.fc(L.fc(x, 16, act="relu"), 1)
+    loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+    static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+def compiled_for(n):
+    mesh = Mesh(np.asarray(jax.devices()[:n]), (DP_AXIS,))
+    return mesh, static.CompiledProgram(main).with_sharding(mesh=mesh,
+                                                            donate=False)
+
+rng = np.random.default_rng(3)
+feed = {"x": rng.normal(size=(12, 8)).astype(np.float32),
+        "y": rng.normal(size=(12, 1)).astype(np.float32)}
+
+member = ElasticMember.from_env(world_size=world, interval_s=0.1,
+                                dead_after_s=1.0).start()
+exe = static.Executor()
+mesh, compiled = compiled_for(world)
+scope = static.Scope()
+with static.scope_guard(scope):
+    exe.run(startup)
+losses = {}
+step = 0
+while step < STEPS:
+    with static.scope_guard(scope):
+        out = exe.run(compiled, feed=feed, fetch_list=[loss])[0]
+    losses[step] = float(np.asarray(out))
+    member.set_step(step)
+    if rank == 0:   # the leader checkpoints every step (and is never killed)
+        with static.scope_guard(scope):
+            eckpt.save_checkpoint(ckpt_dir, eckpt.scope_state(main, scope),
+                                  step, keep_last=6)
+    newly = member.detect_and_evict()
+    if newly:
+        # detect -> record -> evict done; now: rebuild mesh at the smaller
+        # world, restore the latest checkpoint, resume
+        new_world = member.world_size()
+        mesh, compiled = compiled_for(new_world)
+        plan = ShardingPlan(mesh=mesh, donate=False)
+        state = meta = None
+        for _ in range(40):   # ride out save/GC races with the leader
+            try:
+                state, meta = eckpt.restore_checkpoint(ckpt_dir, plan=plan)
+                break
+            except eckpt.CheckpointError:
+                time.sleep(0.1)
+        assert state is not None, "no restorable checkpoint after eviction"
+        scope = static.Scope()
+        eckpt.restore_scope_state(state, scope)
+        member.record_resume(meta["step"], new_world)
+        step = meta["step"] + 1
+        continue
+    step += 1
+    time.sleep(0.12)
+member.stop()
+trace_mod.flight_recorder().dump(
+    os.path.join(out_dir, f"flight.rank{rank}.json"))
+with open(os.path.join(out_dir, f"losses.rank{rank}.json"), "w") as f:
+    json.dump(losses, f)
+"""
+
+
+def _reference_losses(steps: int):
+    """Single-process trajectory of the same net/feed (fresh programs: the
+    subprocess workers regenerate identical names anyway)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    main, startup = static.Program(), static.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with static.program_guard(main, startup):
+        x = L.data("x", [8])
+        y = L.data("y", [1])
+        pred = L.fc(L.fc(x, 16, act="relu"), 1)
+        loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+        static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.default_rng(3)
+    feed = {"x": rng.normal(size=(12, 8)).astype(np.float32),
+            "y": rng.normal(size=(12, 1)).astype(np.float32)}
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        return [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0]))
+                for _ in range(steps)]
+
+
+def test_chaos_kill_worker_midrun_survivors_recover(tmp_path):
+    """SIGKILL a worker mid-run; the survivors must complete every step on
+    a rebuilt (smaller) mesh with the loss curve still on the reference
+    trajectory, and their flight dumps must pin the full
+    detect -> record -> evict -> resume chain."""
+    steps = 18
+    script = tmp_path / "worker.py"
+    script.write_text(_CHAOS_WORKER)
+    edir = tmp_path / "membership"
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "out"
+    cache = tmp_path / "cc"
+    for d in (edir, out, cache):
+        d.mkdir()
+    env_base = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PDTPU_ELASTIC_DIR=str(edir),
+                    PADDLE_TRAINERS_NUM="3",
+                    PYTHONPATH=str(_REPO) + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""))
+    procs = {}
+    try:
+        for rank in range(3):
+            env = dict(env_base, PADDLE_TRAINER_ID=str(rank))
+            procs[rank] = subprocess.Popen(
+                [sys.executable, str(script), str(ckpt), str(out),
+                 str(cache), str(steps)],
+                cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        # wait for the victim to make real progress, then kill -9 it
+        victim_hb = edir / "hb.1.json"
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            assert procs[1].poll() is None, \
+                "victim exited before the chaos:\n" + procs[1].stdout.read()
+            try:
+                if json.loads(victim_hb.read_text())["step"] >= 4:
+                    break
+            except (OSError, ValueError, KeyError):
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("victim never reached step 4")
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=30)
+
+        for rank in (0, 2):
+            rc = procs[rank].wait(timeout=420)
+            assert rc == 0, (f"survivor {rank} died:\n"
+                             + procs[rank].stdout.read())
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    ref = _reference_losses(steps)
+    dumps = {}
+    for rank in (0, 2):
+        # loss-curve continuity: every step present, on-trajectory (mesh
+        # size changed mid-run, so ulp-level drift is legitimate)
+        losses = json.loads((out / f"losses.rank{rank}.json").read_text())
+        assert sorted(int(s) for s in losses) == list(range(steps)), rank
+        curve = [losses[str(s)] for s in range(steps)]
+        assert curve == pytest.approx(ref, rel=2e-3), rank
+        dumps[rank] = json.loads(
+            (out / f"flight.rank{rank}.json").read_text())["events"]
+
+    # the detect -> record -> evict -> resume chain: every survivor resumes
+    # (restore + elastic_resume); the rank that detected first records
+    # worker_dead and exactly one claims the eviction marker — a survivor
+    # that raced in later sees only the marker, not the staleness itself
+    evict_winners = 0
+    saw_dead = 0
+    for rank, events in dumps.items():
+        kinds = [e["kind"] for e in events]
+        assert "elastic_resume" in kinds, rank
+        assert "elastic_restore" in kinds, rank
+        assert kinds.index("elastic_restore") < kinds.index("elastic_resume")
+        if "worker_dead" in kinds:
+            saw_dead += 1
+            dead_ev = next(e for e in events if e["kind"] == "worker_dead")
+            assert dead_ev["worker"] == 1
+            assert kinds.index("worker_dead") < kinds.index("elastic_resume")
+        if "worker_evicted" in kinds:
+            evict_winners += 1
+            assert "worker_dead" in kinds, rank  # winner must have detected
+    assert saw_dead >= 1                       # someone observed the death
+    assert evict_winners == 1                  # O_EXCL marker: one winner
+    assert (edir / "evicted.1").exists()
+    # the leader's checkpoints drove the recovery
+    kinds0 = [e["kind"] for e in dumps[0]]
+    assert "elastic_checkpoint" in kinds0

@@ -44,6 +44,12 @@ _KNOWN_NAMES = frozenset({
     "comm.allreduce_bytes",
     "comm.allreduce_ms",
     "comm.compress_ratio",
+    # elastic/ (checkpoint.py, membership.py, failover.py)
+    "elastic.checkpoint_ms",
+    "elastic.failovers",
+    "elastic.resharded_leaves",
+    "elastic.restore_ms",
+    "elastic.worker_deaths",
     # static/executor.py + static/compile_cache.py
     "executor.cache_hit",
     "executor.cache_miss",
@@ -140,6 +146,7 @@ def _register_instrumented_modules() -> None:
     """Import every instrumented layer so its metrics are registered even
     when the workload doesn't exercise it (PS server, hapi loop)."""
     import paddle_tpu.distributed.ps_server  # noqa: F401
+    import paddle_tpu.elastic  # noqa: F401 — the elastic.* family
     import paddle_tpu.serving  # noqa: F401 — the serve.* family
     import paddle_tpu.static.analysis  # noqa: F401 — analysis.* counters
     import paddle_tpu.static.shardcheck  # noqa: F401 — analysis.plans_checked
