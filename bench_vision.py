@@ -1,12 +1,24 @@
 """Vision benchmarks: ResNet-50 (BASELINE config 2) and YOLOv3 (config 4,
-single-chip part) training throughput in images/sec/chip.
+single-chip part) training throughput in images/sec/chip, plus the r06
+static-graph INFERENCE ladder:
+
+* ``conv_infer`` — a conv/BN/pool tower served through the Executor with
+  ``opt_passes=default`` ON (the r06 default for inference benches),
+  reporting the traced-op-count delta from the rewrite pipeline and the
+  first-step compile-time delta vs the unoptimized program;
+* ``int8_infer`` — the same tower PTQ'd (slim/quant_static.py) and folded
+  to int8 ops by the ``quant_infer`` pass (static/passes.py
+  QUANT_INFER_PIPELINE), reporting quantized throughput vs float and the
+  int8-vs-float error.  On TPU the quant ops dispatch to the
+  ops/pallas/int8 kernels; off-TPU the simulate fallback runs, so CPU
+  numbers measure the pass pipeline, not the MXU.
 
 Reference configs: PaddleClas ResNet-50 dygraph (224x224, momentum SGD) and
 PaddleDetection YOLOv3-DarkNet53 (416x416, yolo_loss over 3 heads).  No
 published in-tree reference numbers exist (BASELINE.md `"published": {}`);
 the first TPU measurement recorded here is the baseline.
 
-Usage: python bench_vision.py [resnet50|yolov3|all]
+Usage: python bench_vision.py [resnet50|yolov3|conv_infer|int8_infer|all]
 Prints one JSON line per model (same schema as bench.py).
 """
 from __future__ import annotations
@@ -186,11 +198,140 @@ def bench_yolov3(on_tpu):
                 model="yolov3", size=size, _aot=aot)
 
 
+# ---------------------------------------------------------------------------
+# r06 inference ladder: opt_passes-on conv tower + int8 PTQ path
+# ---------------------------------------------------------------------------
+
+def _conv_tower(on_tpu):
+    """Static conv/BN(relu)/pool x2 + fc head — big enough on TPU for the
+    Pallas gates (C=128 lanes), tiny on CPU so the bench rides CI."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    ch = 128 if on_tpu else 8
+    size = 32 if on_tpu else 8
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = 11
+    with static.program_guard(main, startup):
+        img = L.data("img", [3, size, size])
+        h = L.conv2d(img, ch, 3, padding=1)
+        h = L.batch_norm(h, act="relu", is_test=True)
+        h = L.pool2d(h, 2, "max", 2)
+        h = L.conv2d(h, ch, 3, padding=1)
+        h = L.batch_norm(h, act="relu", is_test=True)
+        h = L.pool2d(h, 2, "max", 2)
+        out = L.fc(L.flatten(h), 10)
+    return main, startup, out, size
+
+
+def _infer_loop(exe, program, feed, fetch, scope, warmup, iters):
+    """(first-step ms, steady imgs/sec) for one Executor config."""
+    import paddle_tpu.static as static
+
+    with static.scope_guard(scope):
+        t0 = time.perf_counter()
+        exe.run(program, feed=feed, fetch_list=fetch)
+        first_ms = (time.perf_counter() - t0) * 1e3
+        for _ in range(warmup):
+            exe.run(program, feed=feed, fetch_list=fetch)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out, = exe.run(program, feed=feed, fetch_list=fetch)
+        dt = time.perf_counter() - t0
+    batch = next(iter(feed.values())).shape[0]
+    return first_ms, batch * iters / dt, out
+
+
+def bench_conv_infer(on_tpu):
+    import paddle_tpu.static as static
+    from paddle_tpu.core import flags
+    from paddle_tpu.static import passes as P
+
+    batch = 64 if on_tpu else 8
+    warmup, iters = (3, int(os.environ.get("BENCH_ITERS", "30"))) \
+        if on_tpu else (1, 5)
+    main, startup, out, size = _conv_tower(on_tpu)
+    rng = np.random.default_rng(0)
+    feed = {"img": rng.standard_normal(
+        (batch, 3, size, size)).astype(np.float32)}
+
+    # traced-op-count delta straight from the pipeline the flag runs
+    _rw, report = P.PassManager(P.DEFAULT_PIPELINE).apply(
+        main, feed_names={"img"}, fetch_names=[out.name])
+
+    saved = flags.get_flags(["opt_passes"])
+    results = {}
+    try:
+        for mode in ("", "default"):
+            flags.set_flags({"opt_passes": mode})
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                exe = static.Executor()
+                exe.run(startup)
+            results[mode or "off"] = _infer_loop(
+                exe, main, feed, [out], scope, warmup, iters)
+    finally:
+        flags.set_flags(saved)
+    first_off, ips_off, ref = results["off"]
+    first_on, ips_on, got = results["default"]
+    err = float(np.abs(np.asarray(got) - np.asarray(ref)).max())
+    return dict(metric="conv_infer_throughput", imgs_per_sec=ips_on,
+                model="conv_infer", batch=batch, size=size, iters=iters,
+                ops_traced_before=report.ops_before,
+                ops_traced_after=report.ops_after,
+                compile_ms={"opt_off": round(first_off, 1),
+                            "opt_on": round(first_on, 1)},
+                vs_opt_off=round(ips_on / ips_off, 4),
+                opt_abs_err=err)
+
+
+def bench_int8_infer(on_tpu):
+    import paddle_tpu.static as static
+    from paddle_tpu.slim import quant_static
+    from paddle_tpu.static import passes as P
+
+    batch = 64 if on_tpu else 8
+    warmup, iters = (3, int(os.environ.get("BENCH_ITERS", "30"))) \
+        if on_tpu else (1, 5)
+    main, startup, out, size = _conv_tower(on_tpu)
+    rng = np.random.default_rng(0)
+    feed = {"img": rng.standard_normal(
+        (batch, 3, size, size)).astype(np.float32)}
+
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+    # float baseline BEFORE PTQ mutates the weights in scope
+    _first, ips_f32, float_out = _infer_loop(exe, main, feed, [out], scope,
+                                             warmup, iters)
+    with static.scope_guard(scope):
+        ptq = quant_static.PostTrainingQuantization(
+            exe, program=main, feed_names=["img"],
+            batch_generator=lambda: iter([feed]), batch_nums=1, scope=scope)
+        qprog = ptq.quantize()
+    rewritten, _report = P.PassManager(P.QUANT_INFER_PIPELINE).apply(
+        qprog, feed_names={"img"}, fetch_names=[out.name])
+    quant_ops = sum(1 for op in rewritten.global_block().ops
+                    if op.type.startswith("quant_"))
+    first_ms, ips_q, q_out = _infer_loop(exe, rewritten, feed, [out.name],
+                                         scope, warmup, iters)
+    scale = float(np.abs(np.asarray(float_out)).max()) or 1.0
+    err = float(np.abs(np.asarray(q_out)
+                       - np.asarray(float_out)).max()) / scale
+    return dict(metric="int8_infer_throughput", imgs_per_sec=ips_q,
+                model="int8_infer", batch=batch, size=size, iters=iters,
+                quant_ops=quant_ops, compile_ms=round(first_ms, 1),
+                vs_f32=round(ips_q / ips_f32, 4),
+                int8_rel_err=round(err, 5))
+
+
 def main():
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    runs = {"resnet50": bench_resnet50, "yolov3": bench_yolov3}
+    runs = {"resnet50": bench_resnet50, "yolov3": bench_yolov3,
+            "conv_infer": bench_conv_infer, "int8_infer": bench_int8_infer}
     if which != "all" and which not in runs:
         sys.exit(f"usage: bench_vision.py [{'|'.join(runs)}|all] "
                  f"(got {which!r})")
@@ -198,26 +339,30 @@ def main():
     for name in targets:
         r = runs[name](on_tpu)
         ips = r.pop("imgs_per_sec")
-        flops = 3 * _FWD_FLOPS[name] * (r["size"] / (224 if name ==
-                                        "resnet50" else 416)) ** 2
-        mfu = round(ips * flops / _PEAK[platform], 4) \
-            if platform in _PEAK else None
+        mfu = None
+        if name in _FWD_FLOPS and platform in _PEAK:
+            flops = 3 * _FWD_FLOPS[name] * (r["size"] / (224 if name ==
+                                            "resnet50" else 416)) ** 2
+            mfu = round(ips * flops / _PEAK[platform], 4)
         loss = r.pop("loss", None)
         aot = r.pop("_aot", None)
         roofline = (_roofline_block(aot, measured_ms=1000.0 * r["batch"] / ips)
                     if aot is not None else None)
-        print(json.dumps({
+        line = {
             "metric": r.pop("metric"),
             "value": round(ips, 2),
             "unit": "imgs/sec/chip",
-            "vs_baseline": round(ips / _BASELINE_IPS[name], 4),
             "platform": platform,
             "mfu_est": mfu,
             **r,
-            "loss": round(loss, 4) if loss is not None and np.isfinite(loss)
-            else None,  # NaN would break the one-JSON-line contract
-            "roofline": roofline,
-        }))
+        }
+        if name in _BASELINE_IPS:
+            line["vs_baseline"] = round(ips / _BASELINE_IPS[name], 4)
+            # NaN would break the one-JSON-line contract
+            line["loss"] = round(loss, 4) \
+                if loss is not None and np.isfinite(loss) else None
+            line["roofline"] = roofline
+        print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -85,6 +85,28 @@ define_flag("use_fused_layer_norm", True, "Use the Pallas fused LayerNorm "
             "~3 fwd / ~5 bwd for the jnp lowering).")
 define_flag("matmul_precision", "default", "jax.lax precision for matmuls: "
             "default|high|highest.")
+define_flag("use_pallas_conv_fused", True, "Use the Pallas fused "
+            "conv+BN+activation kernel family (ops/pallas/conv_fused.py) to "
+            "back the fused_conv2d_bn_act op on TPU where the shape gates "
+            "hold: inference folds the per-channel a*x+b BN transform into "
+            "an epilogue on the conv's output tiles (one HBM pass instead of "
+            "conv + 2 elementwise passes), training fuses the BN-stats "
+            "reduction + scale/shift + activation around XLA's conv.  Off or "
+            "unsupported: the bitwise-identical unfused XLA lowering runs "
+            "(pallas.fallbacks metric).  The effective kernel set joins the "
+            "Executor compile-cache key (ops/pallas/config.fingerprint), so "
+            "toggling recompiles cleanly and steady state never retraces.")
+define_flag("use_pallas_pool", True, "Use the NHWC-native Pallas max/avg "
+            "pooling kernels (ops/pallas/pooling.py) where the shape gates "
+            "hold, so layout_nhwc propagation ends in layout-native compute "
+            "instead of per-op transposes.  Off or unsupported: the XLA "
+            "reduce_window lowering runs, bitwise identical.")
+define_flag("use_pallas_int8", True, "Use the int8 Pallas conv/matmul "
+            "kernels with fp32 per-channel dequant epilogue "
+            "(ops/pallas/int8.py) to execute quant_conv2d/quant_mul ops "
+            "minted by the quant_infer pass from slim PTQ scales.  Off or "
+            "unsupported: the simulate fallback (dequantize + float op) "
+            "runs — bitwise identical to the pre-rewrite fake-quant graph.")
 define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "
             "profiler is enabled (ref: platform/profiler.h:208).")
 define_flag("eager_log_level", 0, "VLOG-style verbosity for framework logging "

@@ -117,6 +117,56 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return out, running_mean, running_var
 
 
+def _use_fused_bn_act(x, act, data_format) -> bool:
+    """Gate for the Pallas fused train-mode BN+act kernel (backend check
+    lives in ops.pallas.config so tests can patch it once for every
+    vision kernel)."""
+    from ...ops.pallas import config as _pcfg
+    from ...ops.pallas import conv_fused as _cf
+
+    return (_pcfg.kernel_enabled("use_pallas_conv_fused")
+            and _cf.train_supported(x, act, data_format))
+
+
+def batch_norm_act(x, running_mean, running_var, weight=None, bias=None,
+                   momentum=0.9, epsilon=1e-5, act="", data_format="NHWC"):
+    """Training-mode ``act(batch_norm(x))`` as one fused unit.
+
+    The Pallas path (ops/pallas/conv_fused.fused_bn_act_train) does the
+    stats reduction in one pass and the scale/shift+activation in a
+    second, with a custom VJP implementing the classic two-pass backward
+    — this is the training-mode half of the fused_conv2d_bn_act op (XLA
+    keeps the conv; the BN/act epilogue is ours).  Falls back to
+    F.batch_norm + the activation, bitwise today's unfused behavior.
+    Returns ``(out, new_running_mean, new_running_var)``.
+    """
+    if _use_fused_bn_act(x, act, data_format):
+        from ...ops.pallas import conv_fused as _cf
+
+        c = x.shape[-1]
+        gamma = jnp.ones((c,), jnp.float32) if weight is None else weight
+        beta = jnp.zeros((c,), jnp.float32) if bias is None else bias
+        out, mean, var = _cf.fused_bn_act_train(x, gamma, beta,
+                                                float(epsilon), act)
+        mean = jax.lax.stop_gradient(mean).astype(running_mean.dtype)
+        var = jax.lax.stop_gradient(var).astype(running_var.dtype)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+        return out, new_rm, new_rv
+    out, new_rm, new_rv = batch_norm(
+        x, running_mean, running_var, weight=weight, bias=bias,
+        training=True, momentum=momentum, epsilon=epsilon,
+        data_format=data_format)
+    if act:
+        from . import activation as _act_mod
+
+        # paddle op names vs functional names: hard_swish -> hardswish etc.
+        fn = getattr(_act_mod, act, None) \
+            or getattr(_act_mod, act.replace("_", ""))
+        out = fn(out)
+    return out, new_rm, new_rv
+
+
 def bn_inference_scale_bias(mean, var, weight, bias, epsilon):
     """Fold inference-mode BN to per-channel ``a·x + b`` (fp32 a, b).
 

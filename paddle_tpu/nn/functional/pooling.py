@@ -11,6 +11,21 @@ def _pair(v, n=2):
     return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
 
 
+def _use_pallas_pool(x, kernel, stride, pads, mode, exclusive,
+                     data_format) -> bool:
+    """Gate for the NHWC-native Pallas pooling kernels: flag + TPU backend
+    (ops.pallas.config, patched by tests) + per-shape support.  Off or
+    unsupported: the lax.reduce_window path below, bitwise identical."""
+    from ...ops.pallas import config as _pcfg
+
+    if not _pcfg.kernel_enabled("use_pallas_pool"):
+        return False
+    from ...ops.pallas import pooling as _pool
+
+    return _pool.supported(x, kernel, stride, pads, mode, exclusive,
+                           data_format)
+
+
 def _pool2d(x, kernel, stride, padding, init, op, norm=None,
             data_format="NCHW"):
     kernel = _pair(kernel)
@@ -32,17 +47,30 @@ def _pool2d(x, kernel, stride, padding, init, op, norm=None,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                data_format="NCHW"):
-    out = _pool2d(x, kernel_size, stride, padding, -jnp.inf, lax.max,
-                  data_format=data_format)
     if return_mask:
         # index mask (ref: max_pool2d_with_index) computed via broadcast compare
         raise NotImplementedError("return_mask is not supported yet")
-    return out
+    kernel = _pair(kernel_size)
+    strides = _pair(stride if stride is not None else kernel)
+    pads = _pair(padding)
+    if _use_pallas_pool(x, kernel, strides, pads, "max", True, data_format):
+        from ...ops.pallas import pooling as _pool
+
+        return _pool.max_pool2d_nhwc(x, kernel, strides, pads)
+    return _pool2d(x, kernel_size, stride, padding, -jnp.inf, lax.max,
+                   data_format=data_format)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
                data_format="NCHW"):
     kernel = _pair(kernel_size)
+    strides = _pair(stride if stride is not None else kernel)
+    pads = _pair(padding)
+    if _use_pallas_pool(x, kernel, strides, pads, "avg", exclusive,
+                        data_format):
+        from ...ops.pallas import pooling as _pool
+
+        return _pool.avg_pool2d_nhwc(x, kernel, strides, pads)
     if padding == 0 or not exclusive:
         out = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add,
                       data_format=data_format)

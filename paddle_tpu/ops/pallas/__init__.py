@@ -1,3 +1,8 @@
 """Pallas TPU kernels — the rebuild's equivalent of the reference's hand-tuned
 CUDA kernels (operators/fused/, operators/math/) and CPU JIT codegen
-(operators/jit/, obsoleted by XLA for everything non-attention)."""
+(operators/jit/, obsoleted by XLA for everything non-attention).
+
+Modules: flash_attention, layer_norm, conv_fused (fused conv+BN+act,
+training BN-stats+act), pooling (NHWC max/avg), int8 (quantized conv/matmul
+with fp32 dequant epilogue), config (flag gates, compile-cache fingerprint,
+xprof cost registry)."""

@@ -1,0 +1,113 @@
+"""Kernel-selection config shared by the Pallas kernels and the Executor.
+
+Three concerns live here so every kernel module and every dispatch site
+agrees on them:
+
+* **Gating** — `kernel_enabled(flag)` is the single backend+flag gate the
+  functional dispatch sites use.  Kernels run in interpret mode off-TPU
+  for tests, but production CPU/GPU paths should not pay the interpret
+  overhead, so the gate requires a TPU backend; tests monkeypatch
+  `backend_is_tpu` to force the Pallas branch on CPU CI.
+* **Cache identity** — `fingerprint()` folds the *effective* kernel set
+  (flag AND backend) into a short string the Executor joins into both its
+  in-memory and persistent compile-cache keys.  Kernel selection happens
+  at trace time, so two traces under different kernel configs are
+  different executables: the fingerprint makes a flag flip a clean
+  recompile instead of a stale cache hit, and keeps steady-state runs at
+  zero retraces (pinned by tests/test_pallas_vision.py).
+* **Honest attribution** — kernels register per-call cost models
+  (`register_cost`) so utils/xprof.py can price the custom-call
+  instructions a `pallas_call` lowers to (otherwise fused programs would
+  drop out of the dot/conv flops model), and tools/kernelbench.py can
+  report modeled-vs-measured roofline numbers from the same source.
+
+Schema: bump `_SCHEMA` whenever a kernel's numerics or tiling change in a
+way that invalidates cached executables compiled under the same flag set.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+
+from paddle_tpu.core import flags
+from paddle_tpu.utils import monitor
+
+_SCHEMA = 1
+
+# (short tag, flag name) for every Pallas kernel family, sorted by tag.
+# The short tag keeps the fingerprint compact; the flag is the user knob.
+_KERNEL_FLAGS: Tuple[Tuple[str, str], ...] = (
+    ("conv", "use_pallas_conv_fused"),
+    ("fa", "use_flash_attention"),
+    ("int8", "use_pallas_int8"),
+    ("ln", "use_fused_layer_norm"),
+    ("pool", "use_pallas_pool"),
+)
+
+
+def backend_is_tpu() -> bool:
+    """Separated from `kernel_enabled` so tests can monkeypatch it and run
+    the kernels in interpret mode on CPU CI."""
+    return jax.default_backend() == "tpu"
+
+
+def kernel_enabled(flag_name: str) -> bool:
+    """Flag on AND a TPU backend (per-shape `supported()` gates are the
+    kernel module's job, checked at the dispatch site)."""
+    return bool(flags.get_flag(flag_name)) and backend_is_tpu()
+
+
+def fingerprint() -> str:
+    """Effective kernel set as a cache-key part, e.g.
+    ``pk1:conv=1,fa=1,int8=1,ln=1,pool=1`` (all-zero off-TPU)."""
+    bits = ",".join(f"{tag}={int(kernel_enabled(name))}"
+                    for tag, name in _KERNEL_FLAGS)
+    return f"pk{_SCHEMA}:{bits}"
+
+
+def cache_key_part() -> str:
+    """`fingerprint()` when any kernel is effective, else "" — an empty
+    effective set traces exactly the pre-kernel executable, so legacy and
+    CPU compile-cache keys stay byte-identical."""
+    fp = fingerprint()
+    return fp if "=1" in fp else ""
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: which kernels actually ran, and which dispatches fell back.
+# ---------------------------------------------------------------------------
+_m_calls = monitor.counter(
+    "pallas.kernel_calls",
+    "Pallas kernel wrapper invocations (trace-time), labeled by kernel.",
+    labelnames=("kernel",))
+_m_fallbacks = monitor.counter(
+    "pallas.fallbacks",
+    "Dispatches that fell back to the XLA lowering, labeled kernel/reason.",
+    labelnames=("kernel", "reason"))
+
+
+def record_call(kernel: str) -> None:
+    _m_calls.inc(kernel=kernel)
+
+
+def record_fallback(kernel: str, reason: str = "unsupported") -> None:
+    _m_fallbacks.inc(kernel=kernel, reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# Cost registry: kernel tag -> fn(HloInstr) -> flops.  Tags are the
+# jax.named_scope strings the wrappers emit ("pallas.<kernel>"), matched
+# as substrings of custom-call metadata op_name by utils/xprof.py.
+# ---------------------------------------------------------------------------
+_COSTS: Dict[str, Callable] = {}
+
+
+def register_cost(tag: str, instr_flops_fn: Callable) -> None:
+    _COSTS[tag] = instr_flops_fn
+    from paddle_tpu.utils import xprof  # lazy: keep import-time deps light
+    xprof.register_custom_call_cost(tag, instr_flops_fn)
+
+
+def registered_costs() -> Dict[str, Callable]:
+    return dict(_COSTS)

@@ -113,13 +113,32 @@ class Server:
     # -- setup ---------------------------------------------------------------
     def add_tenant(self, name: str, program, feed_names: Sequence[str],
                    fetch_list: Sequence, scope,
-                   quota: Optional[int] = None) -> Tenant:
+                   quota: Optional[int] = None,
+                   quantize: bool = False) -> Tenant:
         """Register a tenant program.  The program and its feed names are
         statically verified against this server's bucket ladder right here
         (static/shardcheck.py SC007 + the PV program checks) — a bad feed
         name or a batch dim no bucket can hold fails at registration with a
-        named diagnostic instead of at the first submit."""
+        named diagnostic instead of at the first submit.
+
+        ``quantize=True`` runs the program through the ``quant_infer``
+        pipeline (static/passes.py QUANT_INFER_PIPELINE) at registration:
+        PTQ artifacts (``weight_scale`` attrs + fixed-scale activation
+        quant ops left by slim/quant_static.py) fold into int8 ops that
+        dispatch to the ops/pallas/int8 kernels when gated.  The rewrite
+        runs under the VerifiedRewrite contract; a program with no quant
+        artifacts passes through unchanged."""
         from ..core import flags as _flags
+
+        if quantize:
+            from ..static import passes as _passes
+
+            fetch_names = [f if isinstance(f, str) else f.name
+                           for f in fetch_list]
+            program, _report = _passes.PassManager(
+                _passes.QUANT_INFER_PIPELINE).apply(
+                program, feed_names=set(feed_names),
+                fetch_names=fetch_names)
 
         if _flags.get_flag("check_sharding"):
             from ..static.shardcheck import _check_serving_buckets

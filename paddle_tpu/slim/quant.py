@@ -25,6 +25,42 @@ from ..nn import functional as F
 from ..nn.layer.base import Layer, Parameter
 
 
+# ----------------------------------------------------------- scale axes --
+# Per-channel weight scales are ALWAYS indexed by the op's *output-channel*
+# axis — the axis that survives the contraction and lands on the NHWC lane
+# (minor) axis of the op's output, so a ``(O,)`` scale vector broadcasts
+# over output tiles with no transpose and dequantization can happen AFTER
+# the int32 accumulation (ops/pallas/int8.py's epilogue).  Which axis that
+# is depends on the weight layout:
+#
+# - conv-family filters are OIHW: output channels on axis 0;
+# - mul/matmul ``Y`` weights are (in, out): output channels on the LAST
+#   axis.  Axis 0 there is the contraction axis — a scale indexed by it
+#   cannot be applied after accumulation, so quantizing on it silently
+#   breaks per-channel int8 inference (each output column mixes every
+#   "channel's" scale).
+_WEIGHT_QUANT_AXIS = {
+    "conv2d": 0, "depthwise_conv2d": 0, "conv3d": 0,
+    "mul": -1, "matmul": -1, "matmul_v2": -1,
+}
+
+
+def weight_quant_axis(op_type: str, ndim: int) -> int:
+    """Normalized per-channel quant axis for ``op_type``'s weight input.
+
+    The single source of truth shared by the static QAT/PTQ passes
+    (slim/quant_static.py), the dygraph wrappers below, and the int8
+    lowerings (static/ops_fused.py) — see the scale-axis contract above.
+    """
+    axis = _WEIGHT_QUANT_AXIS.get(op_type, 0)
+    return axis % ndim if ndim else 0
+
+
+def conv_quant_axis() -> int:
+    """OIHW output-channel axis (= the NHWC lane axis of the conv output)."""
+    return 0
+
+
 # ------------------------------------------------------------ fake quant --
 def _ste(x, q):
     """Straight-through estimator: forward q, backward identity."""

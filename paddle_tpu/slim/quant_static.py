@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..static.framework import Operator, Parameter, Program
+from .quant import weight_quant_axis
 
 # op type -> (weight slot, activation slots) (ref
 # QuantizationTransformPass._quantizable_ops + op IO conventions)
@@ -75,7 +76,8 @@ class QuantizationTransformPass:
                         if qname is None:
                             if _is_param(block, name):
                                 qname = self._insert_weight_quant(
-                                    block, new_ops, name)
+                                    block, new_ops, name,
+                                    op.type if slot == wslot else "conv2d")
                             else:
                                 qname = self._insert_act_quant(
                                     block, new_ops, name, program,
@@ -86,18 +88,23 @@ class QuantizationTransformPass:
         block.set_ops(new_ops)
         return program
 
-    def _insert_weight_quant(self, block, new_ops, name: str) -> str:
+    def _insert_weight_quant(self, block, new_ops, name: str,
+                             consumer_type: str = "conv2d") -> str:
         v = block.var(name)
         out = block.create_var(name=f"{name}.quantized", shape=v.shape,
                                dtype=v.dtype)
         if self.weight_type == "channel_wise_abs_max":
-            n_scale = v.shape[0] if v.ndim else 1
+            # per-OUTPUT-channel scales: OIHW axis 0 for conv filters, the
+            # last axis for (in, out) mul/matmul weights — see the
+            # scale-axis contract in slim/quant.py
+            qaxis = weight_quant_axis(consumer_type, v.ndim)
+            n_scale = v.shape[qaxis] if v.ndim else 1
             scale = block.create_var(name=f"{name}.quant_scale",
                                      shape=(n_scale,), dtype="float32")
             new_ops.append(Operator(
                 block, "fake_channel_wise_quantize_dequantize_abs_max",
                 {"X": [name]}, {"Out": [out.name], "OutScale": [scale.name]},
-                {"bit_length": self.weight_bits, "quant_axis": 0}))
+                {"bit_length": self.weight_bits, "quant_axis": qaxis}))
         else:  # abs_max
             scale = block.create_var(name=f"{name}.quant_scale", shape=(1,),
                                      dtype="float32")
@@ -158,12 +165,13 @@ class QuantizationFreezePass:
                     and _is_param(block, op.inputs["X"][0]):
                 wname = op.inputs["X"][0]
                 w = np.asarray(self.scope.find_var(wname))
-                red = tuple(range(1, w.ndim))
+                qaxis = int(op.attrs.get("quant_axis", 0)) % max(w.ndim, 1)
+                red = tuple(i for i in range(w.ndim) if i != qaxis)
                 scale = np.maximum(np.abs(w).max(axis=red), 1e-8)
-                q = np.round(
-                    w / scale.reshape((-1,) + (1,) * (w.ndim - 1)) * qmax_w)
-                wq = q / qmax_w * scale.reshape(
-                    (-1,) + (1,) * (w.ndim - 1))
+                rs_shape = [1] * w.ndim
+                rs_shape[qaxis] = -1
+                rs = scale.reshape(rs_shape)
+                wq = np.round(w / rs * qmax_w) / qmax_w * rs
                 self.scope.set(wname, wq.astype(w.dtype))
                 renames[op.outputs["Out"][0]] = wname
                 scales[wname] = scale
@@ -264,9 +272,12 @@ class PostTrainingQuantization:
                 for wname in op.inputs.get(wslot, []):
                     if _is_param(block, wname) and wname not in done_weights:
                         w = np.asarray(self.scope.find_var(wname))
-                        red = tuple(range(1, w.ndim))
+                        qaxis = weight_quant_axis(op.type, w.ndim)
+                        red = tuple(i for i in range(w.ndim) if i != qaxis)
                         scale = np.maximum(np.abs(w).max(axis=red), 1e-8)
-                        rs = scale.reshape((-1,) + (1,) * (w.ndim - 1))
+                        rs_shape = [1] * w.ndim
+                        rs_shape[qaxis] = -1
+                        rs = scale.reshape(rs_shape)
                         self.scope.set(
                             wname,
                             (np.round(w / rs * qmax_w) / qmax_w * rs

@@ -2270,6 +2270,10 @@ _INFER_RULES.update({
     # is exactly the anchor op's (conv2d / mul respectively)
     "fused_conv2d_bn_act": _rule_conv2d,
     "fused_matmul_bias_act": _rule_mul,
+    # quant_infer-emitted int8 inference ops (static/passes.py): int8
+    # compute is internal, the op's IO contract is the float anchor's
+    "quant_conv2d": _rule_conv2d,
+    "quant_mul": _rule_mul,
 })
 
 

@@ -121,14 +121,18 @@ def build_cache_key(program, seed: int, fetch_names: Sequence[str],
                     feed_arrays: Dict[str, Any], donated: Dict[str, Any],
                     carried: Dict[str, Any], donate: bool,
                     plan_fingerprint: Optional[str],
-                    entry: str = "", passes: str = "") -> str:
+                    entry: str = "", passes: str = "",
+                    kernel: str = "") -> str:
     """SHA-256 key for one compiled step artifact (see module docstring for
     what is deliberately included).  ``entry`` is the Executor's entry-key
     partition (serving shape buckets); ``passes`` is the graph-rewrite
-    pipeline fingerprint (static/passes.py) the program was compiled under.
-    Each rides the key only when set, so bucket-keyed / pass-optimized
-    artifacts never collide with the default's and legacy keys are
-    unchanged."""
+    pipeline fingerprint (static/passes.py) the program was compiled under;
+    ``kernel`` is the effective Pallas kernel-config fingerprint
+    (ops/pallas/config.py) — kernel selection happens at trace time, so
+    artifacts traced under different kernel sets are different executables.
+    Each rides the key only when set, so bucket-keyed / pass-optimized /
+    kernel-gated artifacts never collide with the default's and legacy
+    keys are unchanged."""
     import jax
     import jaxlib
 
@@ -156,6 +160,8 @@ def build_cache_key(program, seed: int, fetch_names: Sequence[str],
         parts = parts + (f"entry={entry}",)
     if passes:
         parts = parts + (f"passes={passes}",)
+    if kernel:
+        parts = parts + (f"kernel={kernel}",)
     return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
 

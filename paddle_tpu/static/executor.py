@@ -470,10 +470,12 @@ class _CacheEntry:
 
     __slots__ = ("key", "compiled", "version", "donate", "plan_token",
                  "fetch_names", "feed_sig", "state_names", "needs_value",
-                 "op_count", "fingerprint", "disk_cache", "aot", "mem")
+                 "op_count", "fingerprint", "kernel_fp", "disk_cache",
+                 "aot", "mem")
 
     def __init__(self, key, version, donate, plan_token, fetch_names,
-                 feed_arrays, state_names, needs_value, op_count, fingerprint):
+                 feed_arrays, state_names, needs_value, op_count, fingerprint,
+                 kernel_fp=""):
         self.key = key
         self.compiled = None
         self.version = version
@@ -486,14 +488,16 @@ class _CacheEntry:
         self.needs_value = frozenset(needs_value)
         self.op_count = op_count
         self.fingerprint = fingerprint
+        self.kernel_fp = kernel_fp
         self.disk_cache = "off"  # persistent-cache provenance: hit|miss|off
         self.aot = None  # AOT executable when telemetry compiled one —
         self.mem = None  # xprof's attribution source + its memory breakdown
 
     def matches(self, version, fetch_names, feed_arrays, plan_token,
-                donate) -> bool:
+                donate, kernel_fp="") -> bool:
         if (self.version != version or self.donate != donate
                 or self.plan_token != plan_token
+                or self.kernel_fp != kernel_fp
                 or self.fetch_names != fetch_names
                 or len(self.feed_sig) != len(feed_arrays)):
             return False
@@ -578,11 +582,19 @@ class Executor:
         # re-walk.  Distinct entry keys (shape buckets) never evict each
         # other's hot slot.
         hot_key = (getattr(program, "_exec_cache_token", None), entry_key)
+        # kernel-config fingerprint (ops/pallas/config.py): kernel selection
+        # happens at trace time, so a flag flip (or backend-gate change)
+        # must be a clean recompile, never a stale hot-entry hit
+        from ..ops.pallas import config as _pcfg
+
+        kernel_fp = _pcfg.cache_key_part()
         entry = self._hot.get(hot_key)
         if entry is None or not entry.matches(program._version, fetch_names,
-                                              feed_arrays, plan_token, donate):
+                                              feed_arrays, plan_token, donate,
+                                              kernel_fp):
             entry = self._cold_lookup(program, fetch_names, feed_arrays,
-                                      plan_token, donate, entry_key)
+                                      plan_token, donate, entry_key,
+                                      kernel_fp)
 
         state, missing = {}, None
         for n in entry.state_names:
@@ -673,7 +685,8 @@ class Executor:
                         exec_program, seed, fetch_names, feed_arrays,
                         d_state, p_state, donate,
                         plan.fingerprint() if plan is not None else None,
-                        entry=entry_key or "", passes=passes_fp)
+                        entry=entry_key or "", passes=passes_fp,
+                        kernel=entry.kernel_fp)
                 (entry.compiled, entry.disk_cache, cost,
                  entry.aot) = self._build(
                     exec_program, fetch_names, entry.state_names, seed,
@@ -764,7 +777,7 @@ class Executor:
         return list(fetches)
 
     def _cold_lookup(self, program, fetch_names, feed_arrays, plan_token,
-                     donate, entry_key=None) -> _CacheEntry:
+                     donate, entry_key=None, kernel_fp="") -> _CacheEntry:
         """Full cache-key build (sorted feed signature + program walk); the
         resulting entry is pinned on the hot map (keyed by program token ×
         entry key) so steady-state calls skip this entirely."""
@@ -772,7 +785,7 @@ class Executor:
         key = (token, entry_key, program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
-               plan_token, donate)
+               plan_token, donate, kernel_fp)
         entry = self._cache.get(key)
         if entry is None:
             state_names = self._state_names(program, global_scope())
@@ -783,7 +796,8 @@ class Executor:
                 op_count=sum(len(b.ops) for b in program.blocks),
                 # cache token + program version identify the exact compiled
                 # artifact on spans/flight events
-                fingerprint=f"{token}v{program._version}")
+                fingerprint=f"{token}v{program._version}",
+                kernel_fp=kernel_fp)
             self._cache[key] = entry
         self._hot[(token, entry_key)] = entry
         return entry

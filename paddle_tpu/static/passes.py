@@ -52,7 +52,8 @@ from .framework import Block, Operator, Program
 
 __all__ = [
     "PassManager", "PassContext", "PipelineReport", "ParityReport",
-    "DEFAULT_PIPELINE", "available_passes", "pipeline_from_flag",
+    "DEFAULT_PIPELINE", "QUANT_INFER_PIPELINE", "available_passes",
+    "pipeline_from_flag",
     "optimize_for_executor", "golden_parity", "verify_rewrite",
     "use_def_chains", "liveness", "reachable_ops", "is_pure",
     "RANDOM_OPS", "CONTROL_FLOW_OPS",
@@ -475,16 +476,20 @@ class DCE(Pass):
 
 
 class FuseConvBNAct(Pass):
-    """conv2d → batch_norm(is_test) [→ act] ⇒ ``fused_conv2d_bn_act``
+    """conv2d → batch_norm [→ act] ⇒ ``fused_conv2d_bn_act``
     (ref conv_bn_fuse_pass.cc + conv_elementwise_add_act_fuse_pass.cc).
 
     The generalized replacement for the r05 hand-fold: instead of every
     inference batch_norm paying a per-activation a·x+b
     (nn/functional/norm.py), the pass folds the BN into the conv *filter*
-    (see static/ops_fused.py).  Only fires on inference BN — a training
-    batch_norm updates running stats, and its MeanOut/VarianceOut writes
-    are real; is_test BN writes back its inputs unchanged, so dropping
-    the op is exact."""
+    (see static/ops_fused.py).  Training batch_norms fuse too: the fused
+    op keeps the ``MeanOut``/``VarianceOut`` running-stat writes (which
+    alias ``Mean``/``Variance`` in place, exactly as layers.batch_norm
+    emits them) and records ``is_test``/``momentum``, and its lowering
+    routes through nn.functional.norm.batch_norm_act — differentiable, so
+    the pass no longer bails on programs with a ``backward_region`` (that
+    pseudo-op references only Loss/Params by name, never intermediates,
+    so single-use matching stays exact in training graphs)."""
 
     name = "fuse_conv_bn_act"
 
@@ -492,8 +497,6 @@ class FuseConvBNAct(Pass):
         from .ops_fused import FUSABLE_ACTS
 
         block = program.global_block()
-        if any(op.type == "backward_region" for op in block.ops):
-            return {"changed": False, "fused": 0}
         fused = 0
         while True:
             match = self._find(block, ctx, FUSABLE_ACTS)
@@ -516,10 +519,11 @@ class FuseConvBNAct(Pass):
                 continue
             j = use[0]
             bn = block.ops[j]
-            if (bn.type != "batch_norm" or j <= idx
-                    or not bn.attrs.get("is_test", False)):
+            if bn.type != "batch_norm" or j <= idx:
                 continue
-            # the inference write-back must be the identity alias
+            # the running-stat write-back must be the in-place alias (both
+            # modes: is_test writes inputs unchanged, training updates the
+            # same vars — either way the fused op preserves the contract)
             if (bn.outputs.get("MeanOut", [None])[0]
                     != bn.inputs.get("Mean", [None])[0]
                     or bn.outputs.get("VarianceOut", [None])[0]
@@ -557,9 +561,15 @@ class FuseConvBNAct(Pass):
                  "dilations": conv.attrs.get("dilations", 1),
                  "groups": conv.attrs.get("groups", 1),
                  "data_format": conv.attrs.get("data_format", "NCHW"),
-                 "epsilon": bn.attrs.get("epsilon", 1e-5), "act": act}
-        block.replace_op(idx, "fused_conv2d_bn_act", ins,
-                         {"Output": [final]}, attrs)
+                 "epsilon": bn.attrs.get("epsilon", 1e-5), "act": act,
+                 "is_test": bn.attrs.get("is_test", False),
+                 "momentum": bn.attrs.get("momentum", 0.9)}
+        outs = {"Output": [final]}
+        if not attrs["is_test"]:
+            # training: the running-stat updates are real — keep them
+            outs["MeanOut"] = bn.outputs["MeanOut"]
+            outs["VarianceOut"] = bn.outputs["VarianceOut"]
+        block.replace_op(idx, "fused_conv2d_bn_act", ins, outs, attrs)
         for dead in sorted([x for x in (j, k) if x is not None],
                            reverse=True):
             block.remove_op(dead)
@@ -650,12 +660,107 @@ class FuseMatmulBiasAct(Pass):
             block.remove_op(dead)
 
 
+class QuantInfer(Pass):
+    """PTQ artifacts ⇒ int8 inference ops: ``conv2d``/``mul`` carrying
+    ``weight_scale`` attrs (left by QuantizationFreezePass / the static
+    PostTrainingQuantization — slim/quant_static.py) whose activation
+    input comes through a ``fake_quantize_dequantize_fixed_scale`` op
+    become ``quant_conv2d`` / ``quant_mul`` with the input scale folded
+    into attrs (and the qdq op deleted when nothing else reads it).
+
+    The rewritten ops' lowerings (static/ops_fused.py) run the
+    ops/pallas/int8 kernels when gated — int8 MXU dots, int32
+    accumulation, fp32 per-channel dequant epilogue — and otherwise a
+    *simulate* fallback that replays the exact fake-quant + float-op
+    sequence this pass removed, so flag-off golden parity is bitwise.
+    A trailing attr-free activation the int8 epilogue supports is
+    absorbed like FuseConvBNAct does.  Not in the default pipeline:
+    quantized inference opts in via ``opt_passes="quant_infer,..."`` or
+    serving's ``quantize=`` tenant option."""
+
+    name = "quant_infer"
+
+    # op type -> (activation slot, output slot, quant op type)
+    _TARGETS = {"conv2d": ("Input", "Output", "quant_conv2d"),
+                "mul": ("X", "Out", "quant_mul")}
+    # acts the int8 kernels take as epilogue (ops/pallas/int8.EPILOGUE_ACTS)
+    _ACTS = frozenset({"relu", "relu6", "sigmoid", "tanh"})
+
+    def run(self, program, ctx):
+        block = program.global_block()
+        if any(op.type == "backward_region" for op in block.ops):
+            return {"changed": False, "fused": 0}   # inference-only rewrite
+        rewritten = 0
+        while True:
+            match = self._find(block, ctx)
+            if match is None:
+                break
+            self._apply(block, *match)
+            rewritten += 1
+        return {"changed": rewritten > 0, "fused": rewritten}
+
+    def _find(self, block, ctx):
+        defs, uses = use_def_chains(block)
+        for idx, op in enumerate(block.ops):
+            spec = self._TARGETS.get(op.type)
+            if spec is None or "weight_scale" not in op.attrs:
+                continue
+            aslot, oslot, _qtype = spec
+            a_name = op.inputs.get(aslot, [None])[0]
+            if a_name is None:
+                continue
+            d = defs.get(a_name, ())
+            if len(d) != 1:
+                continue
+            q_idx = d[0][0]
+            qdq = block.ops[q_idx]
+            if (qdq.type != "fake_quantize_dequantize_fixed_scale"
+                    or q_idx >= idx or "scale" not in qdq.attrs):
+                continue
+            # qdq op removable only when this op is its sole reader
+            removable = (len(uses.get(a_name, ())) == 1
+                         and not ctx.protected(block, a_name))
+            # absorb a trailing attr-free act the int8 epilogue supports
+            out_name = op.outputs.get(oslot, [None])[0]
+            k = None
+            act = ""
+            o_use = _single_def_use(defs, uses, out_name) \
+                if out_name and not ctx.protected(block, out_name) else None
+            if o_use is not None and o_use[1] == "X":
+                cand = block.ops[o_use[0]]
+                if (o_use[0] > idx and cand.type in self._ACTS
+                        and not cand.attrs
+                        and len(cand.outputs.get("Out", ())) == 1):
+                    k, act = o_use[0], cand.type
+            return idx, q_idx, removable, k, act
+        return None
+
+    def _apply(self, block, idx, q_idx, removable, k, act):
+        op, qdq = block.ops[idx], block.ops[q_idx]
+        aslot, oslot, qtype = self._TARGETS[op.type]
+        ins = dict(op.inputs)
+        ins[aslot] = list(qdq.inputs["X"])
+        outs = {s: list(names) for s, names in op.outputs.items()}
+        if k is not None:
+            outs[oslot] = [block.ops[k].outputs["Out"][0]]
+        attrs = dict(op.attrs)
+        attrs["in_scale"] = float(qdq.attrs["scale"])
+        attrs["in_bits"] = int(qdq.attrs.get("bit_length", 8))
+        attrs["act"] = act
+        block.replace_op(idx, qtype, ins, outs, attrs)
+        _m_quant_ops.inc(**{"op": op.type})
+        for dead in sorted([x for x in (k, q_idx if removable else None)
+                            if x is not None], reverse=True):
+            block.remove_op(dead)
+
+
 _NCHW_TO_NHWC = (0, 2, 3, 1)
 _NHWC_TO_NCHW = (0, 3, 1, 2)
 # 4-D ops whose lowerings take data_format (ops.py _conv2d/_pool2d,
-# ops_fused._fused_conv2d_bn_act via F.conv2d)
+# ops_fused._fused_conv2d_bn_act via F.conv2d, ops_fused._quant_conv2d)
 _LAYOUT_OPS = {"conv2d": ("Input", "Output"),
                "fused_conv2d_bn_act": ("Input", "Output"),
+               "quant_conv2d": ("Input", "Output"),
                "pool2d": ("X", "Out")}
 # value-wise single-input ops a transpose can sink through unchanged
 _SINKABLE = frozenset({
@@ -920,11 +1025,17 @@ _PASSES_SCHEMA = 1  # bump on any semantics change: rides the compile-cache key
 
 _REGISTRY: Dict[str, Pass] = {p.name: p for p in (
     ConstantFolding(), CSE(), FuseConvBNAct(), FuseMatmulBiasAct(),
-    LayoutNHWC(), DCE(),
+    QuantInfer(), LayoutNHWC(), DCE(),
 )}
 
 DEFAULT_PIPELINE = ("constant_folding", "cse", "fuse_conv_bn_act",
                     "fuse_matmul_bias_act", "layout_nhwc", "dce")
+
+# the opt-in pipeline for PTQ-calibrated inference programs: fold the quant
+# artifacts to int8 ops first, then lay out NHWC (quant_conv2d is in
+# _LAYOUT_OPS) and sweep the orphaned qdq chains
+QUANT_INFER_PIPELINE = ("constant_folding", "cse", "quant_infer",
+                        "fuse_matmul_bias_act", "layout_nhwc", "dce")
 
 
 def available_passes() -> List[str]:
@@ -947,6 +1058,10 @@ _m_ops_fused = _monitor.counter(
 _m_pipeline_ms = _monitor.histogram(
     "passes.pipeline_ms", "Wall-clock of one pipeline application "
     "(clone + passes + verification).")
+_m_quant_ops = _monitor.counter(
+    "quant.ops_rewritten", "float ops rewritten to int8 quant ops by the "
+    "quant_infer pass, labeled by the original op type.",
+    labelnames=("op",))
 
 
 @dataclass

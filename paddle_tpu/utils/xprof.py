@@ -39,8 +39,11 @@ dumps so a crash dump carries a perf snapshot.
 
 Model limitations (documented, reported, never silently wrong): loop
 bodies are counted once (trip counts are dynamic), custom-calls model 0
-flops (bytes still count), and bytes are modeled at fusion granularity —
-fused intermediates are register traffic, not HBM.
+flops unless the owning kernel registered a cost model
+(``register_custom_call_cost`` — every ops/pallas kernel does, keyed by
+its ``pallas.<kernel>`` scope tag, so fused-kernel programs keep ≥90%
+attribution coverage; bytes always count), and bytes are modeled at
+fusion granularity — fused intermediates are register traffic, not HBM.
 """
 from __future__ import annotations
 
@@ -269,11 +272,38 @@ def parse_hlo(text: str) -> Tuple[Dict[str, List[HloInstr]], List[str]]:
     return comps, entries
 
 
+# custom-call cost registry: Pallas kernels lower to custom-call
+# instructions XLA's shape-based model cannot price, so each kernel
+# wrapper emits a ``jax.named_scope("pallas.<kernel>")`` tag (it survives
+# into metadata.op_name) and registers fn(HloInstr) -> flops here via
+# ops/pallas/config.register_cost.  Bytes need no registry: custom-call
+# operand/output bytes are already counted by _instr_bytes.
+_CUSTOM_CALL_COSTS: Dict[str, Any] = {}
+
+
+def register_custom_call_cost(tag: str, instr_flops_fn) -> None:
+    """Price custom-call instructions whose metadata op_name contains
+    ``tag`` with ``instr_flops_fn(instr) -> flops``."""
+    _CUSTOM_CALL_COSTS[tag] = instr_flops_fn
+
+
+def _custom_call_flops(instr: HloInstr) -> float:
+    for tag, fn in _CUSTOM_CALL_COSTS.items():
+        if tag in instr.op_name:
+            try:
+                return float(fn(instr))
+            except Exception:
+                return 0.0
+    return 0.0
+
+
 def _instr_flops(instr: HloInstr) -> float:
     op = instr.opcode
     if not instr.out_shapes:
         return 0.0
     out_elems = sum(_elems(s) for _, s in instr.out_shapes)
+    if op == "custom-call":
+        return _custom_call_flops(instr)
     if op == "dot":
         m = _LHS_CDIMS_RE.search(instr.rest)
         if m is None or not instr.operand_shapes:

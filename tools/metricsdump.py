@@ -75,12 +75,17 @@ _KNOWN_NAMES = frozenset({
     # io/prefetch.py
     "io.prefetch_batches",
     "io.prefetch_depth",
+    # ops/pallas/config.py (kernel dispatch telemetry)
+    "pallas.fallbacks",
+    "pallas.kernel_calls",
     # static/passes.py (graph-rewrite pipeline)
     "passes.ops_fused",
     "passes.ops_removed",
     "passes.pipeline_ms",
     "passes.rollbacks",
     "passes.runs",
+    # static/passes.py quant_infer (int8 inference rewrite)
+    "quant.ops_rewritten",
     # distributed/ps_server.py
     "ps.heartbeat_age_seconds",
     "ps.rpc_count",
@@ -152,7 +157,8 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.static.shardcheck  # noqa: F401 — analysis.plans_checked
     import paddle_tpu.static.compile_cache  # noqa: F401
     import paddle_tpu.static.executor  # noqa: F401 — executor.* + registry.*
-    import paddle_tpu.static.passes  # noqa: F401 — the passes.* family
+    import paddle_tpu.ops.pallas.config  # noqa: F401 — the pallas.* family
+    import paddle_tpu.static.passes  # noqa: F401 — passes.* + quant.*
     import paddle_tpu.utils.debug  # noqa: F401
     import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
     from paddle_tpu.hapi.callbacks import MetricsLogger
