@@ -70,6 +70,16 @@ if _os.environ.get("PDTPU_TRACE_DIR"):
 
     _trace.arm_from_env()
 
+# Telemetry bootstrap: under `distributed.launch --telemetry_port BASE`
+# every worker has PDTPU_TELEMETRY_PORT=BASE+rank; start the per-rank HTTP
+# telemetry plane (utils/telemetry.py: /metrics, /healthz, /flight, /xprof,
+# /spans) before user code runs.  Bind failures are flight-recorded and
+# swallowed — telemetry never kills a job.
+if _os.environ.get("PDTPU_TELEMETRY_PORT"):
+    from .utils import telemetry as _telemetry
+
+    _telemetry.start_from_env()
+
 
 def is_tensor(x) -> bool:
     import jax

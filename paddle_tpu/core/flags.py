@@ -193,6 +193,32 @@ define_flag("elastic_ckpt_dir", "", "Directory for the periodic elastic "
 define_flag("elastic_keep_last", 2, "How many elastic step checkpoints to "
             "retain under elastic_ckpt_dir (older step directories are "
             "garbage-collected after each save).")
+define_flag("telemetry_port", 0, "Serve the live telemetry plane over HTTP "
+            "on this port (utils/telemetry.py): /metrics (Prometheus text "
+            "from the utils/monitor.py registry), /healthz (elastic "
+            "membership + heartbeat age), /flight (flight-recorder ring), "
+            "/xprof (last roofline report snapshot), /spans (recent trace "
+            "spans).  0 (default): off.  `launch --telemetry_port BASE` "
+            "exports PDTPU_TELEMETRY_PORT=BASE+rank per worker so every "
+            "rank serves its own plane; the server thread is a daemon and "
+            "never blocks process exit (ref: the reference's always-on "
+            "platform/monitor.h StatValue registry, made scrapeable).")
+define_flag("watchdog", False, "Attach the training goodput watchdog "
+            "(utils/watchdog.py) to hapi Model.fit: rolling-median/MAD "
+            "step-time anomaly detection, train.goodput_pct accounting "
+            "(productive step time vs compile/restore/eviction/idle from "
+            "executor/elastic flight events), cross-rank straggler "
+            "attribution over the elastic heartbeat dir, and a "
+            "loss-spike/NaN monitor.  Anomalies are flight-recorded and "
+            "counted (watchdog.anomalies{kind}); detection never raises "
+            "into the train loop.")
+define_flag("watchdog_checkpoint_on_anomaly", False, "Let the watchdog "
+            "write a pre-emptive elastic checkpoint (elastic/checkpoint.py "
+            "save_checkpoint under elastic_ckpt_dir) when it sees a NaN/Inf "
+            "or spiking loss — the last-known-good state is on disk before "
+            "the job wastes hours diverging.  Needs elastic_ckpt_dir set "
+            "and a checkpoint state provider (Model.fit wires one "
+            "automatically when the watchdog flag is on).")
 define_flag("check_sharding", True, "Statically verify Program x "
             "ShardingPlan pairings before the Executor traces them "
             "(static/shardcheck.py, SC001-SC009): feed batch divisibility, "

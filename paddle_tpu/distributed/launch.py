@@ -45,7 +45,8 @@ def launch(training_script: str, script_args: List[str],
            nproc: int = 1, started_port: Optional[int] = None,
            log_dir: Optional[str] = None, backend_env: str = "",
            trace_dir: Optional[str] = None, max_restarts: int = 0,
-           elastic_dir: Optional[str] = None) -> int:
+           elastic_dir: Optional[str] = None,
+           telemetry_port: Optional[int] = None) -> int:
     """Spawn `nproc` worker processes with the trainer-env contract.
     Returns the first nonzero exit code, or 0.
 
@@ -64,7 +65,14 @@ def launch(training_script: str, script_args: List[str],
     abort-everyone behavior kicks in — the ref fleet elastic relaunch loop.
     ``elastic_dir`` is exported as PDTPU_ELASTIC_DIR so workers can join
     the elastic membership (elastic/membership.py ``ElasticMember.from_env``)
-    and evict ranks the launcher gave up on."""
+    and evict ranks the launcher gave up on.
+
+    Telemetry: with ``telemetry_port`` each rank gets
+    PDTPU_TELEMETRY_PORT = telemetry_port + rank, and the ``paddle_tpu``
+    import bootstrap starts that rank's HTTP telemetry plane on it
+    (utils/telemetry.py) — deterministic ports, so an operator scrapes
+    ``/metrics`` and ``/healthz`` of every rank of a live job without any
+    discovery step.  A restarted rank reuses its port (same rank env)."""
     base_port = started_port or _free_port()
     endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
     job_trace_id = uuid.uuid4().hex
@@ -94,6 +102,8 @@ def launch(training_script: str, script_args: List[str],
             env["PDTPU_TRACE_DIR"] = trace_dir
         if elastic_dir:
             env["PDTPU_ELASTIC_DIR"] = elastic_dir
+        if telemetry_port:
+            env["PDTPU_TELEMETRY_PORT"] = str(int(telemetry_port) + rank)
         for kv in backend_env.split(","):
             if "=" in kv:
                 k, v = kv.split("=", 1)
@@ -194,12 +204,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="shared membership/heartbeat directory "
                         "exported to workers as PDTPU_ELASTIC_DIR "
                         "(elastic/membership.py)")
+    parser.add_argument("--telemetry_port", type=int, default=None,
+                        help="base port for the per-rank HTTP telemetry "
+                        "plane: rank r serves /metrics, /healthz, /flight, "
+                        "/xprof, /spans on telemetry_port + r "
+                        "(utils/telemetry.py)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.training_script, args.script_args, args.nproc,
                   args.started_port, args.log_dir, args.backend_env,
-                  args.trace_dir, args.max_restarts, args.elastic_dir)
+                  args.trace_dir, args.max_restarts, args.elastic_dir,
+                  args.telemetry_port)
 
 
 if __name__ == "__main__":

@@ -42,7 +42,8 @@ from typing import Dict, List, Optional, Set, Tuple
 from ..utils import monitor as _monitor
 from ..utils import trace as _trace
 
-__all__ = ["ElasticMember", "MembershipView", "ELASTIC_DIR_ENV"]
+__all__ = ["ElasticMember", "MembershipView", "ELASTIC_DIR_ENV",
+           "read_heartbeats", "heartbeat_ages", "current_member"]
 
 ELASTIC_DIR_ENV = "PDTPU_ELASTIC_DIR"
 
@@ -51,6 +52,46 @@ _m_deaths = _monitor.counter(
     "Workers evicted from the elastic membership after their heartbeat "
     "aged past dead_after_s (counted once per eviction, by the rank that "
     "won the eviction marker).")
+
+
+def read_heartbeats(directory: str) -> Dict[int, dict]:
+    """All parseable ``hb.<rank>.json`` bodies under ``directory``, keyed by
+    rank — the raw per-rank {rank, pid, step, ts} records every membership
+    consumer (liveness, the watchdog's cross-rank straggler attribution,
+    the telemetry ``/healthz`` endpoint) joins on.  Unreadable or torn
+    files are skipped (the writer is atomic, but the rank may be dead)."""
+    out: Dict[int, dict] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for n in names:
+        if not (n.startswith("hb.") and n.endswith(".json")):
+            continue
+        try:
+            rank = int(n.split(".")[1])
+            with open(os.path.join(directory, n)) as f:
+                out[rank] = json.load(f)
+        except (OSError, ValueError, IndexError):
+            continue
+    return out
+
+
+def heartbeat_ages(directory: str,
+                   now: Optional[float] = None) -> Dict[int, float]:
+    """Seconds since each rank's last heartbeat write."""
+    now = time.time() if now is None else now
+    return {r: now - float(hb.get("ts", 0.0))
+            for r, hb in read_heartbeats(directory).items()}
+
+
+# the process's active member (set by start(), cleared by stop()) — the
+# telemetry /healthz endpoint reports membership through this handle
+_current: Optional["ElasticMember"] = None
+
+
+def current_member() -> Optional["ElasticMember"]:
+    return _current
 
 
 @dataclass
@@ -138,6 +179,7 @@ class ElasticMember:
         self.beat()
 
     def start(self) -> "ElasticMember":
+        global _current
         self.beat()
         self._running = True
 
@@ -151,13 +193,17 @@ class ElasticMember:
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
+        _current = self
         return self
 
     def stop(self) -> None:
+        global _current
         self._running = False
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if _current is self:
+            _current = None
 
     # -- observer side -------------------------------------------------------
     def _read_hb(self, rank: int) -> Optional[dict]:

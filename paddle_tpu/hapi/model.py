@@ -9,6 +9,7 @@ replaces both reference adapters and is where the MXU actually gets fed.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -209,6 +210,36 @@ class Model:
                     _flags.get_flag("elastic_ckpt_dir"),
                     save_every=int(_flags.get_flag("elastic_save_every")),
                     keep_last=int(_flags.get_flag("elastic_keep_last"))))
+        # the goodput watchdog rides the callback list the same way when
+        # the watchdog flag is on; with watchdog_checkpoint_on_anomaly +
+        # elastic_ckpt_dir it also gets a checkpoint_fn over the live fit
+        # state so a NaN/spiking loss saves a pre-divergence checkpoint
+        if _flags.get_flag("watchdog"):
+            from ..utils.watchdog import WatchdogCallback
+
+            callbacks = list(callbacks) if callbacks else []
+            if not any(isinstance(c, WatchdogCallback) for c in callbacks):
+                wcb = WatchdogCallback(
+                    heartbeat_dir=os.environ.get("PDTPU_ELASTIC_DIR"))
+                ckpt_dir = _flags.get_flag("elastic_ckpt_dir")
+                if (_flags.get_flag("watchdog_checkpoint_on_anomaly")
+                        and ckpt_dir):
+                    from ..elastic.checkpoint import (ElasticCheckpoint,
+                                                      save_checkpoint)
+
+                    # reuse ElasticCheckpoint's live-state flattening
+                    # (fit's jit path keeps params in _fit_params mid-epoch)
+                    saver = ElasticCheckpoint(ckpt_dir, save_every=0)
+                    saver.set_model(self)
+
+                    def _anomaly_ckpt(reason, _s=saver, _w=wcb):
+                        return save_checkpoint(
+                            str(ckpt_dir), _s._flat_state(), _w._gstep,
+                            keep_last=int(
+                                _flags.get_flag("elastic_keep_last")))
+
+                    wcb.watchdog.checkpoint_fn = _anomaly_ckpt
+                callbacks.append(wcb)
         cbs = cb_mod.CallbackList(callbacks, model=self,
                                   params={"epochs": epochs, "verbose": verbose,
                                           "steps": _safe_len(loader),

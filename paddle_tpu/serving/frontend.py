@@ -44,7 +44,8 @@ import numpy as np
 from ..io.prefetch import stage
 from ..utils import trace as _trace
 from .slo import (AdmissionError, BATCH_OCCUPANCY, BATCH_SIZE, LOAD_SHED,
-                  QUEUE_DEPTH, REQUESTS, SLOPolicy, TTFT_MS)
+                  QUEUE_DEPTH, REQUESTS, SLOPolicy, TTFT_MS, TTFT_BATCH_MS,
+                  TTFT_COMPILE_MS, TTFT_EXECUTE_MS, TTFT_QUEUE_MS)
 from .tenancy import Tenant, TenantManager
 
 __all__ = ["Server", "DEFAULT_BUCKET_EDGES"]
@@ -53,16 +54,19 @@ DEFAULT_BUCKET_EDGES = (1, 2, 4, 8, 16, 32)
 
 
 class _Request:
-    __slots__ = ("tenant", "feeds", "rows", "sig", "future", "t_submit")
+    __slots__ = ("tenant", "feeds", "rows", "sig", "future", "t_submit",
+                 "ctx")
 
     def __init__(self, tenant: str, feeds: Dict[str, np.ndarray], rows: int,
-                 sig: Tuple, future: "Future", t_submit: float):
+                 sig: Tuple, future: "Future", t_submit: float,
+                 ctx: _trace.SpanContext):
         self.tenant = tenant
         self.feeds = feeds
         self.rows = rows
         self.sig = sig
         self.future = future
         self.t_submit = t_submit
+        self.ctx = ctx  # per-request trace context: submit -> result
 
 
 class Server:
@@ -234,7 +238,13 @@ class Server:
             raise ValueError(
                 f"request has {rows} rows > largest bucket "
                 f"{self.max_batch}; split it client-side")
-        return _Request(t.name, arrays, rows, tuple(sig), Future(), t_submit)
+        # mint the request's trace context here, on the caller's thread, so
+        # it parents under the caller's span when there is one — the whole
+        # queue -> batch -> compile -> execute decomposition hangs off it
+        base = _trace.current_context()
+        ctx = base.child() if base is not None else _trace.SpanContext()
+        return _Request(t.name, arrays, rows, tuple(sig), Future(), t_submit,
+                        ctx)
 
     # -- dispatcher side -----------------------------------------------------
     def _bucket_for(self, rows: int) -> int:
@@ -300,33 +310,77 @@ class Server:
         tenant_name = batch[0].tenant
         rows = sum(r.rows for r in batch)
         bucket = self._bucket_for(rows)
+        # -- TTFT decomposition, segment 1: queue (submit -> pop).  The
+        # coalescing hold is part of it by design — a request pays the
+        # hold whether backlog or max_wait_ms caused it.
         t_dispatch = time.perf_counter()
         for r in batch:
             TTFT_MS.observe((t_dispatch - r.t_submit) * 1e3)
+            TTFT_QUEUE_MS.observe((t_dispatch - r.t_submit) * 1e3)
         BATCH_SIZE.observe(rows)
         BATCH_OCCUPANCY.observe(rows / bucket)
+        fr = _trace.flight_recorder()
         try:
             t = self.tenants.acquire(tenant_name)
-            feed = {}
-            for name in t.feed_names:
-                parts = [r.feeds[name] for r in batch]
-                a = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
-                if bucket > rows:
-                    pad = np.zeros((bucket - rows,) + a.shape[1:], a.dtype)
-                    a = np.concatenate([a, pad], 0)
-                feed[name] = a
-            with _trace.span("serve::dispatch", tenant=tenant_name,
-                             bucket=bucket, rows=rows, requests=len(batch)):
-                feed = stage(feed, device=self.device)
-                outs = t.executor.run(
-                    t.program, feed=feed, fetch_list=t.fetch_list,
-                    scope=t.scope, entry_key=f"b{bucket}")
+            # the batch dispatch parents under the head request's context;
+            # follower requests are joined to it by per-request
+            # serve_request flight events below
+            with _trace.span("serve::dispatch", parent=batch[0].ctx,
+                             tenant=tenant_name, bucket=bucket, rows=rows,
+                             requests=len(batch)):
+                with _trace.span("serve::batch_assemble", tenant=tenant_name,
+                                 bucket=bucket):
+                    feed = {}
+                    for name in t.feed_names:
+                        parts = [r.feeds[name] for r in batch]
+                        a = (parts[0] if len(parts) == 1
+                             else np.concatenate(parts, 0))
+                        if bucket > rows:
+                            pad = np.zeros((bucket - rows,) + a.shape[1:],
+                                           a.dtype)
+                            a = np.concatenate([a, pad], 0)
+                        feed[name] = a
+                    feed = stage(feed, device=self.device)
+                t_staged = time.perf_counter()
+                # compile vs execute attribution: the executor's own flight
+                # spans (executor::trace_compile on a cold bucket, the
+                # executor_run event's run-only dur_ms) land in the ring
+                # during this synchronous call — scan just the new events
+                seq0 = fr.last_seq
+                with _trace.span("serve::execute", tenant=tenant_name,
+                                 bucket=bucket):
+                    outs = t.executor.run(
+                        t.program, feed=feed, fetch_list=t.fetch_list,
+                        scope=t.scope, entry_key=f"b{bucket}")
+            compile_ms = execute_ms = 0.0
+            for e in fr.events_since(seq0):
+                if (e.get("kind") == "span_end"
+                        and e.get("name") == "executor::trace_compile"):
+                    compile_ms += float(e.get("dur_ms", 0.0) or 0.0)
+                elif e.get("kind") == "executor_run":
+                    execute_ms += float(e.get("dur_ms", 0.0) or 0.0)
+            batch_ms = (t_staged - t_dispatch) * 1e3
             t_done = time.perf_counter()
             off = 0
             for r in batch:
                 sliced = [np.ascontiguousarray(o[off:off + r.rows])
                           for o in outs]
                 off += r.rows
+                queue_ms = (t_dispatch - r.t_submit) * 1e3
+                TTFT_BATCH_MS.observe(batch_ms)
+                TTFT_COMPILE_MS.observe(compile_ms)
+                TTFT_EXECUTE_MS.observe(execute_ms)
+                # one flight event per request carries the request's own
+                # trace context plus the full decomposition — tracecat
+                # shows every request's TTFT split without span surgery
+                fr.record(
+                    "serve_request", name=f"{tenant_name}/b{bucket}",
+                    ctx=r.ctx, tenant=tenant_name, bucket=bucket,
+                    rows=r.rows, queue_ms=round(queue_ms, 3),
+                    batch_ms=round(batch_ms, 3),
+                    compile_ms=round(compile_ms, 3),
+                    execute_ms=round(execute_ms, 3),
+                    total_ms=round((t_done - r.t_submit) * 1e3, 3))
                 self.slo.observe(tenant_name, str(bucket),
                                  (t_done - r.t_submit) * 1e3)
                 self.tenants.end_request(tenant_name)

@@ -19,6 +19,16 @@ contract):
 * ``serve.batch_occupancy``       — real rows / padded bucket rows
 * ``serve.ttft_ms``               — submit -> first dispatch (frontend) or
                                     first generated token (continuous decode)
+* ``serve.ttft_queue_ms``         — TTFT decomposition: submit -> dispatcher
+                                    pop (queue + coalescing hold)
+* ``serve.ttft_batch_ms``         — TTFT decomposition: pop -> padded batch
+                                    staged on device
+* ``serve.ttft_compile_ms``       — TTFT decomposition: trace+compile time
+                                    inside the dispatch (0 on a hot bucket)
+* ``serve.ttft_execute_ms``       — TTFT decomposition: device execution
+* ``serve.ttft_p50_ms`` / ``serve.ttft_p99_ms`` — collect-time percentile
+  gauges over ``serve.ttft_ms`` (nan until the histogram has data —
+  ``Histogram.percentile`` on an empty cell returns nan by contract)
 * ``serve.request_ms{tenant,bucket}`` — submit -> result, per tenant×bucket
 * ``serve.requests{tenant}``      — admitted requests
 * ``serve.load_shed{reason}``     — requests refused (slo|quota|closed)
@@ -40,7 +50,9 @@ from ..utils import monitor as _monitor
 
 __all__ = ["AdmissionError", "QuotaExceededError", "SLOPolicy",
            "QUEUE_DEPTH", "BATCH_SIZE", "BATCH_OCCUPANCY", "TTFT_MS",
-           "REQUEST_MS", "REQUESTS", "LOAD_SHED"]
+           "TTFT_QUEUE_MS", "TTFT_BATCH_MS", "TTFT_COMPILE_MS",
+           "TTFT_EXECUTE_MS", "TTFT_P50", "TTFT_P99", "REQUEST_MS",
+           "REQUESTS", "LOAD_SHED"]
 
 
 class AdmissionError(ResourceExhaustedError):
@@ -71,6 +83,22 @@ TTFT_MS = _monitor.histogram(
     "serve.ttft_ms", "Time to first result activity (ms): submit -> bucket "
     "dispatch on the frontend; submit -> first generated token on the "
     "continuous decode path.")
+TTFT_QUEUE_MS = _monitor.histogram(
+    "serve.ttft_queue_ms", "TTFT decomposition (ms): submit -> the "
+    "dispatcher popping the request off the queue.  Includes the "
+    "max_wait_ms coalescing hold — a high value with low queue_depth "
+    "means the hold is the cost, not backlog.")
+TTFT_BATCH_MS = _monitor.histogram(
+    "serve.ttft_batch_ms", "TTFT decomposition (ms): queue pop -> the "
+    "padded bucket batch staged on device (concatenate + pad + H2D).")
+TTFT_COMPILE_MS = _monitor.histogram(
+    "serve.ttft_compile_ms", "TTFT decomposition (ms): trace+compile time "
+    "the request's dispatch paid (attributed from executor flight spans; "
+    "0 on a hot bucket — a nonzero steady state means bucket executables "
+    "are being evicted or retraced).")
+TTFT_EXECUTE_MS = _monitor.histogram(
+    "serve.ttft_execute_ms", "TTFT decomposition (ms): device execution of "
+    "the request's bucket batch (executor run time, compile excluded).")
 REQUEST_MS = _monitor.histogram(
     "serve.request_ms", "End-to-end request latency (ms): submit -> result "
     "future resolved, labeled by tenant and shape bucket ('decode' for "
@@ -81,6 +109,19 @@ REQUESTS = _monitor.counter(
 LOAD_SHED = _monitor.counter(
     "serve.load_shed", "Requests refused at admission (typed "
     "AdmissionError), by reason.", labelnames=("reason",))
+
+# collect-time percentile gauges so a bare /metrics scrape shows TTFT tail
+# without the scraper re-deriving it from buckets; an empty histogram (no
+# requests yet, or metrics flag off) yields nan samples, never a failed
+# scrape (Gauge.samples guards the callbacks — pinned in test_metrics.py)
+TTFT_P50 = _monitor.gauge(
+    "serve.ttft_p50_ms", "Median serve.ttft_ms, interpolated from the "
+    "histogram at collect time (nan until a request has dispatched).")
+TTFT_P50.set_function(lambda: TTFT_MS.percentile(50))
+TTFT_P99 = _monitor.gauge(
+    "serve.ttft_p99_ms", "p99 serve.ttft_ms, interpolated from the "
+    "histogram at collect time (nan until a request has dispatched).")
+TTFT_P99.set_function(lambda: TTFT_MS.percentile(99))
 
 
 class SLOPolicy:

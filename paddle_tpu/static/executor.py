@@ -1179,8 +1179,14 @@ class Executor:
             p50 = _m_step_ms.percentile(50)
             if not _math.isnan(p50):
                 measured_ms = p50
-        return _xprof.profile_aot(entry.aot, measured_ms=measured_ms,
-                                  top=top)
+        report = _xprof.profile_aot(entry.aot, measured_ms=measured_ms,
+                                    top=top)
+        # publish to the telemetry plane: a live scrape of /xprof returns
+        # the last report without re-profiling
+        from ..utils import telemetry as _telemetry
+
+        _telemetry.publish_snapshot("xprof", report)
+        return report
 
     def close(self):
         self._cache.clear()

@@ -1,3 +1,4 @@
-from . import auto_checkpoint, checkpoint, debug, monitor, profiler, trace
+from . import (auto_checkpoint, checkpoint, debug, monitor, profiler,
+               telemetry, trace, watchdog)
 from .auto_checkpoint import AutoCheckpoint
 from .debug import check_numerics, disable_nan_check, enable_nan_check

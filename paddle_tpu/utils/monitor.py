@@ -164,7 +164,10 @@ class Gauge(Metric):
             fn = self._functions.get(key)
             if fn is None:
                 return self._cells.get(key, 0)
-        return fn()
+        try:
+            return fn()
+        except Exception:
+            return math.nan
 
     def samples(self) -> List[Tuple[Dict[str, str], Any]]:
         with self._lock:
@@ -172,9 +175,16 @@ class Gauge(Metric):
             fns = list(self._functions.items())
         if fns and enabled():
             # evaluate callbacks outside the lock: a function touching other
-            # metrics (or this one) must not deadlock collection
+            # metrics (or this one) must not deadlock collection — and a
+            # raising callback degrades to a nan sample instead of failing
+            # the whole scrape (percentile-over-empty-histogram gauges are
+            # the canonical case: Histogram.percentile itself returns nan on
+            # an empty cell, but a user callback gets the same safety net)
             for key, fn in fns:
-                items[key] = fn()
+                try:
+                    items[key] = fn()
+                except Exception:
+                    items[key] = math.nan
         return [(self._labels_dict(k), v) for k, v in items.items()]
 
 
@@ -261,11 +271,17 @@ class Histogram(Metric):
         return cell.mx
 
     def percentile(self, q: float, **labels) -> float:
-        """The q-th percentile estimate for one labeled cell, ``nan`` when
-        the cell has no observations.  One shared implementation for every
-        latency consumer (serving SLO admission, servebench reports) — the
-        estimate's error is bounded by the containing bucket's width, so
-        size the ``buckets`` ladder to the precision the decision needs."""
+        """The q-th percentile estimate for one labeled cell.
+
+        An empty histogram — the cell was never observed, or collection ran
+        with the ``metrics`` flag off — returns ``nan``, never raises: the
+        serving TTFT percentile gauges and the SLO projection scrape this
+        at collect time, and a scrape must not fail because traffic hasn't
+        arrived yet (regression-pinned in tests/test_metrics.py).  One
+        shared implementation for every latency consumer (serving SLO
+        admission, servebench reports) — the estimate's error is bounded by
+        the containing bucket's width, so size the ``buckets`` ladder to
+        the precision the decision needs."""
         if not 0 <= q <= 100:
             raise ValueError(f"percentile q must be in [0, 100], got {q}")
         with self._lock:
@@ -520,6 +536,9 @@ def stats() -> Dict[str, int]:
         if m.kind not in ("counter", "gauge"):
             continue
         for labels, value in m.samples():
+            if not math.isfinite(value):
+                continue  # e.g. a percentile function gauge over an
+                #           empty histogram samples nan — no int form
             if labels:
                 body = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
                 key = f"{m.name}{{{body}}}"

@@ -1,0 +1,365 @@
+"""Live telemetry plane: zero-dependency HTTP exposition of the runtime
+instruments.
+
+Reference parity: platform/monitor.h keeps an always-on ``StatValue``
+registry meant to be *watched* while the job runs, and the reference's
+device tracer streams while training; our PR 2/3/9 instruments (metrics,
+trace/flight-recorder, xprof) were pull-only-at-exit.  This module turns
+them into an operable system: a threaded stdlib HTTP server any rank can
+run (``telemetry_port`` flag; ``launch --telemetry_port BASE`` assigns
+``BASE + rank`` per worker) serving
+
+* ``/metrics``  — Prometheus text exposition of the process-wide
+  ``utils/monitor.py`` registry (``parse_prometheus_text``-round-trippable)
+* ``/healthz``  — JSON liveness: rank/pid/uptime, elastic membership view
+  and per-rank last-heartbeat ages when the process joined one (or when
+  ``PDTPU_ELASTIC_DIR`` names a membership dir), watchdog goodput summary
+  when a watchdog is live.  HTTP 200 while healthy, 503 once membership
+  sees dead ranks or the watchdog has flagged anomalies.
+* ``/flight``   — the live flight-recorder ring as JSON (same schema as a
+  post-mortem dump, but scrapeable from a *running* job)
+* ``/xprof``    — the last published ``Executor.xprof_report()`` snapshot
+  (the Executor publishes automatically via :func:`publish_snapshot`)
+* ``/spans``    — recent span begin/end events from the flight ring
+  (``?n=200`` bounds the reply; ``?since=SEQ`` reads incrementally)
+
+Server threads are daemons (``ThreadingHTTPServer.daemon_threads``) and the
+accept loop runs on a daemon thread, so a scraped process — including a
+pytest worker — exits without joins.  Everything served is a snapshot copy;
+scrapes never block writers.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..core import flags as _flags
+from . import monitor as _monitor
+from . import trace as _trace
+
+__all__ = ["TelemetryServer", "start_telemetry", "stop_telemetry",
+           "get_server", "start_from_env", "publish_snapshot",
+           "get_snapshot", "register_health_provider", "TELEMETRY_PORT_ENV"]
+
+TELEMETRY_PORT_ENV = "PDTPU_TELEMETRY_PORT"
+
+_m_requests = _monitor.counter(
+    "telemetry.requests", "HTTP requests served by the telemetry plane, "
+    "by endpoint path.", labelnames=("path",))
+_m_scrape_ms = _monitor.histogram(
+    "telemetry.scrape_ms", "Wall time to render one telemetry HTTP "
+    "response (snapshot + serialization).")
+_m_port = _monitor.gauge(
+    "telemetry.port", "Port the process's telemetry server is bound to "
+    "(0 = not serving).")
+
+# ---------------------------------------------------------------------------
+# Published snapshots: modules push their latest report; endpoints serve it.
+# ---------------------------------------------------------------------------
+_snapshots: Dict[str, Any] = {}
+_snapshots_lock = threading.Lock()
+
+
+def publish_snapshot(kind: str, doc: Any) -> None:
+    """Store a JSON-safe document under ``kind`` for the telemetry plane to
+    serve (``/xprof`` serves kind ``"xprof"``).  The Executor publishes its
+    roofline report here on every ``xprof_report()`` call; any module can
+    publish its own kind — last write wins, stamped with a publish time."""
+    with _snapshots_lock:
+        _snapshots[str(kind)] = {"published_at": time.time(), "doc": doc}
+
+
+def get_snapshot(kind: str) -> Optional[Dict[str, Any]]:
+    with _snapshots_lock:
+        return _snapshots.get(str(kind))
+
+
+# ---------------------------------------------------------------------------
+# Health providers: named callables contributing to /healthz.
+# ---------------------------------------------------------------------------
+_health_providers: Dict[str, Callable[[], Any]] = {}
+_health_lock = threading.Lock()
+
+
+def register_health_provider(name: str, provider: Callable[[], Any]) -> None:
+    """Contribute a JSON-safe section to ``/healthz`` under ``name``.  A
+    provider returning a dict with ``"healthy": False`` flips the endpoint
+    to HTTP 503; raising providers are reported as their repr, never a
+    failed scrape.  The watchdog registers itself here."""
+    with _health_lock:
+        _health_providers[str(name)] = provider
+
+
+def _elastic_health() -> Optional[Dict[str, Any]]:
+    """Membership + heartbeat ages: through the process's live
+    ElasticMember when one is started, else read-only off the
+    PDTPU_ELASTIC_DIR heartbeat files (an observer process — a dashboard
+    sidecar — gets the same view without joining)."""
+    from ..elastic import membership as _membership
+
+    member = _membership.current_member()
+    directory = member.dir if member is not None else \
+        os.environ.get(_membership.ELASTIC_DIR_ENV)
+    if not directory:
+        return None
+    ages = _membership.heartbeat_ages(directory)
+    out: Dict[str, Any] = {
+        "dir": directory,
+        "heartbeat_age_s": {str(r): round(a, 3)
+                            for r, a in sorted(ages.items())},
+        "last_heartbeat_age_s": round(max(ages.values()), 3) if ages
+                                else None,
+    }
+    if member is not None:
+        v = member.view()
+        out.update(rank=member.rank, live=list(v.live), dead=list(v.dead),
+                   evicted=list(v.evicted), world_size=member.world_size(),
+                   steps={str(r): s for r, s in sorted(v.steps.items())},
+                   healthy=not v.dead)
+    return out
+
+
+class TelemetryServer:
+    """One process's telemetry HTTP server.
+
+    ::
+
+        srv = TelemetryServer(port=0).start()     # 0 = ephemeral port
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics")
+        srv.stop()
+
+    ``port=0`` binds an ephemeral port (tests); the launcher assigns
+    deterministic per-rank ports so operators can point Prometheus at
+    ``BASE + rank`` for every rank of a job.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[_monitor.MetricRegistry] = None):
+        self.host = host
+        self._requested_port = int(port)
+        self.registry = registry or _monitor.default_registry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t_start = 0.0
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves ephemeral port 0), 0 when stopped."""
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    # -- request handling ----------------------------------------------------
+    def _routes(self):
+        return {
+            "/": self._index,
+            "/metrics": self._metrics,
+            "/healthz": self._healthz,
+            "/flight": self._flight,
+            "/xprof": self._xprof,
+            "/spans": self._spans,
+        }
+
+    def _index(self, query) -> tuple:
+        lines = ["paddle_tpu telemetry plane", ""]
+        lines += sorted(self._routes())[1:]
+        return 200, "text/plain; charset=utf-8", "\n".join(lines) + "\n"
+
+    def _metrics(self, query) -> tuple:
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                self.registry.to_prometheus_text())
+
+    def _healthz(self, query) -> tuple:
+        doc: Dict[str, Any] = {
+            "status": "ok",
+            "rank": _trace._rank(),
+            "pid": os.getpid(),
+            "trace_id": _trace.job_trace_id(),
+            "uptime_s": round(time.time() - self._t_start, 3),
+        }
+        healthy = True
+        try:
+            elastic = _elastic_health()
+        except Exception as e:  # a broken share must not 500 the probe
+            elastic = {"error": repr(e)}
+        if elastic is not None:
+            doc["elastic"] = elastic
+            if elastic.get("healthy") is False:
+                healthy = False
+        with _health_lock:
+            providers = list(_health_providers.items())
+        for name, provider in providers:
+            try:
+                section = provider()
+            except Exception as e:
+                section = {"error": repr(e)}
+            if section is None:
+                continue
+            doc[name] = section
+            if isinstance(section, dict) and section.get("healthy") is False:
+                healthy = False
+        doc["status"] = "ok" if healthy else "degraded"
+        return (200 if healthy else 503, "application/json",
+                json.dumps(doc, default=repr))
+
+    def _flight(self, query) -> tuple:
+        return (200, "application/json",
+                json.dumps(_trace.flight_recorder().to_json(), default=repr))
+
+    def _xprof(self, query) -> tuple:
+        snap = get_snapshot("xprof")
+        if snap is None:
+            return (404, "application/json", json.dumps(
+                {"error": "no xprof report published yet — run "
+                          "Executor.xprof_report() (metrics flag on)"}))
+        return 200, "application/json", json.dumps(snap, default=repr)
+
+    def _spans(self, query) -> tuple:
+        try:
+            n = int(query.get("n", ["200"])[0])
+            since = int(query.get("since", ["0"])[0])
+        except ValueError:
+            return (400, "application/json",
+                    json.dumps({"error": "n/since must be integers"}))
+        fr = _trace.flight_recorder()
+        events = [e for e in fr.events_since(since)
+                  if e.get("kind") in ("span_begin", "span_end")]
+        return 200, "application/json", json.dumps({
+            "last_seq": fr.last_seq,
+            "spans": events[-max(0, n):],
+        }, default=repr)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # every request on its own daemon thread; never log to stderr
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                t0 = time.perf_counter()
+                parsed = urlparse(self.path)
+                route = server._routes().get(parsed.path)
+                if route is None:
+                    status, ctype, body = 404, "application/json", \
+                        json.dumps({"error": f"no endpoint {parsed.path!r}",
+                                    "endpoints": sorted(server._routes())})
+                else:
+                    try:
+                        status, ctype, body = route(parse_qs(parsed.query))
+                    except Exception as e:  # endpoint bug ≠ dead plane
+                        status, ctype, body = 500, "application/json", \
+                            json.dumps({"error": repr(e)})
+                payload = body.encode("utf-8")
+                try:
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    return  # scraper went away mid-reply
+                _m_requests.inc(path=parsed.path)
+                _m_scrape_ms.observe((time.perf_counter() - t0) * 1000.0)
+
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    Handler)
+        httpd.daemon_threads = True
+        httpd.allow_reuse_address = True
+        self._httpd = httpd
+        self._t_start = time.time()
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="pdtpu-telemetry", daemon=True)
+        self._thread.start()
+        _m_port.set(self.port)
+        _trace.flight_recorder().record(
+            "telemetry_start", name=f"{self.host}:{self.port}",
+            port=self.port)
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        _m_port.set(0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton + launch-worker bootstrap.
+# ---------------------------------------------------------------------------
+_server: Optional[TelemetryServer] = None
+_server_lock = threading.Lock()
+
+
+def get_server() -> Optional[TelemetryServer]:
+    return _server
+
+
+def start_telemetry(port: Optional[int] = None,
+                    host: str = "127.0.0.1") -> TelemetryServer:
+    """Start (or return) the process-wide telemetry server.  ``port=None``
+    resolves from the ``telemetry_port`` flag; an explicit 0 binds an
+    ephemeral port."""
+    global _server
+    with _server_lock:
+        if _server is not None and _server.running:
+            return _server
+        if port is None:
+            port = int(_flags.get_flag("telemetry_port"))
+        _server = TelemetryServer(port=port, host=host).start()
+        return _server
+
+
+def stop_telemetry() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def start_from_env() -> Optional[TelemetryServer]:
+    """Worker bootstrap, called at ``paddle_tpu`` import: start the plane
+    when ``PDTPU_TELEMETRY_PORT`` (exported per-rank by ``launch
+    --telemetry_port``) or the ``telemetry_port`` flag names a port.  A
+    bind failure (port taken — e.g. a not-yet-reaped predecessor after an
+    elastic restart) is flight-recorded and swallowed: telemetry must
+    never kill a training job."""
+    env = os.environ.get(TELEMETRY_PORT_ENV, "")
+    try:
+        port = int(env) if env else int(_flags.get_flag("telemetry_port"))
+    except ValueError:
+        port = 0
+    if port <= 0:
+        return None
+    try:
+        return start_telemetry(port=port)
+    except OSError as e:
+        _trace.flight_recorder().record(
+            "telemetry_bind_failed", name=f"port{port}", port=port,
+            error=repr(e))
+        return None

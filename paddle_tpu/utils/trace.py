@@ -256,16 +256,35 @@ class FlightRecorder:
         if size is None:
             size = int(_flags.get_flag("flight_recorder_size"))
         self._events: "deque" = deque(maxlen=max(1, int(size)))
+        self._seq = 0
+        self._seq_lock = threading.Lock()
 
     @property
     def size(self) -> int:
         return self._events.maxlen
 
+    @property
+    def last_seq(self) -> int:
+        """Monotonic count of events ever recorded (ring evictions
+        included) — cursor anchor for :meth:`events_since`."""
+        return self._seq
+
+    def events_since(self, seq: int) -> List[Dict[str, Any]]:
+        """Events with a ``seq`` stamp strictly greater than ``seq`` still
+        present in the ring — the incremental read the watchdog and the
+        telemetry ``/spans`` endpoint poll with (events evicted between
+        polls are simply gone; the ring is a window, not a log)."""
+        return [e for e in self._events if e.get("seq", 0) > seq]
+
     def record(self, kind: str, name: str = "",
                ctx: Optional[SpanContext] = None, **fields: Any) -> None:
         if ctx is None:
             ctx = current_context()
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
         ev: Dict[str, Any] = {
+            "seq": seq,
             "ts": time.time(),
             "kind": str(kind),
             "name": str(name),

@@ -1,0 +1,355 @@
+"""Training goodput watchdog: step-time anomalies, loss health, and
+wall-clock attribution — all computed in-process off the registries the
+runtime already feeds.
+
+Reference parity: the fleet elastic manager pairs its membership watchdog
+with a *training* watchdog (hung-step and loss-NaN detection feeding the
+relaunch decision); profiler folklore calls the productive fraction of
+wall clock "goodput".  Here the same three signals come from instruments
+earlier PRs installed, so the watchdog needs no hooks of its own:
+
+* **step-time anomalies** — rolling median + MAD over the last ``window``
+  step durations; a step beyond ``median + mad_threshold * 1.4826 * MAD``
+  is flight-recorded ``watchdog_step_anomaly`` and counted in
+  ``watchdog.anomalies{kind="step_time"}``.  Median/MAD (not mean/stddev)
+  so the detector survives the very outliers it exists to catch.
+* **loss health** — a NaN/Inf loss flight-records ``watchdog_nan_loss``
+  and, when the ``watchdog_checkpoint_on_anomaly`` flag is set and a
+  ``checkpoint_fn`` is wired, saves a pre-emptive elastic checkpoint
+  *before* the divergence pollutes further optimizer state; a finite loss
+  more than ``loss_spike_factor``× the rolling median is recorded
+  ``watchdog_loss_spike``.
+* **goodput** — every observed step also drains the flight-recorder ring
+  through an ``events_since`` cursor and buckets attributed wall time:
+  ``executor::trace_compile`` span ends → compile, ``elastic_restore`` /
+  ``elastic_checkpoint`` events → restore/checkpoint, eviction markers →
+  eviction; productive time is the summed step durations and everything
+  left is idle (input pipeline, host sync, scheduling).  Published as the
+  ``train.goodput_pct`` gauge plus ``watchdog.time_ms{category}``.
+* **cross-rank stragglers** — :meth:`straggler_report` joins per-rank
+  ``step``/``ts`` from the elastic heartbeat dir (the same files
+  membership liveness reads), so one scrape of any rank's ``/healthz``
+  names the rank holding the collective back.
+
+Detection NEVER raises into the train loop: every observe path is wrapped,
+a broken share or torn heartbeat degrades to "no report".  ``Model.fit``
+attaches :class:`WatchdogCallback` automatically when the ``watchdog``
+flag is on; the callback also registers the watchdog as the telemetry
+plane's ``"watchdog"`` health provider so ``/healthz`` flips to 503 while
+the job is diverging.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import flags as _flags
+from . import monitor as _monitor
+from . import trace as _trace
+
+__all__ = ["Watchdog", "WatchdogCallback", "rolling_median_mad"]
+
+_MAD_SCALE = 1.4826  # MAD → stddev-equivalent under normality
+
+_m_anomalies = _monitor.counter(
+    "watchdog.anomalies", "Anomalies flagged by the training watchdog, by "
+    "kind (step_time | nan_loss | loss_spike).", labelnames=("kind",))
+_m_checkpoints = _monitor.counter(
+    "watchdog.checkpoints", "Pre-emptive elastic checkpoints the watchdog "
+    "saved on loss anomalies (watchdog_checkpoint_on_anomaly flag).")
+_m_time = _monitor.counter(
+    "watchdog.time_ms", "Attributed wall time, by category (productive | "
+    "compile | restore | checkpoint | idle).", labelnames=("category",))
+_m_goodput = _monitor.gauge(
+    "train.goodput_pct", "Productive step time as a percentage of wall "
+    "clock since the watchdog started — compile, checkpoint/restore and "
+    "idle time are the non-goodput remainder.")
+
+
+def rolling_median_mad(values) -> tuple:
+    """(median, MAD) of a sequence — the robust location/scale pair the
+    step-time detector thresholds against."""
+    xs = sorted(values)
+    if not xs:
+        return (math.nan, math.nan)
+    mid = len(xs) // 2
+    med = xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+    dev = sorted(abs(x - med) for x in xs)
+    mad = dev[mid] if len(dev) % 2 else 0.5 * (dev[mid - 1] + dev[mid])
+    return (med, mad)
+
+
+class Watchdog:
+    """In-process goodput watchdog.  Feed it one ``observe_step`` per train
+    step; read ``report()`` (also served on ``/healthz``) any time.
+
+    ``checkpoint_fn(reason: str) -> Any`` is invoked at most
+    ``max_anomaly_checkpoints`` times, and only while the
+    ``watchdog_checkpoint_on_anomaly`` flag is set — ``Model.fit`` wires a
+    closure over the live fit state when it attaches the callback."""
+
+    def __init__(self, window: int = 32, mad_threshold: float = 5.0,
+                 min_samples: int = 8, loss_spike_factor: float = 10.0,
+                 checkpoint_fn: Optional[Callable[[str], Any]] = None,
+                 heartbeat_dir: Optional[str] = None,
+                 straggler_factor: float = 2.0, straggler_min_lag: int = 5,
+                 max_anomaly_checkpoints: int = 1):
+        self.window = int(window)
+        self.mad_threshold = float(mad_threshold)
+        self.min_samples = max(3, int(min_samples))
+        self.loss_spike_factor = float(loss_spike_factor)
+        self.checkpoint_fn = checkpoint_fn
+        self.heartbeat_dir = heartbeat_dir
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_min_lag = int(straggler_min_lag)
+        self.max_anomaly_checkpoints = int(max_anomaly_checkpoints)
+        self._durs: deque = deque(maxlen=self.window)
+        self._losses: deque = deque(maxlen=self.window)
+        self._lock = threading.Lock()
+        self._t_start = time.time()
+        self._cursor = _trace.flight_recorder().last_seq
+        self._time_ms: Dict[str, float] = {
+            "productive": 0.0, "compile": 0.0, "restore": 0.0,
+            "checkpoint": 0.0, "idle": 0.0}
+        self._counts = {"step_time": 0, "nan_loss": 0, "loss_spike": 0}
+        self._flushed: Dict[str, float] = {}  # time_ms already exported
+        self._ckpts_taken = 0
+        self._steps = 0
+        self._last_anomaly: Optional[Dict[str, Any]] = None
+
+    # -- detection -----------------------------------------------------------
+    def observe_step(self, step: int, dur_ms: float,
+                     loss: Optional[float] = None) -> List[str]:
+        """Record one train step; returns the anomaly kinds flagged (empty
+        for a healthy step).  Never raises — detection failures degrade to
+        an unflagged step, not a dead train loop."""
+        try:
+            return self._observe(int(step), float(dur_ms), loss)
+        except Exception:
+            return []
+
+    def _observe(self, step: int, dur_ms: float,
+                 loss: Optional[float]) -> List[str]:
+        flagged: List[str] = []
+        with self._lock:
+            self._steps += 1
+            self._time_ms["productive"] += dur_ms
+            # threshold against the PRIOR window — the anomalous step must
+            # not dilute the statistics that judge it
+            if len(self._durs) >= self.min_samples:
+                med, mad = rolling_median_mad(self._durs)
+                limit = med + self.mad_threshold * _MAD_SCALE * max(
+                    mad, 1e-3 * max(med, 1e-9))
+                if dur_ms > limit:
+                    flagged.append("step_time")
+                    self._note(step, "step_time", dur_ms=round(dur_ms, 3),
+                               median_ms=round(med, 3),
+                               limit_ms=round(limit, 3))
+            self._durs.append(dur_ms)
+            if loss is not None:
+                loss = float(loss)
+                if not math.isfinite(loss):
+                    flagged.append("nan_loss")
+                    self._note(step, "nan_loss", loss=repr(loss))
+                else:
+                    prior = [l for l in self._losses if l > 0]
+                    if len(prior) >= self.min_samples:
+                        med, _ = rolling_median_mad(prior)
+                        if loss > self.loss_spike_factor * med:
+                            flagged.append("loss_spike")
+                            self._note(step, "loss_spike",
+                                       loss=round(loss, 6),
+                                       median=round(med, 6))
+                    self._losses.append(loss)
+            self._drain_flight_locked()
+            self._publish_locked()
+        if ("nan_loss" in flagged or "loss_spike" in flagged):
+            self._maybe_checkpoint(step, flagged)
+        return flagged
+
+    def _note(self, step: int, kind: str, **fields) -> None:
+        self._counts[kind] += 1
+        _m_anomalies.inc(kind=kind)
+        self._last_anomaly = {"step": step, "kind": kind, **fields}
+        _trace.flight_recorder().record(
+            f"watchdog_{'step_anomaly' if kind == 'step_time' else kind}",
+            name=f"step{step}", step=step, **fields)
+
+    def _maybe_checkpoint(self, step: int, flagged: List[str]) -> None:
+        if (self.checkpoint_fn is None
+                or not _flags.get_flag("watchdog_checkpoint_on_anomaly")
+                or self._ckpts_taken >= self.max_anomaly_checkpoints):
+            return
+        self._ckpts_taken += 1
+        reason = ",".join(flagged)
+        try:
+            self.checkpoint_fn(reason)
+        except Exception as e:
+            _trace.flight_recorder().record(
+                "watchdog_checkpoint_failed", name=reason, step=step,
+                error=repr(e))
+            return
+        _m_checkpoints.inc()
+        _trace.flight_recorder().record(
+            "watchdog_checkpoint", name=reason, step=step, reason=reason)
+
+    # -- goodput -------------------------------------------------------------
+    _SPAN_CATEGORIES = {"executor::trace_compile": "compile"}
+    _EVENT_CATEGORIES = {"elastic_restore": "restore",
+                         "elastic_checkpoint": "checkpoint"}
+
+    def _drain_flight_locked(self) -> None:
+        fr = _trace.flight_recorder()
+        events = fr.events_since(self._cursor)
+        if events:
+            self._cursor = max(e.get("seq", self._cursor) for e in events)
+        for e in events:
+            cat = None
+            if e.get("kind") == "span_end":
+                cat = self._SPAN_CATEGORIES.get(e.get("name", ""))
+            else:
+                cat = self._EVENT_CATEGORIES.get(e.get("kind", ""))
+            if cat is not None:
+                self._time_ms[cat] += float(e.get("dur_ms", 0.0) or 0.0)
+
+    def _publish_locked(self) -> None:
+        wall_ms = max((time.time() - self._t_start) * 1000.0, 1e-9)
+        attributed = sum(v for k, v in self._time_ms.items() if k != "idle")
+        self._time_ms["idle"] = max(wall_ms - attributed, 0.0)
+        goodput = 100.0 * min(self._time_ms["productive"] / wall_ms, 1.0)
+        _m_goodput.set(goodput)
+        for cat, ms in self._time_ms.items():
+            delta = ms - self._flushed.get(cat, 0.0)
+            if delta > 0:
+                _m_time.inc(delta, category=cat)
+                self._flushed[cat] = ms
+
+    def goodput_pct(self) -> float:
+        with self._lock:
+            wall_ms = max((time.time() - self._t_start) * 1000.0, 1e-9)
+            return 100.0 * min(self._time_ms["productive"] / wall_ms, 1.0)
+
+    # -- cross-rank attribution ----------------------------------------------
+    def straggler_report(self, directory: Optional[str] = None,
+                         now: Optional[float] = None) -> Dict[str, Any]:
+        """Join per-rank ``step``/``ts`` heartbeats from the elastic
+        membership dir: the front-runner step, each rank's lag, and the
+        ranks whose lag exceeds ``straggler_factor``× the *other* ranks'
+        median lag (leave-one-out, so a lone straggler cannot inflate its
+        own baseline; absolute floor ``straggler_min_lag`` steps) — the
+        collective's critical path, readable from any one rank."""
+        from ..elastic import membership as _membership
+
+        directory = directory or self.heartbeat_dir
+        if not directory:
+            return {"ranks": {}, "stragglers": []}
+        hbs = _membership.read_heartbeats(directory)
+        if not hbs:
+            return {"ranks": {}, "stragglers": []}
+        now = time.time() if now is None else now
+        steps = {r: int(hb.get("step", 0)) for r, hb in hbs.items()}
+        front = max(steps.values())
+        lags = {r: front - s for r, s in steps.items()}
+        stragglers = []
+        for r, lag in lags.items():
+            others = [l for o, l in lags.items() if o != r]
+            if not others:
+                continue
+            med_other, _ = rolling_median_mad(others)
+            if lag > max(self.straggler_min_lag,
+                         self.straggler_factor * med_other):
+                stragglers.append(r)
+        stragglers.sort()
+        for r in stragglers:
+            _trace.flight_recorder().record(
+                "watchdog_straggler", name=f"rank{r}", worker=r,
+                step=steps[r], front=front, lag=lags[r])
+        return {
+            "front_step": front,
+            "ranks": {str(r): {"step": steps[r], "lag": lags[r],
+                               "hb_age_s": round(
+                                   now - float(hbs[r].get("ts", 0.0)), 3)}
+                      for r in sorted(hbs)},
+            "stragglers": stragglers,
+        }
+
+    # -- reporting (telemetry /healthz section) ------------------------------
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            doc: Dict[str, Any] = {
+                "healthy": self._counts["nan_loss"] == 0,
+                "steps": self._steps,
+                "goodput_pct": round(
+                    100.0 * min(self._time_ms["productive"] / max(
+                        (time.time() - self._t_start) * 1000.0, 1e-9), 1.0),
+                    2),
+                "time_ms": {k: round(v, 1)
+                            for k, v in self._time_ms.items()},
+                "anomalies": dict(self._counts),
+            }
+            if self._last_anomaly is not None:
+                doc["last_anomaly"] = dict(self._last_anomaly)
+        if self.heartbeat_dir:
+            try:
+                doc["stragglers"] = self.straggler_report()
+            except Exception:
+                pass
+        return doc
+
+
+class WatchdogCallback:
+    """hapi Callback wrapping a :class:`Watchdog` (duck-typed like
+    ElasticCheckpoint: CallbackList dispatches by attribute, so not
+    inheriting avoids an import cycle).  Times each train batch, reads the
+    lazy ``loss`` log (one device sync per step — the price of loss
+    monitoring), and registers the watchdog on the telemetry plane.
+    ``Model.fit`` attaches one automatically when the ``watchdog`` flag is
+    set."""
+
+    def __init__(self, watchdog: Optional[Watchdog] = None, **kwargs):
+        self.model = None
+        self.params: Dict[str, Any] = {}
+        self.watchdog = watchdog or Watchdog(**kwargs)
+        self._t0: Optional[float] = None
+        self._gstep = 0
+        from . import telemetry as _telemetry
+        _telemetry.register_health_provider("watchdog",
+                                            self.watchdog.report)
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dur_ms = (time.perf_counter() - self._t0) * 1000.0
+        self._t0 = None
+        loss = None
+        if logs is not None:
+            try:
+                loss = logs.get("loss")  # forces the lazy thunk
+            except Exception:
+                loss = None
+        self._gstep += 1
+        self.watchdog.observe_step(self._gstep, dur_ms, loss=loss)

@@ -509,3 +509,72 @@ def test_metricsdump_cli_smoke(tmp_path):
 def _repo_root():
     import os
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# empty-histogram percentile contract + collect-robust function gauges
+# (satellite: the serve.ttft percentile gauges must survive a cold server)
+# ---------------------------------------------------------------------------
+def test_empty_histogram_percentile_is_nan_never_raises():
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.lat", buckets=(1.0, 10.0))
+    # never-observed cell: nan, not an exception — documented contract
+    assert np.isnan(h.percentile(50))
+    assert np.isnan(h.percentile(99))
+    hl = r.histogram("t.lab", labelnames=("k",))
+    assert np.isnan(hl.percentile(50, k="never_seen"))
+    # metrics flag off: observations are dropped, percentile stays nan
+    flags.set_flags({"metrics": False})
+    try:
+        h.observe(5.0)
+        assert np.isnan(h.percentile(50))
+    finally:
+        flags.set_flags({"metrics": True})
+
+
+def test_function_gauge_over_empty_histogram_degrades_to_nan():
+    # the serve.ttft_p50_ms/p99_ms pattern: a collect-time gauge callback
+    # over Histogram.percentile must yield a nan sample (and a scrapeable
+    # exposition) before the histogram has data — not a failed scrape
+    r = monitor.MetricRegistry()
+    h = r.histogram("t.lat")
+    g = r.gauge("t.lat_p99")
+    g.set_function(lambda: h.percentile(99))
+    ((labels, value),) = g.samples()
+    assert labels == {} and np.isnan(value)
+    assert np.isnan(g.value())
+    text = r.to_prometheus_text()  # nan is Prometheus-legal
+    assert "t_lat_p99 nan" in text.lower()
+    # a callback that raises degrades to nan instead of killing the scrape
+    broken = r.gauge("t.broken")
+    broken.set_function(lambda: 1 / 0)
+    samples = dict((tuple(l.items()), v) for l, v in broken.samples())
+    assert np.isnan(samples[()])
+    r.to_prometheus_text()  # still scrapeable
+    # and once data arrives the same gauge turns real
+    h.observe(7.0)
+    assert g.value() == pytest.approx(7.0, abs=7.0)
+    assert not np.isnan(g.value())
+    # stats()'s flat int snapshot skips nan gauges instead of raising
+    # (the default registry holds nan percentile gauges once serving.slo
+    # is imported — stats() must stay callable regardless)
+    dg = monitor.gauge("t.nan_stats_probe", "nan never reaches int()")
+    dg.set_function(lambda: float("nan"))
+    snap = monitor.stats()
+    assert "t.nan_stats_probe" not in snap
+    dg.set_function(lambda: 4.0)
+    assert monitor.stats()["t.nan_stats_probe"] == 4
+
+
+def test_serve_ttft_percentile_gauges_registered_and_cold_safe():
+    from paddle_tpu.serving import slo
+
+    reg = monitor.default_registry()
+    for name in ("serve.ttft_p50_ms", "serve.ttft_p99_ms",
+                 "serve.ttft_queue_ms", "serve.ttft_batch_ms",
+                 "serve.ttft_compile_ms", "serve.ttft_execute_ms"):
+        assert name in reg.names()
+    # cold scrape (possibly before any request) never raises
+    text = reg.to_prometheus_text()
+    assert "serve_ttft_p99_ms" in text
+    assert isinstance(slo.TTFT_P99.value(), float)  # nan or real, no raise

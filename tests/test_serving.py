@@ -604,6 +604,83 @@ def test_servebench_selfcheck():
 
 
 # ---------------------------------------------------------------------------
+# per-request TTFT decomposition: queue / batch / compile / execute
+# ---------------------------------------------------------------------------
+def test_ttft_decomposition_histograms_and_flight_spans():
+    from paddle_tpu.serving import slo
+
+    reg = monitor.default_registry()
+    fr = trace.flight_recorder()
+
+    def counts():
+        return {n: reg.get(n).count()
+                for n in ("serve.ttft_queue_ms", "serve.ttft_batch_ms",
+                          "serve.ttft_compile_ms", "serve.ttft_execute_ms")}
+
+    main, y, scope = _mlp_tenant()
+    c0 = counts()
+    seq0 = fr.last_seq
+    with Server(bucket_edges=(1, 2, 4), max_wait_ms=0.0) as srv:
+        srv.add_tenant("m", main, ["x"], [y], scope)
+        # cold request: pays the bucket compile
+        srv.submit("m", {"x": np.ones((1, 8), np.float32)}).result(timeout=60)
+        # hot request, same bucket: compile segment must be 0
+        srv.submit("m", {"x": np.ones((1, 8), np.float32)}).result(timeout=60)
+    c1 = counts()
+    assert all(c1[n] - c0[n] == 2 for n in c1), (c0, c1)
+
+    evs = fr.events_since(seq0)
+    reqs = [e for e in evs if e["kind"] == "serve_request"]
+    assert len(reqs) == 2
+    cold, hot = reqs
+    # every request carries the full decomposition + its own trace context
+    for r in reqs:
+        assert {"queue_ms", "batch_ms", "compile_ms", "execute_ms",
+                "total_ms", "trace_id", "span_id"} <= set(r)
+        assert r["total_ms"] >= r["execute_ms"] >= 0.0
+    assert cold["compile_ms"] > 0.0          # first b1 dispatch compiled
+    assert hot["compile_ms"] == 0.0          # hot cache: pure execute
+    assert hot["execute_ms"] > 0.0
+    # the dispatch span tree is in the ring for tracecat: dispatch parents
+    # assemble + execute, and itself parents under the request context
+    begins = [e for e in evs if e["kind"] == "span_begin"]
+    assert {"serve::dispatch", "serve::batch_assemble",
+            "serve::execute"} <= {e["name"] for e in begins}
+    # each dispatch parents under ITS head request's context (cold and hot
+    # were separate single-request batches)
+    dispatch, = [e for e in begins if e["name"] == "serve::dispatch"
+                 and e.get("parent_id") == cold["span_id"]]
+    assert dispatch["trace_id"] == cold["trace_id"]
+    execute, = [e for e in begins if e["name"] == "serve::execute"
+                and e.get("parent_id") == dispatch["span_id"]]
+    assert execute["trace_id"] == cold["trace_id"]
+    assert any(e["name"] == "serve::dispatch"
+               and e.get("parent_id") == hot["span_id"] for e in begins)
+    # histograms agree with the flight attribution: compile seen once
+    assert reg.get("serve.ttft_compile_ms").sum() >= cold["compile_ms"] - 1.0
+    # the percentile gauges are live now (real numbers, not nan)
+    assert not np.isnan(slo.TTFT_P50.value())
+    assert not np.isnan(slo.TTFT_P99.value())
+
+
+def test_submit_inside_span_parents_request_context():
+    main, y, scope = _mlp_tenant()
+    fr = trace.flight_recorder()
+    seq0 = fr.last_seq
+    with Server(bucket_edges=(1,), max_wait_ms=0.0) as srv:
+        srv.add_tenant("m", main, ["x"], [y], scope)
+        with trace.span("client::call") as sp:
+            srv.submit("m", {"x": np.ones((1, 8), np.float32)}
+                       ).result(timeout=60)
+            client_ctx = sp.context
+    req, = [e for e in fr.events_since(seq0) if e["kind"] == "serve_request"]
+    # the request context is a child of the caller's span: same trace,
+    # parented under it — tracecat stitches client -> server causality
+    assert req["trace_id"] == client_ctx.trace_id
+    assert req["parent_id"] == client_ctx.span_id
+
+
+# ---------------------------------------------------------------------------
 # slow stress variants (excluded from tier-1; run with `-m slow`)
 # ---------------------------------------------------------------------------
 @pytest.mark.slow

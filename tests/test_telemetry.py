@@ -1,0 +1,351 @@
+"""Live telemetry plane (utils/telemetry.py): HTTP exposition of metrics /
+health / flight ring / xprof / spans, per-rank servers under `launch
+--telemetry_port`, and the tools/benchdiff regression gate.
+
+The server smoke here is the tier-1 CI gate the ISSUE requires: start,
+scrape /metrics + /healthz, round-trip the exposition through
+``parse_prometheus_text``.  All servers bind ephemeral ports on 127.0.0.1
+and run daemon threads, so pytest never hangs on shutdown."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.utils import monitor, telemetry, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def _server():
+    srv = telemetry.TelemetryServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path, timeout=10.0):
+    """(status, json-or-text body) — reads error bodies too."""
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read().decode()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        status = e.code
+    try:
+        return status, json.loads(body)
+    except ValueError:
+        return status, body
+
+
+# ---------------------------------------------------------------------------
+# endpoint smoke (the tier-1 CI gate)
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_round_trips_prometheus_text(_server):
+    c = monitor.counter("t.telemetry_smoke", "scrape marker")
+    c.inc(7)
+    status, text = _get(_server.port, "/metrics")
+    assert status == 200
+    parsed = monitor.parse_prometheus_text(text)
+    assert parsed[("t_telemetry_smoke", ())] == 7.0
+    # the plane's own instruments ride the same exposition: scrape again so
+    # the first scrape's request counter is visible
+    status, text = _get(_server.port, "/metrics")
+    parsed = monitor.parse_prometheus_text(text)
+    assert parsed[("telemetry_requests", (("path", "/metrics"),))] >= 1.0
+    assert parsed[("telemetry_port", ())] == float(_server.port)
+
+
+def test_healthz_ok_and_degraded(_server):
+    status, doc = _get(_server.port, "/healthz")
+    assert status == 200
+    assert doc["status"] == "ok"
+    assert doc["pid"] == os.getpid()
+    assert doc["uptime_s"] >= 0
+    # a health provider reporting unhealthy flips the endpoint to 503
+    telemetry.register_health_provider(
+        "t_probe", lambda: {"healthy": False, "detail": "synthetic"})
+    try:
+        status, doc = _get(_server.port, "/healthz")
+        assert status == 503
+        assert doc["status"] == "degraded"
+        assert doc["t_probe"]["detail"] == "synthetic"
+        # a RAISING provider degrades to its repr, never a dead probe
+        telemetry._health_providers["t_probe"] = lambda: 1 / 0
+        status, doc = _get(_server.port, "/healthz")
+        assert status == 200
+        assert "ZeroDivisionError" in doc["t_probe"]["error"]
+    finally:
+        telemetry._health_providers.pop("t_probe", None)
+
+
+def test_flight_and_spans_endpoints(_server):
+    fr = trace.flight_recorder()
+    seq0 = fr.last_seq
+    fr.record("t_marker", name="telemetry_test", payload=42)
+    with trace.span("t::span_probe"):
+        pass
+    status, doc = _get(_server.port, "/flight")
+    assert status == 200
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "t_marker" in kinds
+    status, doc = _get(_server.port, f"/spans?since={seq0}&n=10")
+    assert status == 200
+    names = [e["name"] for e in doc["spans"]]
+    assert names.count("t::span_probe") == 2        # begin + end
+    assert all(e["kind"].startswith("span_") for e in doc["spans"])
+    assert doc["last_seq"] >= seq0 + 3
+    status, doc = _get(_server.port, "/spans?n=zebra")
+    assert status == 400
+
+
+def test_xprof_endpoint_404_then_published(_server):
+    telemetry._snapshots.pop("xprof", None)
+    status, doc = _get(_server.port, "/xprof")
+    assert status == 404 and "error" in doc
+    telemetry.publish_snapshot("xprof", {"regions": [], "mfu": 0.5})
+    status, doc = _get(_server.port, "/xprof")
+    assert status == 200
+    assert doc["doc"]["mfu"] == 0.5
+    assert doc["published_at"] <= time.time()
+
+
+def test_unknown_endpoint_404_lists_routes(_server):
+    status, doc = _get(_server.port, "/nope")
+    assert status == 404
+    assert "/metrics" in doc["endpoints"]
+    status, body = _get(_server.port, "/")
+    assert status == 200 and "/healthz" in body
+
+
+def test_healthz_reads_elastic_membership(_server, tmp_path):
+    from paddle_tpu.elastic.membership import ElasticMember
+
+    m = ElasticMember(str(tmp_path), rank=0, world_size=2,
+                      interval_s=0.05, dead_after_s=30.0).start()
+    try:
+        status, doc = _get(_server.port, "/healthz")
+        assert status == 200
+        assert doc["elastic"]["rank"] == 0
+        assert 0 in doc["elastic"]["live"]
+        assert doc["elastic"]["heartbeat_age_s"]["0"] < 30.0
+    finally:
+        m.stop()
+    # stopped member deregisters; healthz drops the section cleanly
+    status, doc = _get(_server.port, "/healthz")
+    assert status == 200
+
+
+def test_singleton_start_idempotent_and_env_bootstrap():
+    try:
+        srv = telemetry.start_telemetry(port=0)
+        assert telemetry.start_telemetry() is srv          # idempotent
+        assert telemetry.get_server() is srv
+        port = srv.port
+        assert port > 0
+    finally:
+        telemetry.stop_telemetry()
+    assert telemetry.get_server() is None
+    # start_from_env: no env, flag 0 -> stays off
+    os.environ.pop(telemetry.TELEMETRY_PORT_ENV, None)
+    assert telemetry.start_from_env() is None
+    # bind conflict: flight-recorded, returns None, never raises
+    srv = telemetry.TelemetryServer(port=0).start()
+    try:
+        os.environ[telemetry.TELEMETRY_PORT_ENV] = str(srv.port)
+        seq0 = trace.flight_recorder().last_seq
+        assert telemetry.start_from_env() is None
+        assert any(e["kind"] == "telemetry_bind_failed"
+                   for e in trace.flight_recorder().events_since(seq0))
+    finally:
+        os.environ.pop(telemetry.TELEMETRY_PORT_ENV, None)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# launch --telemetry_port: per-rank live planes, self- and peer-scraped
+# ---------------------------------------------------------------------------
+
+def _free_port_base():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_launch_two_ranks_serve_live_metrics_and_healthz(tmp_path):
+    from paddle_tpu.distributed.launch import launch
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    base = _free_port_base()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, os, time, urllib.request
+        import paddle_tpu  # import bootstrap starts this rank's plane
+        from paddle_tpu.utils import monitor, telemetry
+
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        srv = telemetry.get_server()
+        assert srv is not None and srv.port == {base} + rank, srv
+        monitor.counter("t.worker_mark", "").inc(rank + 1)
+
+        def scrape(port, path, tries=50):
+            last = None
+            for _ in range(tries):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{{port}}{{path}}",
+                            timeout=5) as r:
+                        return r.status, r.read().decode()
+                except Exception as e:  # peer may still be booting
+                    last = e
+                    time.sleep(0.2)
+            raise last
+
+        # self-scrape + peer-scrape (ports are deterministic: base + rank)
+        peer = {base} + (1 - rank)
+        results = {{}}
+        for label, port in (("self", srv.port), ("peer", peer)):
+            st, text = scrape(port, "/metrics")
+            parsed = monitor.parse_prometheus_text(text)
+            hst, hbody = scrape(port, "/healthz")
+            results[label] = {{
+                "metrics_status": st,
+                "mark": parsed.get(("t_worker_mark", ()), None),
+                "telemetry_port": parsed.get(("telemetry_port", ()), None),
+                "healthz_status": hst,
+                "healthz": json.loads(hbody),
+            }}
+        with open(os.path.join({str(out_dir)!r}, f"r{{rank}}.json"),
+                  "w") as f:
+            json.dump(results, f)
+
+        # keep this rank's plane up until BOTH ranks finished scraping —
+        # exiting early would refuse the peer's in-flight scrape
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join({str(out_dir)!r},
+                                               f"r{{r}}.json"))
+                   for r in (0, 1)):
+                break
+            time.sleep(0.1)
+    """))
+    rc = launch(str(script), [], nproc=2, telemetry_port=base,
+                backend_env=f"JAX_PLATFORMS=cpu,PYTHONPATH={REPO},"
+                            "PDTPU_FLAGS_metrics=1")
+    assert rc == 0
+    for rank in range(2):
+        doc = json.load(open(out_dir / f"r{rank}.json"))
+        for label in ("self", "peer"):
+            r = doc[label]
+            assert r["metrics_status"] == 200, (rank, label)
+            assert r["healthz_status"] == 200, (rank, label)
+            assert r["healthz"]["status"] == "ok"
+        # self-scrape sees this rank's own counter and bound port
+        assert doc["self"]["mark"] == float(rank + 1)
+        assert doc["self"]["telemetry_port"] == float(base + rank)
+        # peer-scrape proves BOTH planes were live simultaneously and
+        # expose per-rank state (the peer's counter differs)
+        assert doc["peer"]["telemetry_port"] == float(base + (1 - rank))
+        assert doc["peer"]["mark"] == float((1 - rank) + 1)
+        assert doc["peer"]["healthz"]["rank"] == 1 - rank
+
+
+# ---------------------------------------------------------------------------
+# tools/benchdiff: the regression gate
+# ---------------------------------------------------------------------------
+
+def _bench(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_benchdiff_passes_identical_fails_seeded_regression(tmp_path):
+    from tools import benchdiff
+
+    base = {"parsed": {"metric": "pretrain_throughput", "value": 100.0,
+                       "unit": "tokens/sec/chip"},
+            "results": [{"metric": "serve_p99_ms", "value": 10.0,
+                         "unit": "ms"}]}
+    a = _bench(tmp_path, "a.json", base)
+    b = _bench(tmp_path, "b.json", base)
+    same = benchdiff.diff_metrics(benchdiff.extract_metrics(a),
+                                  benchdiff.extract_metrics(b))
+    assert same["verdict"] == "pass" and same["compared"] == 2
+
+    worse = {"parsed": dict(base["parsed"], value=80.0),   # -20% throughput
+             "results": [dict(base["results"][0], value=12.0)]}  # +20% p99
+    c = _bench(tmp_path, "c.json", worse)
+    bad = benchdiff.diff_metrics(benchdiff.extract_metrics(a),
+                                 benchdiff.extract_metrics(c))
+    assert bad["verdict"] == "fail"
+    assert {e["metric"] for e in bad["regressions"]} == {
+        "pretrain_throughput", "serve_p99_ms"}
+    # direction awareness: +20% throughput / -20% p99 are IMPROVEMENTS
+    better = {"parsed": dict(base["parsed"], value=120.0),
+              "results": [dict(base["results"][0], value=8.0)]}
+    d = _bench(tmp_path, "d.json", better)
+    good = benchdiff.diff_metrics(benchdiff.extract_metrics(a),
+                                  benchdiff.extract_metrics(d))
+    assert good["verdict"] == "pass"
+    assert len(good["improvements"]) == 2
+    # per-metric tolerance override widens just the noisy metric
+    ok = benchdiff.diff_metrics(benchdiff.extract_metrics(a),
+                                benchdiff.extract_metrics(c),
+                                overrides=[("p99", 0.5),
+                                           ("throughput", 0.5)])
+    assert ok["verdict"] == "pass"
+
+
+def test_benchdiff_reads_real_bench_ledger_and_record_schema(tmp_path):
+    from tools import benchdiff
+
+    # the repo's own ledger files parse (all three schemas)
+    for f in ("BENCH_r05.json", "BENCH_VISION.json", "BENCH_SERVE.json"):
+        metrics = benchdiff.extract_metrics(os.path.join(REPO, f))
+        assert metrics, f
+    serve = benchdiff.extract_metrics(os.path.join(REPO, "BENCH_SERVE.json"))
+    assert "batched.qps" in serve            # nested record flattening
+    assert benchdiff.direction_of("batched.qps") == "higher"
+    assert benchdiff.direction_of("batched.p50_ms") == "lower"
+    assert benchdiff.direction_of("mystery_metric") == "both"
+    with pytest.raises(ValueError):
+        benchdiff.extract_metrics(
+            _bench(tmp_path, "empty.json", {"nothing": True}))
+
+
+def test_benchdiff_cli_selfcheck_and_verdict_line(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.benchdiff", "--selfcheck"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout)["selfcheck"] == "pass"
+
+    base = {"parsed": {"metric": "tput", "value": 100.0,
+                       "unit": "rows/sec"}}
+    a = _bench(tmp_path, "a.json", base)
+    c = _bench(tmp_path, "c.json",
+               {"parsed": dict(base["parsed"], value=70.0)})
+    ok = subprocess.run([sys.executable, "-m", "tools.benchdiff", a, a],
+                        cwd=REPO, capture_output=True, text=True,
+                        timeout=120)
+    assert ok.returncode == 0
+    assert json.loads(ok.stdout)["verdict"] == "pass"
+    bad = subprocess.run([sys.executable, "-m", "tools.benchdiff", a, c],
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=120)
+    assert bad.returncode == 1               # the gate: nonzero on regression
+    verdict = json.loads(bad.stdout)
+    assert verdict["verdict"] == "fail"
+    assert verdict["regressions"][0]["metric"] == "tput"

@@ -1,0 +1,272 @@
+"""Training goodput watchdog (utils/watchdog.py): rolling-median/MAD
+step-time anomalies, NaN/spiking-loss detection with flag-gated pre-emptive
+checkpoints, flight-event goodput attribution, and cross-rank straggler
+attribution over the elastic heartbeat dir."""
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.elastic import checkpoint as eckpt
+from paddle_tpu.utils import monitor, trace, watchdog as wd
+
+
+@pytest.fixture
+def _watchdog_flags_guard():
+    saved = flags.get_flags(["watchdog", "watchdog_checkpoint_on_anomaly",
+                             "elastic_ckpt_dir", "elastic_keep_last",
+                             "metrics"])
+    yield
+    flags.set_flags(saved)
+
+
+def _flight_since(seq):
+    return trace.flight_recorder().events_since(seq)
+
+
+# ---------------------------------------------------------------------------
+# step-time anomaly detection (median + MAD)
+# ---------------------------------------------------------------------------
+
+def test_injected_5x_straggler_step_is_flagged():
+    reg = monitor.default_registry()
+    n0 = reg.get("watchdog.anomalies").value(kind="step_time")
+    seq0 = trace.flight_recorder().last_seq
+    w = wd.Watchdog(window=16, min_samples=8)
+    for i in range(16):
+        assert w.observe_step(i, 100.0 + (i % 5)) == []  # jittery but sane
+    flagged = w.observe_step(16, 500.0)                  # the 5x straggler
+    assert flagged == ["step_time"]
+    assert reg.get("watchdog.anomalies").value(kind="step_time") - n0 == 1
+    evs = [e for e in _flight_since(seq0)
+           if e["kind"] == "watchdog_step_anomaly"]
+    assert len(evs) == 1
+    assert evs[0]["dur_ms"] == 500.0
+    assert evs[0]["median_ms"] == pytest.approx(102.0, abs=2.0)
+    # recovery: subsequent normal steps are not flagged (the outlier is in
+    # the window now, but median/MAD shrug it off)
+    assert w.observe_step(17, 101.0) == []
+    rep = w.report()
+    assert rep["anomalies"]["step_time"] == 1
+    assert rep["last_anomaly"]["kind"] == "step_time"
+    assert rep["healthy"]  # step-time anomalies degrade, NaN loss unhealths
+
+
+def test_steady_series_never_flags_and_needs_min_samples():
+    w = wd.Watchdog(min_samples=8)
+    # before min_samples, even a wild value passes (no baseline yet)
+    assert w.observe_step(0, 1.0) == []
+    assert w.observe_step(1, 900.0) == []
+    w2 = wd.Watchdog(min_samples=4)
+    for i in range(50):
+        assert w2.observe_step(i, 10.0) == []
+
+
+def test_rolling_median_mad_reference():
+    med, mad = wd.rolling_median_mad([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert med == 3.0 and mad == 1.0          # robust to the outlier
+    med2, mad2 = wd.rolling_median_mad([5.0, 7.0])
+    assert med2 == 6.0 and mad2 == 1.0
+    assert all(math.isnan(v) for v in wd.rolling_median_mad([]))
+
+
+# ---------------------------------------------------------------------------
+# loss health: NaN + spike, flag-gated pre-emptive checkpoint
+# ---------------------------------------------------------------------------
+
+def test_nan_loss_flight_event_and_gated_checkpoint(_watchdog_flags_guard):
+    calls = []
+    seq0 = trace.flight_recorder().last_seq
+    w = wd.Watchdog(checkpoint_fn=lambda reason: calls.append(reason))
+    # flag off: detected + flight-recorded, but NOT checkpointed
+    flags.set_flags({"watchdog_checkpoint_on_anomaly": False})
+    assert w.observe_step(0, 10.0, loss=float("nan")) == ["nan_loss"]
+    assert calls == []
+    # flag on: the next anomaly checkpoints (once — max_anomaly_checkpoints)
+    flags.set_flags({"watchdog_checkpoint_on_anomaly": True})
+    reg = monitor.default_registry()
+    c0 = reg.get("watchdog.checkpoints").value()
+    assert w.observe_step(1, 10.0, loss=float("inf")) == ["nan_loss"]
+    assert calls == ["nan_loss"]
+    assert reg.get("watchdog.checkpoints").value() - c0 == 1
+    assert w.observe_step(2, 10.0, loss=float("nan")) == ["nan_loss"]
+    assert calls == ["nan_loss"]  # budget spent, no second save
+    kinds = [e["kind"] for e in _flight_since(seq0)]
+    assert kinds.count("watchdog_nan_loss") == 3
+    assert kinds.count("watchdog_checkpoint") == 1
+    assert not w.report()["healthy"]
+
+
+def test_loss_spike_detected_against_rolling_median():
+    w = wd.Watchdog(min_samples=4, loss_spike_factor=10.0)
+    for i in range(8):
+        assert w.observe_step(i, 10.0, loss=0.5 + 0.01 * i) == []
+    assert w.observe_step(8, 10.0, loss=50.0) == ["loss_spike"]
+    # a failing checkpoint_fn is flight-recorded, never raised
+    seq0 = trace.flight_recorder().last_seq
+    w2 = wd.Watchdog(checkpoint_fn=lambda r: 1 / 0)
+    flags.set_flags({"watchdog_checkpoint_on_anomaly": True})
+    try:
+        assert w2.observe_step(0, 1.0, loss=float("nan")) == ["nan_loss"]
+    finally:
+        flags.set_flags({"watchdog_checkpoint_on_anomaly": False})
+    assert any(e["kind"] == "watchdog_checkpoint_failed"
+               for e in _flight_since(seq0))
+
+
+# ---------------------------------------------------------------------------
+# goodput attribution off the flight ring
+# ---------------------------------------------------------------------------
+
+def test_goodput_attribution_buckets_flight_events():
+    w = wd.Watchdog()
+    fr = trace.flight_recorder()
+    # synthetic executor/elastic events land in the ring after the cursor
+    fr.record("span_end", name="executor::trace_compile", dur_ms=40.0)
+    fr.record("elastic_restore", name="step5", dur_ms=25.0)
+    fr.record("elastic_checkpoint", name="step6", dur_ms=10.0)
+    w.observe_step(0, 30.0)
+    rep = w.report()
+    assert rep["time_ms"]["compile"] == pytest.approx(40.0)
+    assert rep["time_ms"]["restore"] == pytest.approx(25.0)
+    assert rep["time_ms"]["checkpoint"] == pytest.approx(10.0)
+    assert rep["time_ms"]["productive"] == pytest.approx(30.0)
+    assert 0.0 < rep["goodput_pct"] <= 100.0
+    # the cursor advanced: re-observing must not double-count
+    w.observe_step(1, 30.0)
+    assert w.report()["time_ms"]["compile"] == pytest.approx(40.0)
+    # exported as gauge + cumulative per-category counter
+    reg = monitor.default_registry()
+    assert isinstance(reg.get("train.goodput_pct").value(), float)
+    assert reg.get("watchdog.time_ms").value(category="productive") > 0
+
+
+def test_goodput_pct_reflects_productive_fraction():
+    w = wd.Watchdog()
+    w._t_start = time.time() - 1.0          # pretend 1s of wall clock
+    w.observe_step(0, 600.0)                # 600ms productive
+    assert w.goodput_pct() == pytest.approx(60.0, abs=15.0)
+
+
+# ---------------------------------------------------------------------------
+# cross-rank straggler attribution over the heartbeat dir
+# ---------------------------------------------------------------------------
+
+def _write_hb(directory, rank, step, ts=None):
+    with open(os.path.join(directory, f"hb.{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "pid": 1000 + rank, "step": step,
+                   "ts": time.time() if ts is None else ts}, f)
+
+
+def test_two_rank_straggler_attribution(tmp_path):
+    d = str(tmp_path)
+    _write_hb(d, 0, 100)
+    _write_hb(d, 1, 40)                     # 60 steps behind
+    seq0 = trace.flight_recorder().last_seq
+    w = wd.Watchdog(heartbeat_dir=d)
+    rep = w.straggler_report()
+    assert rep["front_step"] == 100
+    assert rep["stragglers"] == [1]
+    assert rep["ranks"]["1"]["lag"] == 60
+    assert rep["ranks"]["0"]["lag"] == 0
+    evs = [e for e in _flight_since(seq0)
+           if e["kind"] == "watchdog_straggler"]
+    assert len(evs) == 1 and evs[0]["worker"] == 1
+    # the report also rides /healthz via report()
+    assert w.report()["stragglers"]["stragglers"] == [1]
+
+
+def test_near_uniform_ranks_not_flagged(tmp_path):
+    d = str(tmp_path)
+    for r, s in ((0, 100), (1, 99), (2, 97), (3, 100)):
+        _write_hb(d, r, s)
+    w = wd.Watchdog(heartbeat_dir=d)
+    assert w.straggler_report()["stragglers"] == []
+    # no heartbeat dir -> empty report, never a crash
+    assert wd.Watchdog().straggler_report() == {"ranks": {},
+                                                "stragglers": []}
+
+
+# ---------------------------------------------------------------------------
+# hapi wiring: watchdog flag -> callback -> NaN fixture checkpoints
+# ---------------------------------------------------------------------------
+
+def _hapi_model(seed=5):
+    import paddle_tpu as pd
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+
+    pd.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(optimizer=pd.optimizer.SGD(learning_rate=0.05),
+                  loss=nn.MSELoss())
+    return model
+
+
+def _nan_data():
+    from paddle_tpu.io import TensorDataset
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.normal(size=(64, 1)).astype(np.float32)
+    y[:] = np.nan                           # poisoned labels -> NaN loss
+    return TensorDataset([x, y])
+
+
+def test_fit_nan_loss_flight_event_and_preemptive_checkpoint(
+        tmp_path, _watchdog_flags_guard):
+    ckpt = str(tmp_path / "wd_ckpt")
+    flags.set_flags({"watchdog": True,
+                     "watchdog_checkpoint_on_anomaly": True,
+                     "elastic_ckpt_dir": ckpt})
+    seq0 = trace.flight_recorder().last_seq
+    model = _hapi_model()
+    model.fit(_nan_data(), batch_size=16, epochs=1, verbose=0)
+    kinds = [e["kind"] for e in _flight_since(seq0)]
+    assert "watchdog_nan_loss" in kinds
+    assert "watchdog_checkpoint" in kinds
+    # the pre-emptive elastic checkpoint is real and restorable
+    steps = eckpt.list_steps(ckpt)
+    assert len(steps) == 1                   # max_anomaly_checkpoints=1
+    body = eckpt.load_manifest(ckpt)
+    names = [l["name"] for l in body["leaves"]]
+    assert any(n.startswith("param/") for n in names)
+    assert any(n.startswith("opt/") for n in names)
+
+
+def test_fit_healthy_run_no_anomalies(tmp_path, _watchdog_flags_guard):
+    from paddle_tpu.io import TensorDataset
+
+    flags.set_flags({"watchdog": True,
+                     "watchdog_checkpoint_on_anomaly": False,
+                     "elastic_ckpt_dir": str(tmp_path / "nope")})
+    rng = np.random.default_rng(0)
+    data = TensorDataset([rng.normal(size=(64, 8)).astype(np.float32),
+                          rng.normal(size=(64, 1)).astype(np.float32)])
+    seq0 = trace.flight_recorder().last_seq
+    model = _hapi_model()
+    model.fit(data, batch_size=16, epochs=1, verbose=0)
+    assert not any(e["kind"].startswith("watchdog_")
+                   for e in _flight_since(seq0))
+    assert not (tmp_path / "nope").exists()
+
+
+def test_watchdog_callback_direct_and_lazy_logs():
+    cb = wd.WatchdogCallback(watchdog=wd.Watchdog(min_samples=4))
+    cb.on_train_begin()
+    for i in range(6):
+        cb.on_train_batch_begin(i)
+        cb.on_train_batch_end(i, {"loss": 0.5})
+    assert cb.watchdog.report()["steps"] == 6
+    # batch_end without batch_begin (resumed loop) is a no-op, not a crash
+    cb.on_train_batch_end(99, {"loss": 0.5})
+    assert cb.watchdog.report()["steps"] == 6
+    # the callback registered the watchdog as a /healthz provider
+    from paddle_tpu.utils import telemetry
+
+    assert telemetry._health_providers["watchdog"]()["steps"] == 6

@@ -1,0 +1,248 @@
+"""benchdiff — regression gate between two BENCH_*.json revisions.
+
+The repo's benchmark ledger is a pile of per-revision JSON files in three
+shapes (all produced by earlier PRs' bench tools):
+
+* ``{"parsed": {"metric": ..., "value": ..., "unit": ...}}``       (stepbench)
+* ``{"results": [{"metric": ..., "value": ..., "unit": ...}]}``    (vision)
+* ``{"record": {...nested numeric scalars...}}``            (serve/collbench)
+
+``benchdiff OLD NEW`` extracts every numeric metric from both, compares
+them with a per-metric tolerance band, and prints ONE JSON line::
+
+    {"verdict": "pass"|"fail", "compared": N, "regressions": [...],
+     "improvements": [...], "only_old": [...], "only_new": [...]}
+
+exit 0 on pass, 1 on fail — pipe it into CI as a gate.  Direction is
+inferred per metric: throughput/qps/speedup/goodput/mfu (or any ``/sec``
+unit) regress when they DROP; latency/``*_ms``/``p50``..``p99`` regress
+when they RISE; anything unrecognized is two-sided (any move beyond
+tolerance fails, so a renamed unit can't silently exempt a metric).
+
+``--tolerance 0.10`` (default) is the relative band; ``--metric-tolerance
+name=0.25`` (repeatable, substring match) widens noisy metrics without
+loosening the rest.  Metrics present on only one side are reported but
+don't fail the gate (``--require-common`` makes them fail).
+
+``--selfcheck`` builds synthetic revisions in a temp dir and asserts the
+gate passes on identical inputs and fails on a seeded 20% regression —
+rides tier-1 (tests/test_telemetry.py) so the gate itself is gated.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["extract_metrics", "diff_metrics", "direction_of", "main"]
+
+_HIGHER_HINTS = ("throughput", "qps", "speedup", "goodput", "mfu",
+                 "occupancy", "bandwidth", "flops", "samples", "tokens")
+_LOWER_HINTS = ("latency", "_ms", "p50", "p95", "p99", "time", "wait",
+                "ttft", "overhead")
+
+
+def direction_of(metric: str, unit: str = "") -> str:
+    """'higher' | 'lower' | 'both' — which way this metric regresses."""
+    name = metric.lower()
+    u = (unit or "").lower()
+    if u and ("/sec" in u or u.endswith("/s")):
+        return "higher"
+    if any(h in name for h in _HIGHER_HINTS):
+        return "higher"
+    if any(h in name for h in _LOWER_HINTS) or u in ("ms", "s", "us"):
+        return "lower"
+    return "both"
+
+
+def _flatten(prefix: str, node, out: Dict[str, float]) -> None:
+    if isinstance(node, bool):
+        return
+    if isinstance(node, (int, float)):
+        if math.isfinite(float(node)):
+            out[prefix] = float(node)
+        return
+    if isinstance(node, dict):
+        # histogram-shaped subtrees (bucket-bound keys) are not metrics
+        if "buckets" in node and "count" in node:
+            return
+        for k, v in node.items():
+            if str(k).startswith("_"):
+                continue
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
+    """{metric: (value, unit)} from any of the BENCH_*.json shapes."""
+    with open(path) as f:
+        doc = json.load(f)
+    out: Dict[str, Tuple[float, str]] = {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object at top level")
+    entries = []
+    if isinstance(doc.get("parsed"), dict):
+        entries.append(doc["parsed"])
+    if isinstance(doc.get("results"), list):
+        entries.extend(e for e in doc["results"] if isinstance(e, dict))
+    for e in entries:
+        name = e.get("metric")
+        if name is None or not isinstance(e.get("value"), (int, float)):
+            continue
+        out[str(name)] = (float(e["value"]), str(e.get("unit", "")))
+    if isinstance(doc.get("record"), dict):
+        flat: Dict[str, float] = {}
+        _flatten("", doc["record"], flat)
+        for k, v in flat.items():
+            out.setdefault(k, (v, ""))
+    if not out:
+        raise ValueError(
+            f"{path}: no metrics found — expected 'parsed', 'results' or "
+            "'record' (the stepbench/vision/servebench BENCH schemas)")
+    return out
+
+
+def _tolerance_for(metric: str, default: float,
+                   overrides: List[Tuple[str, float]]) -> float:
+    for pat, tol in overrides:
+        if pat in metric:
+            return tol
+    return default
+
+
+def diff_metrics(old: Dict[str, Tuple[float, str]],
+                 new: Dict[str, Tuple[float, str]],
+                 tolerance: float = 0.10,
+                 overrides: Optional[List[Tuple[str, float]]] = None,
+                 require_common: bool = False) -> Dict:
+    overrides = overrides or []
+    regressions, improvements, unchanged = [], [], 0
+    common = sorted(set(old) & set(new))
+    for m in common:
+        (ov, unit), (nv, _) = old[m], new[m]
+        tol = _tolerance_for(m, tolerance, overrides)
+        denom = abs(ov) if ov else 1.0
+        rel = (nv - ov) / denom
+        direction = direction_of(m, unit)
+        worse = (rel < -tol if direction == "higher"
+                 else rel > tol if direction == "lower"
+                 else abs(rel) > tol)
+        better = (rel > tol if direction == "higher"
+                  else rel < -tol if direction == "lower"
+                  else False)
+        entry = {"metric": m, "old": ov, "new": nv,
+                 "change_pct": round(100.0 * rel, 2),
+                 "direction": direction, "tolerance_pct": 100.0 * tol}
+        if worse:
+            regressions.append(entry)
+        elif better:
+            improvements.append(entry)
+        else:
+            unchanged += 1
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    fail = bool(regressions) or (require_common and (only_old or only_new))
+    return {
+        "verdict": "fail" if fail else "pass",
+        "compared": len(common),
+        "unchanged": unchanged,
+        "regressions": regressions,
+        "improvements": improvements,
+        "only_old": only_old,
+        "only_new": only_new,
+    }
+
+
+# ---------------------------------------------------------------------------
+# selfcheck
+
+
+def _write(path: str, doc: dict) -> str:
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def selfcheck() -> int:
+    tmp = tempfile.mkdtemp(prefix="benchdiff_selfcheck_")
+    base = {
+        "parsed": {"metric": "train_throughput", "value": 1000.0,
+                   "unit": "tokens/sec/chip"},
+        "results": [
+            {"metric": "infer_p99_ms", "value": 5.0, "unit": "ms"},
+            {"metric": "train_mfu", "value": 0.5, "unit": ""},
+        ],
+        "record": {"batched": {"qps": 2000.0, "p50_ms": 1.5}},
+    }
+    a = _write(os.path.join(tmp, "a.json"), base)
+    b = _write(os.path.join(tmp, "b.json"), base)
+    same = diff_metrics(extract_metrics(a), extract_metrics(b))
+    ok = same["verdict"] == "pass" and not same["regressions"]
+
+    # seeded 20% regressions, one per direction class: throughput drops,
+    # latency rises — both must trip a 10% band
+    worse = json.loads(json.dumps(base))
+    worse["parsed"]["value"] = 800.0
+    worse["results"][0]["value"] = 6.0
+    c = _write(os.path.join(tmp, "c.json"), worse)
+    bad = diff_metrics(extract_metrics(a), extract_metrics(c))
+    tripped = {e["metric"] for e in bad["regressions"]}
+    ok = (ok and bad["verdict"] == "fail"
+          and {"train_throughput", "infer_p99_ms"} <= tripped)
+
+    # and the band actually tolerates sub-threshold noise
+    noisy = json.loads(json.dumps(base))
+    noisy["parsed"]["value"] = 950.0      # -5% < 10% band
+    d = _write(os.path.join(tmp, "d.json"), noisy)
+    near = diff_metrics(extract_metrics(a), extract_metrics(d))
+    ok = ok and near["verdict"] == "pass"
+
+    print(json.dumps({"selfcheck": "pass" if ok else "fail",
+                      "identical": same["verdict"],
+                      "seeded_regression": bad["verdict"],
+                      "tripped": sorted(tripped),
+                      "sub_threshold": near["verdict"]}))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.benchdiff",
+        description="Regression gate between two BENCH_*.json revisions "
+                    "(one JSON verdict line; exit 1 on regression)")
+    p.add_argument("old", nargs="?", help="baseline BENCH_*.json")
+    p.add_argument("new", nargs="?", help="candidate BENCH_*.json")
+    p.add_argument("--tolerance", type=float, default=0.10,
+                   help="relative tolerance band (default 0.10 = 10%%)")
+    p.add_argument("--metric-tolerance", action="append", default=[],
+                   metavar="SUBSTR=TOL",
+                   help="per-metric override, substring match "
+                        "(e.g. --metric-tolerance p99=0.25); repeatable")
+    p.add_argument("--require-common", action="store_true",
+                   help="fail when a metric exists on only one side")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="verify the gate on synthetic revisions and exit")
+    args = p.parse_args(argv)
+    if args.selfcheck:
+        return selfcheck()
+    if not args.old or not args.new:
+        p.error("old and new BENCH files are required (or --selfcheck)")
+    overrides = []
+    for spec in args.metric_tolerance:
+        if "=" not in spec:
+            p.error(f"--metric-tolerance wants SUBSTR=TOL, got {spec!r}")
+        pat, tol = spec.rsplit("=", 1)
+        overrides.append((pat, float(tol)))
+    verdict = diff_metrics(extract_metrics(args.old),
+                           extract_metrics(args.new),
+                           tolerance=args.tolerance, overrides=overrides,
+                           require_common=args.require_common)
+    print(json.dumps(verdict))
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

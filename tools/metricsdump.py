@@ -104,12 +104,27 @@ _KNOWN_NAMES = frozenset({
     "serve.queue_depth",
     "serve.request_ms",
     "serve.requests",
+    "serve.ttft_batch_ms",
+    "serve.ttft_compile_ms",
+    "serve.ttft_execute_ms",
     "serve.ttft_ms",
-    # hapi/callbacks.py MetricsLogger
+    "serve.ttft_p50_ms",
+    "serve.ttft_p99_ms",
+    "serve.ttft_queue_ms",
+    # utils/telemetry.py (the HTTP exposition plane)
+    "telemetry.port",
+    "telemetry.requests",
+    "telemetry.scrape_ms",
+    # hapi/callbacks.py MetricsLogger + utils/watchdog.py goodput
     "train.epochs",
+    "train.goodput_pct",
     "train.samples_per_sec",
     "train.step_time_ms",
     "train.steps",
+    # utils/watchdog.py (anomaly detection)
+    "watchdog.anomalies",
+    "watchdog.checkpoints",
+    "watchdog.time_ms",
     # utils/xprof.py
     "xprof.attribution_coverage",
     "xprof.mfu",
@@ -160,6 +175,8 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.ops.pallas.config  # noqa: F401 — the pallas.* family
     import paddle_tpu.static.passes  # noqa: F401 — passes.* + quant.*
     import paddle_tpu.utils.debug  # noqa: F401
+    import paddle_tpu.utils.telemetry  # noqa: F401 — the telemetry.* family
+    import paddle_tpu.utils.watchdog  # noqa: F401 — watchdog.* + goodput
     import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
     from paddle_tpu.hapi.callbacks import MetricsLogger
 
