@@ -11,8 +11,16 @@ import jax.numpy as jnp
 
 
 def embedding(x, weight, padding_idx=None, sparse=False):
-    del sparse  # gradient representation is XLA's concern
-    out = jnp.take(weight, x.astype(jnp.int32), axis=0)
+    if sparse:
+        # the SelectedRows analogue: dedup ids, segment-sum cotangent rows
+        # over duplicates, scatter only unique rows (gradient work scales
+        # with batch ids, not vocab size)
+        from ...parallel.embedding import sparse_lookup
+        ids = x.astype(jnp.int32)
+        out = sparse_lookup(weight, ids.reshape(-1)).reshape(
+            tuple(ids.shape) + (weight.shape[-1],))
+    else:
+        out = jnp.take(weight, x.astype(jnp.int32), axis=0)
     if padding_idx is not None:
         mask = (x != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)

@@ -16,7 +16,14 @@ Axes (any subset, any sizes):
   sp — sequence/context parallel (ring attention over sequence shards)
   ep — expert parallel (MoE expert sharding)
 """
-from . import collective, compress, mesh, metrics, sharding
+from . import collective, compress, embedding, mesh, metrics, sharding
+from .embedding import (
+    ShardedEmbedding,
+    exchange_bytes,
+    sharded_lookup,
+    sparse_lookup,
+    to_host_table,
+)
 from .compress import (
     CommOptions,
     bucket_signature,

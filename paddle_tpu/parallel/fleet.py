@@ -125,6 +125,17 @@ class CommConfig:
     hierarchical: Any = "auto"
 
 
+@dataclasses.dataclass
+class EmbeddingConfig:
+    """Vocab-sharded embedding knobs (parallel/embedding.py): the mesh
+    axis tables shard over, the exchange-buffer capacity factor (None =
+    exact, no drops), and the backward-exchange wire quantization
+    ("int8"/"fp8" per-row blockwise, or "")."""
+    axis: str = _mesh.TP_AXIS
+    capacity_factor: Any = None
+    quantize: str = ""
+
+
 class DistributedStrategy:
     """Typed strategy object (ref proto distributed_strategy.proto:94)."""
 
@@ -155,6 +166,10 @@ class DistributedStrategy:
         # payload (EQuARX-style, parallel/compress.py).
         self.comm_quantize = ""
         self.comm_configs = CommConfig()
+        # the reference's sparse-embedding story (fleet PS lookup_table)
+        # mapped to the mesh: vocab-shard every lookup-op table
+        self.sharded_embedding = False
+        self.embedding_configs = EmbeddingConfig()
         self.find_unused_parameters = False  # parity no-op
         self.fuse_all_reduce_ops = True      # parity no-op (XLA fuses)
         self.nccl_comm_num = 1               # parity no-op (ICI)
@@ -162,6 +177,22 @@ class DistributedStrategy:
     def __repr__(self):
         on = [k for k, v in self.__dict__.items() if v is True]
         return f"DistributedStrategy(enabled={on})"
+
+
+def embedding_plan_kwargs(strategy: DistributedStrategy) -> Dict[str, Any]:
+    """``ShardingPlan`` kwargs for a strategy's sharded-embedding knobs —
+    the bridge from fleet's typed strategy to the static-graph plan::
+
+        plan = ShardingPlan(mesh=mesh, **embedding_plan_kwargs(strategy))
+
+    Empty dict when ``strategy.sharded_embedding`` is off, so it composes
+    with other plan kwargs unconditionally."""
+    if not getattr(strategy, "sharded_embedding", False):
+        return {}
+    cfg = strategy.embedding_configs
+    return {"embedding_shard": cfg.axis,
+            "embedding_capacity": cfg.capacity_factor,
+            "embedding_quantize": cfg.quantize}
 
 
 class _RoleMaker:

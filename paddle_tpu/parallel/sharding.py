@@ -246,7 +246,10 @@ class ShardingPlan:
                  comm_quantize: str = "",
                  comm_block_size: int = 256,
                  comm_buffer_mb: float = 25.0,
-                 comm_hierarchy: Any = "auto"):
+                 comm_hierarchy: Any = "auto",
+                 embedding_shard: Optional[Union[str, Dict[str, str]]] = None,
+                 embedding_capacity: Optional[float] = None,
+                 embedding_quantize: str = ""):
         if mesh is not None and devices is not None:
             raise ValueError("pass either mesh or devices, not both")
         self._mesh = mesh
@@ -289,6 +292,34 @@ class ShardingPlan:
             self.comm = _compress.CommOptions(
                 quantize=comm_quantize, block_size=int(comm_block_size),
                 buffer_mb=float(comm_buffer_mb), hierarchy=comm_hierarchy)
+        # vocab-sharded embedding tables (parallel/embedding.py): a str is a
+        # blanket axis for every table any lookup op reads (names resolved
+        # from the program at build time — bind_embedding_tables); a dict
+        # maps table-name regexes to axes and also places matching *state*
+        # leaves directly, no program needed (checkpoint/reshard flows)
+        self.embedding_shard = embedding_shard
+        self.embedding_capacity = (None if embedding_capacity is None
+                                   else float(embedding_capacity))
+        self.embedding_quantize = embedding_quantize or ""
+        self._emb_patterns: List[Tuple[Any, str]] = []
+        self._emb_default: Optional[str] = None
+        self._emb_bound: Dict[str, str] = {}
+        if embedding_shard is not None:
+            if isinstance(embedding_shard, str):
+                self._emb_default = embedding_shard
+                _validate_axes((embedding_shard,), known_mesh,
+                               "embedding_shard")
+            else:
+                for pat, ax in embedding_shard.items():
+                    _validate_axes((ax,), known_mesh,
+                                   f"embedding_shard[{pat!r}]")
+                    self._emb_patterns.append((re.compile(pat), ax))
+            if self.embedding_quantize:
+                from . import compress as _compress
+                if self.embedding_quantize not in _compress.COMPRESS_KINDS:
+                    raise ValueError(
+                        f"embedding_quantize={embedding_quantize!r} is not "
+                        f"a known kind {_compress.COMPRESS_KINDS}")
         # monotonic identity token: the in-memory hot-cache key component
         # (cheap int compare per step; content fingerprint() is the slow
         # cross-process identity and only runs at compile time)
@@ -299,6 +330,54 @@ class ShardingPlan:
         tracing (no-op context when the plan carries none)."""
         from . import compress as _compress
         return _compress.comm_scope(self.comm)
+
+    def embedding_axis_for(self, name: str,
+                           lookup: bool = False) -> Optional[str]:
+        """The vocab-shard axis for table ``name``, or None when this plan
+        does not cover it.  ``lookup=True`` marks a call from a lookup-op
+        site, where the blanket str form applies to any table; placement
+        calls (``state_shardings``) only honor the blanket form for names
+        already bound from a program, so arbitrary dense params are never
+        mistaken for embedding tables."""
+        if self.embedding_shard is None:
+            return None
+        if name in self._emb_bound:
+            return self._emb_bound[name]
+        for pat, ax in self._emb_patterns:
+            if pat.search(name):
+                return ax
+        if lookup and self._emb_default is not None:
+            return self._emb_default
+        return None
+
+    def bind_embedding_tables(self, program) -> Dict[str, str]:
+        """Resolve which state leaves are embedding tables by scanning the
+        program's lookup ops (how the blanket ``embedding_shard="tp"`` form
+        learns table names); the Executor calls this before placement."""
+        if self.embedding_shard is None:
+            return {}
+        from . import embedding as _embedding
+        bound = _embedding.resolve_tables(program, self)
+        self._emb_bound.update(bound)
+        return bound
+
+    def embedding_scope(self, program=None):
+        """Context manager making this plan's embedding-shard config
+        ambient while a program traces, so the ``lookup_table`` lowerings
+        route covered tables through the all_to_all exchange (no-op when
+        the plan carries no embedding_shard)."""
+        import contextlib
+        if self.embedding_shard is None:
+            return contextlib.nullcontext()
+        from . import embedding as _embedding
+        if program is not None:
+            self.bind_embedding_tables(program)
+        mesh = self.resolve_mesh()
+        return _embedding.embedding_scope(_embedding.EmbeddingContext(
+            plan=self, mesh=mesh,
+            batch_axes=self._batch_spec_axes(mesh),
+            capacity_factor=self.embedding_capacity,
+            quantize=self.embedding_quantize))
 
     def resolve_mesh(self) -> Mesh:
         """The mesh this plan places onto (resolved once, then pinned so the
@@ -366,8 +445,19 @@ class ShardingPlan:
         """NamedSharding per persistable leaf (annotations > rules > ZeRO >
         replicated) — `infer_sharding` over the flat state dict."""
         mesh = mesh or self.resolve_mesh()
-        return infer_sharding(state, mesh, self.rules, self.annotations,
-                              self.zero_stage)
+        ann = self.annotations
+        if self.embedding_shard is not None:
+            # derived table placements: vocab dim over the plan's embedding
+            # axis — explicit user annotations still win
+            ann = dict(self.annotations or {})
+            for name, leaf in state.items():
+                if name in ann:
+                    continue
+                axis = self.embedding_axis_for(name)
+                ndim = len(np.shape(leaf))
+                if axis is not None and ndim >= 1:
+                    ann[name] = (axis,) + (None,) * (ndim - 1)
+        return infer_sharding(state, mesh, self.rules, ann, self.zero_stage)
 
     def fingerprint(self) -> str:
         """Content fingerprint of the plan for the persistent compile-cache
@@ -383,10 +473,18 @@ class ShardingPlan:
             ann = ";".join(f"{k}->{v}"
                            for k, v in sorted(self.annotations.items()))
         comm = self.comm.signature() if self.comm is not None else "-"
+        emb = "-"
+        if self.embedding_shard is not None:
+            desc = (self.embedding_shard
+                    if isinstance(self.embedding_shard, str)
+                    else ";".join(f"{k}->{v}" for k, v in
+                                  sorted(self.embedding_shard.items())))
+            emb = (f"{desc},cap={self.embedding_capacity}"
+                   f",q={self.embedding_quantize or '-'}")
         return (f"{_mesh.mesh_fingerprint(mesh)}|batch={self.batch_axes}"
                 f"|seq={self.seq_axis}|zero={self.zero_stage}"
                 f"|donate={int(self.donate)}|rules={rules}|ann={ann}"
-                f"|comm={comm}")
+                f"|comm={comm}|emb={emb}")
 
 
 # Default rule table for transformer-family models (ERNIE/BERT/GPT blocks):

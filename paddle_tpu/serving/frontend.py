@@ -118,7 +118,8 @@ class Server:
     def add_tenant(self, name: str, program, feed_names: Sequence[str],
                    fetch_list: Sequence, scope,
                    quota: Optional[int] = None,
-                   quantize: bool = False) -> Tenant:
+                   quantize: bool = False,
+                   dedup_feed: Optional[str] = None) -> Tenant:
         """Register a tenant program.  The program and its feed names are
         statically verified against this server's bucket ladder right here
         (static/shardcheck.py SC007 + the PV program checks) — a bad feed
@@ -161,7 +162,36 @@ class Server:
 
             check_program_cached(program, feed_names=set(feed_names))
         return self.tenants.register(
-            Tenant(name, program, feed_names, fetch_list, scope, quota=quota))
+            Tenant(name, program, feed_names, fetch_list, scope, quota=quota,
+                   dedup_feed=dedup_feed))
+
+    def add_embedding_tenant(self, name: str, weight,
+                             quota: Optional[int] = None,
+                             padding_idx: Optional[int] = None) -> Tenant:
+        """Register an embedding-only tenant: a one-lookup program over
+        ``weight`` (a ``(V, D)`` array — e.g. a trained
+        ``parallel.ShardedEmbedding.weight``) whose single feed is the id
+        vector, with id dedup done in ``submit`` (duplicate ids cross the
+        dispatch queue and the device once; rows come back in token
+        order).  The recommender serving shape: CTR rankers pull rows for
+        a candidate set dominated by popular repeated ids."""
+        import numpy as np
+
+        from ..static import executor as _executor
+        from ..static import framework as _framework
+        from ..static import layers as L
+
+        weight = np.asarray(weight, np.float32)
+        main = _framework.Program()
+        startup = _framework.Program()
+        with _framework.program_guard(main, startup):
+            ids = L.data("ids", [], dtype="int64")
+            rows = L.embedding(ids, size=list(weight.shape),
+                               padding_idx=padding_idx, name=f"{name}_emb")
+        scope = _executor.Scope()
+        scope.set(f"{name}_emb.w", weight)
+        return self.add_tenant(name, main, ["ids"], [rows], scope,
+                               quota=quota, dedup_feed="ids")
 
     def start(self) -> "Server":
         with self._cond:
@@ -191,6 +221,9 @@ class Server:
             LOAD_SHED.inc(reason="closed")
             raise AdmissionError("server is closed")
         t = self.tenants.get(tenant)
+        inv = None
+        if t.dedup_feed is not None:
+            feeds, inv = self._dedup(t, feeds)
         req = self._validate(t, feeds, t_submit)
         # quota first (cheap, per-tenant), then SLO projection
         self.tenants.begin_request(tenant)
@@ -208,7 +241,51 @@ class Server:
             self.tenants.end_request(tenant)
             raise
         REQUESTS.inc(tenant=tenant)
+        if inv is not None:
+            return self._undedup_future(req.future, inv, t_submit)
         return req.future
+
+    @staticmethod
+    def _dedup(t: Tenant, feeds: Dict[str, np.ndarray]):
+        """Submit-side id dedup for embedding-only tenants: unique the
+        dedup feed's rows (np.unique sorts — order is restored by the
+        inverse map) so duplicates never reach the queue or the device."""
+        from ..parallel import embedding as _pemb
+
+        a = np.asarray(feeds[t.dedup_feed])
+        if a.shape[0] == 0:
+            raise ValueError("empty request (0 rows)")
+        uniq, inv = np.unique(a, axis=0, return_inverse=True)
+        _pemb.observe_serving_lookup(
+            unique_ratio=uniq.shape[0] / a.shape[0])
+        return {**feeds, t.dedup_feed: uniq}, inv.reshape(-1)
+
+    @staticmethod
+    def _undedup_future(inner: Future, inv: np.ndarray,
+                        t_submit: float) -> Future:
+        """Future resolving to the inner fetch list with every row mapped
+        back through the inverse indices (token order, duplicates
+        restored)."""
+        from ..parallel import embedding as _pemb
+
+        outer: Future = Future()
+
+        def _done(f: Future) -> None:
+            try:
+                outs = f.result()
+            except BaseException as e:  # propagate, don't swallow
+                outer.set_exception(e)
+                return
+            try:
+                mapped = [np.asarray(o)[inv] for o in outs]
+                _pemb.observe_serving_lookup(
+                    ms=(time.perf_counter() - t_submit) * 1e3)
+                outer.set_result(mapped)
+            except BaseException as e:
+                outer.set_exception(e)
+
+        inner.add_done_callback(_done)
+        return outer
 
     def _validate(self, t: Tenant, feeds: Dict[str, np.ndarray],
                   t_submit: float) -> _Request:

@@ -55,7 +55,8 @@ class Tenant:
     Scope (parameters/state), fetch list, and in-flight quota."""
 
     def __init__(self, name: str, program, feed_names: Sequence[str],
-                 fetch_list: Sequence, scope, quota: Optional[int] = None):
+                 fetch_list: Sequence, scope, quota: Optional[int] = None,
+                 dedup_feed: Optional[str] = None):
         from ..static.executor import Executor
 
         self.name = str(name)
@@ -64,6 +65,14 @@ class Tenant:
         self.fetch_list = list(fetch_list)
         self.scope = scope
         self.quota = None if quota is None else int(quota)
+        # embedding-only tenants: submit() dedups this feed's rows
+        # (np.unique) before enqueueing and maps fetched rows back through
+        # the inverse indices — duplicate ids never reach the device
+        if dedup_feed is not None and dedup_feed not in self.feed_names:
+            raise ValueError(
+                f"dedup_feed {dedup_feed!r} is not a feed of tenant "
+                f"{name!r} (feeds: {self.feed_names})")
+        self.dedup_feed = dedup_feed
         self.executor = Executor()
         self.inflight = 0
 

@@ -852,6 +852,7 @@ _SHAPE_CHECKERS = {
     "softmax_with_cross_entropy": _chk_softmax_ce,
     "lookup_table": _chk_lookup_table,
     "embedding": _chk_lookup_table,
+    "c_embedding": _chk_lookup_table,
     "conv2d": _chk_conv2d,
     "reshape": _chk_reshape,
     "reshape2": _chk_reshape,
@@ -1691,6 +1692,7 @@ _INFER_RULES: Dict[str, object] = {
     "lookup_table": _rule_lookup_table,
     "lookup_table_v2": _rule_embedding,
     "embedding": _rule_embedding,
+    "c_embedding": _rule_embedding,
     "softmax_with_cross_entropy": _rule_softmax_ce,
     "one_hot": _rule_one_hot,
     "one_hot_v2": _rule_one_hot,

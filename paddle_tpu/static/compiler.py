@@ -92,7 +92,10 @@ class CompiledProgram:
                       donate: bool = True, comm_quantize: str = "",
                       comm_block_size: int = 256,
                       comm_buffer_mb: float = 25.0,
-                      comm_hierarchy="auto") -> "CompiledProgram":
+                      comm_hierarchy="auto",
+                      embedding_shard=None,
+                      embedding_capacity=None,
+                      embedding_quantize: str = "") -> "CompiledProgram":
         """Run this program's compiled step under NamedShardings on a mesh —
         the full hybrid-parallel face of the Executor fast path.
 
@@ -113,7 +116,14 @@ class CompiledProgram:
         the step is traced (parallel/compress.py `comm_scope`): axis-bound
         collectives inside the program pick up quantized payloads and
         hierarchical scheduling, and the options key the persistent compile
-        cache through the plan fingerprint."""
+        cache through the plan fingerprint.
+
+        ``embedding_shard`` (an axis name, or {table-name-regex: axis})
+        vocab-shards every covered ``lookup_table`` table over that mesh
+        axis and routes its lookups through the dedup + all_to_all
+        exchange (parallel/embedding.py); ``embedding_capacity`` /
+        ``embedding_quantize`` tune the exchange buffers and the backward
+        wire payload."""
         from ..parallel import mesh as _pmesh
         from ..parallel.sharding import ShardingPlan
 
@@ -123,7 +133,9 @@ class CompiledProgram:
             batch_axes=tuple(batch_axes) if batch_axes else (_pmesh.DP_AXIS,),
             seq_axis=seq_axis, donate=donate, comm_quantize=comm_quantize,
             comm_block_size=comm_block_size, comm_buffer_mb=comm_buffer_mb,
-            comm_hierarchy=comm_hierarchy)
+            comm_hierarchy=comm_hierarchy, embedding_shard=embedding_shard,
+            embedding_capacity=embedding_capacity,
+            embedding_quantize=embedding_quantize)
         return self
 
     def _sharding_plan(self):

@@ -930,7 +930,12 @@ class Executor:
             # lowerings consult parallel.compress.current_comm()
             comm_ctx = plan.comm_scope() if plan is not None \
                 else contextlib.nullcontext()
-            with comm_ctx:
+            # likewise the plan's embedding-shard config: lookup_table
+            # lowerings consult parallel.embedding.current_embedding() to
+            # route covered tables through the all_to_all exchange
+            emb_ctx = plan.embedding_scope(program) if plan is not None \
+                else contextlib.nullcontext()
+            with comm_ctx, emb_ctx:
                 _trace_block(program, env, base_key)
             fetches = [env[n] for n in fetch_names]
             new_state = {}
@@ -948,6 +953,9 @@ class Executor:
 
         if plan is None:
             return self._build_single(raw, example, donate, disk, disk_key)
+        # resolve which state leaves are embedding tables BEFORE placement:
+        # state_shardings must see the bound names to vocab-shard them
+        plan.bind_embedding_tables(program)
         return self._build_sharded(raw, plan, example, donate,
                                    state_constraints, disk, disk_key)
 

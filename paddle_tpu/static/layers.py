@@ -260,15 +260,17 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, is_test=False,
 
 
 def embedding(input, size, padding_idx=None, param_attr=None,
-              dtype="float32", name=None) -> Variable:
-    """ref fluid/layers/nn.py embedding (lookup_table_v2)."""
+              dtype="float32", name=None, is_sparse=False) -> Variable:
+    """ref fluid/layers/nn.py embedding (lookup_table_v2).  ``is_sparse``
+    selects the dedup'd segment-sum gradient (SelectedRows analogue)."""
     w = create_parameter(size, dtype, attr=param_attr,
                          default_initializer=I.Normal(0.0, 1.0),
                          name=f"{name}.w" if name else None)
     out = _out(dtype, tuple(input.shape) + (size[1],))
     _append("lookup_table_v2", {"Ids": [input.name], "W": [w.name]},
             {"Out": [out.name]},
-            {"padding_idx": -1 if padding_idx is None else padding_idx})
+            {"padding_idx": -1 if padding_idx is None else padding_idx,
+             "is_sparse": bool(is_sparse)})
     return out
 
 

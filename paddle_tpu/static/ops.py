@@ -329,10 +329,14 @@ def _dropout(ins, attrs, op):
 
 @register_op("lookup_table_v2")
 def _lookup_table_v2(ins, attrs, op):
-    ids = _one(ins, "Ids")
-    pad = attrs.get("padding_idx", -1)
-    return {"Out": [F.embedding(ids, _one(ins, "W"),
-                                padding_idx=None if pad < 0 else pad)]}
+    # routes through parallel.embedding.lower_lookup: vocab-sharded
+    # all_to_all exchange when the ambient plan covers W, dedup'd
+    # segment-sum gradient under is_sparse, plain gather otherwise;
+    # padding_idx rows are zeroed (and so get zero gradient)
+    from ..parallel import embedding as _pemb
+    wname = op.inputs.get("W", [""])[0]
+    return {"Out": [_pemb.lower_lookup(_one(ins, "W"), _one(ins, "Ids"),
+                                       attrs, wname)]}
 
 
 # -- loss / metrics ----------------------------------------------------------

@@ -846,9 +846,15 @@ def _register_delegates():
                         else jnp.argsort(x, axis=ax)]})(
             _one(ins, "X"), attrs.get("axis", -1),
             attrs.get("descending", False)))
-    register_op("lookup_table")(
-        lambda ins, attrs, op: {"Out": [jnp.take(
-            ins["W"][0], _one(ins, "Ids").squeeze(-1), axis=0)]})
+    def _lookup_table_v1(ins, attrs, op):
+        # v1 ids carry a trailing length-1 dim; otherwise identical to
+        # lookup_table_v2 — same routing (sharded exchange / is_sparse
+        # segment-sum gradient / plain gather) and padding_idx zeroing
+        from ..parallel import embedding as _pemb
+        return {"Out": [_pemb.lower_lookup(
+            ins["W"][0], _one(ins, "Ids").squeeze(-1), attrs,
+            op.inputs.get("W", [""])[0])]}
+    register_op("lookup_table")(_lookup_table_v1)
     register_op("size")(
         lambda ins, attrs, op: {"Out": [jnp.asarray(
             int(np.prod(_one(ins, "Input").shape)), jnp.int64)]})

@@ -657,6 +657,25 @@ for _name in ("push_sparse", "push_sparse_v2"):
     register_op(_name)(_push_rule)
 
 
+@register_op("c_embedding")
+def _c_embedding(ins, attrs, op):
+    """ref collective c_embedding_op.cc: W is one vocab *partition* whose
+    global offset is ``start_index``; out-of-partition ids yield zero rows
+    and the caller allreduces partial results across the model group (the
+    manual Megatron-style layout; the automatic path is
+    ShardingPlan(embedding_shard=...) over the whole table)."""
+    ids = _one(ins, "Ids")
+    w = _one(ins, "W")
+    start = int(attrs.get("start_index", 0))
+    rows_per = int(w.shape[0])
+    flat = ids.reshape(-1).astype(jnp.int32)
+    local = flat - start
+    mine = (local >= 0) & (local < rows_per)
+    rows = jnp.take(w, jnp.clip(local, 0, rows_per - 1), axis=0)
+    rows = jnp.where(mine[:, None], rows, jnp.zeros((), w.dtype))
+    return {"Out": [rows.reshape(tuple(ids.shape) + (int(w.shape[-1]),))]}
+
+
 @register_op("merge_ids")
 def _merge_ids(ins, attrs, op):
     """ref merge_ids_op.cc: reassemble rows pulled per-shard back into the

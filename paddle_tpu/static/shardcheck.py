@@ -1,4 +1,4 @@
-"""Sharding-plan verifier: Program × ShardingPlan static checks (SC001–SC009).
+"""Sharding-plan verifier: Program × ShardingPlan static checks (SC001–SC010).
 
 The second tier of the static-analysis stack.  Tier one
 (``static/analysis.py``, PV001–PV010) checks a Program in isolation; this
@@ -53,6 +53,13 @@ Diagnostic codes (severity ``error`` aborts ``Executor.run`` under flag
   sharded on its contraction dim — GSPMD must insert an allreduce /
   all-gather there.  Legitimate for row-parallel layers; the site and its
   estimated bytes feed the communication estimate either way.
+- ``SC010`` vocab-sharded embeddings (``ShardingPlan(embedding_shard=)``,
+  parallel/embedding.py): a vocab dim indivisible by the shard axis
+  (error — the sharded lookup raises at trace time), the shard axis doubling
+  as a batch axis or a user annotation conflicting with the plan's table
+  placement (errors — silent wrong layout otherwise), and a large table
+  served by neither is_sparse nor a shard plan (warning — the backward
+  materializes a dense vocab-sized gradient).
 
 ``estimate_comm`` additionally produces the static per-bucket allreduce
 byte estimate for the data-parallel gradient sync (same math as
@@ -586,6 +593,83 @@ def _check_contractions(program, plan, mesh, out: List[Diagnostic],
                          "shard the non-contracted dim"))
 
 
+_LOOKUP_OPS = ("lookup_table", "lookup_table_v2", "embedding")
+# below this vocab size a dense gradient is cheap enough not to nag about
+_SC010_DENSE_VOCAB = 65536
+
+
+def _check_embedding(program, plan, mesh, out: List[Diagnostic]):
+    """SC010: vocab-sharded embedding tables (parallel/embedding.py) — an
+    indivisible vocab dim raises inside shard_map at trace time, a table
+    whose id batch shares the vocab axis double-shards, and a conflicting
+    user annotation places the table somewhere the lookup lowering's
+    exchange does not expect; an *uncovered* huge table without is_sparse
+    silently pays the dense vocab-sized gradient (warning)."""
+    state = {name: (shape, dtype)
+             for name, shape, dtype, _tr in _state_vars(program) if shape}
+    covered = getattr(plan, "embedding_shard", None) is not None
+    for block in program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type not in _LOOKUP_OPS:
+                continue
+            names = op.inputs.get("W", ())
+            if not names or names[0] not in state:
+                continue
+            wname = names[0]
+            shape, _dtype = state[wname]
+            axis = (plan.embedding_axis_for(wname, lookup=True)
+                    if covered else None)
+            if axis is None:
+                if (not op.attrs.get("is_sparse", False)
+                        and shape[0] >= _SC010_DENSE_VOCAB):
+                    out.append(Diagnostic(
+                        "SC010", "warning",
+                        f"{op.type} at block {block.idx} op {op_idx} reads "
+                        f"table {wname!r} (vocab {shape[0]}) with neither "
+                        "is_sparse nor an embedding_shard plan — the "
+                        "backward materializes a dense vocab-sized gradient",
+                        block.idx, op_idx, op.type, var=wname,
+                        hint="set is_sparse=True or "
+                             "ShardingPlan(embedding_shard=...)"))
+                continue
+            k = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+            if k > 1 and shape[0] % k:
+                out.append(Diagnostic(
+                    "SC010", "error",
+                    f"embedding table {wname!r} vocab {shape[0]} does not "
+                    f"divide mesh axis {axis!r} size {k} — the sharded "
+                    "lookup raises at trace time",
+                    block.idx, op_idx, op.type, var=wname,
+                    hint="pad the vocab to a multiple of the axis size"))
+            if axis in plan.batch_axes:
+                out.append(Diagnostic(
+                    "SC010", "error",
+                    f"embedding_shard axis {axis!r} for table {wname!r} is "
+                    "also a plan batch axis — ids and vocab would shard "
+                    "over the same devices and the exchange computes "
+                    "garbage",
+                    block.idx, op_idx, op.type, var=wname,
+                    hint="vocab-shard over a model axis (tp), batch over "
+                         "dp"))
+            ann = (plan.annotations or {}).get(wname)
+            if ann is not None:
+                dim0 = ann[0] if len(ann) else None
+                dim0_axes = tuple(
+                    a for a in (dim0 if isinstance(dim0, (tuple, list))
+                                else (dim0,)) if a is not None)
+                if dim0_axes != (axis,):
+                    out.append(Diagnostic(
+                        "SC010", "error",
+                        f"table {wname!r} is vocab-sharded over {axis!r} by "
+                        f"embedding_shard but annotated {tuple(ann)!r} — "
+                        "annotations win placement, so the lookup's "
+                        f"all_to_all over {axis!r} would read a "
+                        "differently-laid-out table",
+                        block.idx, op_idx, op.type, var=wname,
+                        hint="drop the annotation or align it to "
+                             f"({axis!r}, None)"))
+
+
 # ---------------------------------------------------------------------------
 # Communication estimate
 # ---------------------------------------------------------------------------
@@ -667,6 +751,7 @@ def verify_plan(program: Program, plan,
     out.extend(engine.subblock_findings)
     est = estimate_comm(program, plan, mesh)
     _check_contractions(program, plan, mesh, out, est)
+    _check_embedding(program, plan, mesh, out)
     return PlanReport(diagnostics=out, comm=est)
 
 

@@ -332,6 +332,21 @@ def _instr_flops(instr: HloInstr) -> float:
     if op in ("reduce", "reduce-window"):
         return float(sum(_elems(s) for _, s in instr.operand_shapes[:1])
                      or out_elems)
+    # sparse-lookup pricing (parallel/embedding.py exchange): one
+    # address-compute+load per gathered element, one accumulate per
+    # scattered update element — so an embedding backward's cost scales
+    # with batch ids, never with vocab size
+    if op == "gather":
+        return float(out_elems)
+    if op == "scatter":
+        # operands = (target, indices, updates): pay for the update rows
+        if len(instr.operand_shapes) >= 3:
+            return float(_elems(instr.operand_shapes[2][1]))
+        return float(out_elems)
+    if op == "dynamic-update-slice":
+        if len(instr.operand_shapes) >= 2:
+            return float(_elems(instr.operand_shapes[1][1]))
+        return float(out_elems)
     if op in _ELEMENTWISE:
         return float(out_elems)
     return 0.0

@@ -44,6 +44,10 @@ _KNOWN_NAMES = frozenset({
     "comm.allreduce_bytes",
     "comm.allreduce_ms",
     "comm.compress_ratio",
+    # parallel/embedding.py (vocab-sharded embedding exchange + serving)
+    "emb.exchange_bytes",
+    "emb.lookup_ms",
+    "emb.unique_ratio",
     # elastic/ (checkpoint.py, membership.py, failover.py)
     "elastic.checkpoint_ms",
     "elastic.failovers",
@@ -167,6 +171,7 @@ def _register_instrumented_modules() -> None:
     when the workload doesn't exercise it (PS server, hapi loop)."""
     import paddle_tpu.distributed.ps_server  # noqa: F401
     import paddle_tpu.elastic  # noqa: F401 — the elastic.* family
+    import paddle_tpu.parallel.embedding  # noqa: F401 — the emb.* family
     import paddle_tpu.serving  # noqa: F401 — the serve.* family
     import paddle_tpu.static.analysis  # noqa: F401 — analysis.* counters
     import paddle_tpu.static.shardcheck  # noqa: F401 — analysis.plans_checked
