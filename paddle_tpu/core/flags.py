@@ -230,3 +230,18 @@ define_flag("check_sharding", True, "Statically verify Program x "
             "misses — steady-state steps never re-check (ref: the "
             "compile-time InferShape/InferVarType pass stage, extended "
             "with GSPMD layout knowledge).")
+define_flag("check_memory", True, "Statically price a Program's peak HBM "
+            "before the Executor traces it (static/memcheck.py, "
+            "MC001-MC007): size every var from the shape/dtype engine, "
+            "sweep buffer lifetimes in op order, divide by the "
+            "ShardingPlan placement, and reject predicted-OOM programs "
+            "(MC001) before any trace/compile.  Advisory findings "
+            "(donation, ZeRO, embedding-shard opportunities) are "
+            "flight-recorded, never raised.  Memoized like "
+            "check_sharding, so steady-state steps never re-check.")
+define_flag("memcheck_capacity_gb", 0.0, "Override the per-device HBM "
+            "capacity (in GiB) memcheck verifies peak estimates against.  "
+            "0 = auto-detect from the device kind via "
+            "xprof.resolve_peaks (CPU backends have no table entry, so "
+            "MC001 only fires there under an explicit override — set "
+            "this in tests/CI to exercise the OOM gate).")

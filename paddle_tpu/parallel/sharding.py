@@ -440,10 +440,16 @@ class ShardingPlan:
         return {k: self.feed_sharding(k, v, mesh) for k, v in batch.items()}
 
     def state_shardings(self, state: Dict[str, Any],
-                        mesh: Optional[Mesh] = None
-                        ) -> Dict[str, NamedSharding]:
+                        mesh: Optional[Mesh] = None,
+                        optimizer_slots=None) -> Dict[str, NamedSharding]:
         """NamedSharding per persistable leaf (annotations > rules > ZeRO >
-        replicated) — `infer_sharding` over the flat state dict."""
+        replicated) — `infer_sharding` over the flat state dict.
+
+        ``optimizer_slots`` names the leaves that are persistent optimizer
+        state (moments/velocities/beta_pows): under ``zero_stage`` 1-2
+        those shard over the batch axes (``zero_spec``) even though
+        parameters stay replicated — the ZeRO-1/2 contract, and the
+        placement ``memcheck.estimate_peak`` prices."""
         mesh = mesh or self.resolve_mesh()
         ann = self.annotations
         if self.embedding_shard is not None:
@@ -457,7 +463,65 @@ class ShardingPlan:
                 ndim = len(np.shape(leaf))
                 if axis is not None and ndim >= 1:
                     ann[name] = (axis,) + (None,) * (ndim - 1)
-        return infer_sharding(state, mesh, self.rules, ann, self.zero_stage)
+        out = infer_sharding(state, mesh, self.rules, ann, self.zero_stage)
+        if self.zero_stage in (1, 2) and optimizer_slots:
+            for name in optimizer_slots:
+                if name not in state:
+                    continue
+                sh = out.get(name)
+                if sh is None or sh.spec != PartitionSpec():
+                    continue          # annotation/rule placement wins
+                spec = zero_spec(np.shape(state[name]), mesh)
+                if spec != PartitionSpec():
+                    out[name] = NamedSharding(mesh, spec)
+        return out
+
+    def placement_spec(self, name: str, shape: Tuple[int, ...],
+                       mesh: Optional[Mesh] = None) -> PartitionSpec:
+        """Effective PartitionSpec for one persistable var under this plan —
+        the same precedence `state_shardings`/`infer_sharding` apply
+        (annotation > embedding_shard-derived > rule > ZeRO stage-3 spec >
+        replicate; indivisible specs fall back to replicate).  Takes a name
+        and a concrete shape instead of a leaf so static analyses (memcheck,
+        shardcheck) can price placements before any array exists."""
+        mesh = mesh or self.resolve_mesh()
+        ann = None
+        if (self.annotations and name in self.annotations
+                and self.annotations[name] is not None):
+            ann = tuple(self.annotations[name])
+        elif self.embedding_shard is not None and len(shape) >= 1:
+            axis = self.embedding_axis_for(name)
+            if axis is not None:
+                ann = (axis,) + (None,) * (len(shape) - 1)
+        spec = None
+        if ann is not None:
+            spec = _clean_spec(ann, mesh)
+        if spec is None and self.rules is not None:
+            m = self.rules.match(name, len(shape))
+            if m is not None:
+                spec = _clean_spec(m, mesh)
+        if spec is not None and not _divisible(shape, spec, mesh):
+            spec = None
+        if spec is None or spec == PartitionSpec():
+            spec = (zero_spec(shape, mesh) if self.zero_stage >= 3
+                    else PartitionSpec())
+        return spec
+
+    def placement_divisor(self, name: str, shape: Tuple[int, ...],
+                          mesh: Optional[Mesh] = None) -> int:
+        """How many ways this plan splits the named var: the product of
+        mesh-axis sizes over its effective spec (1 == fully replicated).
+        Per-device resident bytes are ``nbytes // placement_divisor`` — the
+        HBM leg of the static cost model."""
+        mesh = mesh or self.resolve_mesh()
+        spec = self.placement_spec(name, tuple(shape), mesh)
+        n = 1
+        for a in tuple(spec):
+            if a is None:
+                continue
+            for x in (a if isinstance(a, (tuple, list)) else (a,)):
+                n *= mesh.shape[x]
+        return n
 
     def fingerprint(self) -> str:
         """Content fingerprint of the plan for the persistent compile-cache

@@ -161,6 +161,38 @@ class Server:
             from ..static.analysis import check_program_cached
 
             check_program_cached(program, feed_names=set(feed_names))
+        if _flags.get_flag("check_memory"):
+            # MC006: price the ladder's *largest* bucket at full
+            # max_live_programs concurrency — admission control must not
+            # admit a working set the device cannot hold.  Advisory
+            # (warning severity): the finding is flight-recorded; only a
+            # single-tenant predicted OOM (MC001) rejects registration.
+            from ..static.memcheck import verify_memory
+            from ..utils import trace as _trace
+
+            feed_shapes = {}
+            for fn in feed_names:
+                try:
+                    shape = tuple(program.global_block().var(fn).shape)
+                except KeyError:
+                    continue
+                feed_shapes[fn] = tuple(
+                    d if isinstance(d, int) and d > 0 else 1 for d in shape)
+            report = verify_memory(
+                program, feeds=feed_shapes, fetch_list=fetch_list,
+                bucket_edges=self.bucket_edges,
+                max_live_programs=self.tenants.max_live_programs)
+            for d in report.diagnostics:
+                _trace.flight_recorder().record(
+                    "memcheck_violation", tenant=name, code=d.code,
+                    severity=d.severity, message=d.message)
+            errs = report.errors
+            if errs:
+                from ..core import errors as _errors
+
+                raise _errors.ProgramVerificationError(
+                    f"tenant {name!r} rejected at registration:\n"
+                    + _errors.render_diagnostics(errs), diagnostics=errs)
         return self.tenants.register(
             Tenant(name, program, feed_names, fetch_list, scope, quota=quota,
                    dedup_feed=dedup_feed))

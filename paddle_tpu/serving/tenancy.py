@@ -160,7 +160,12 @@ class TenantManager:
         """Recompute live/peak temp bytes over the live tenants' compiled
         executables.  Best-effort telemetry: breakdowns exist only when the
         `metrics` flag was on at compile time, and a tenant whose
-        executable has not compiled yet contributes zero."""
+        executable has not compiled yet contributes zero.  Sharded
+        (mesh-placed) tenants report their addressable-shard sum —
+        ``Executor.memory_stats`` covers both build paths — so this gauge
+        is comparable against the static MC006 ladder bound
+        (``memcheck.verify_memory(bucket_edges=..., max_live_programs=...)``)
+        that admission control enforces at registration."""
         with self._lock:
             names = list(self._live)
         total = 0

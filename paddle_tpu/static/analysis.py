@@ -2279,6 +2279,197 @@ _INFER_RULES.update({
 })
 
 
+# -- QAT fake-quant family (static/ops_tail.py): value-wise passthrough
+#    (the quantized carrier keeps X's float dtype) plus a scale output ------
+
+def _rule_fake_quant(ctx):
+    x, dt = ctx.in_shape("X"), ctx.in_dtype("X")
+    ctx.set_out("Out", x, dt)
+    ctx.set_out("OutScale", (1,), dt)
+
+
+def _rule_fake_quant_channel(ctx):
+    """Channel-wise variants: OutScale has one entry per quant_axis slice."""
+    x, dt = ctx.in_shape("X"), ctx.in_dtype("X")
+    ctx.set_out("Out", x, dt)
+    c = None
+    if x is not None and len(x):
+        c = x[int(ctx.attr("quant_axis", 0)) % len(x)]
+    ctx.set_out("OutScale", None if c is None else (c,), dt)
+
+
+def _rule_roi(ctx):
+    """roi_align / roi_pool (static/ops.py): (R, C, ph, pw) where R is the
+    ROI count and C is X's channel dim ((1,C,H,W) or (C,H,W))."""
+    x, rois = ctx.in_shape("X"), ctx.in_shape("ROIs")
+    out = None
+    if x is not None and rois is not None and len(x) >= 3:
+        c = x[1] if len(x) == 4 else x[0]
+        out = (rois[0], c, int(ctx.attr("pooled_height", 1)),
+               int(ctx.attr("pooled_width", 1)))
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_grid_sampler(ctx):
+    """Output spatial dims come from Grid (N, Hg, Wg, 2), channels from X."""
+    x, g = ctx.in_shape("X"), ctx.in_shape("Grid")
+    out = None
+    if x is not None and g is not None and len(x) == 4 and len(g) == 4:
+        out = (x[0], x[1], g[1], g[2])
+    ctx.set_out("Output", out, ctx.in_dtype("X"))
+
+
+def _rule_affine_grid(ctx):
+    os = ctx.attr("output_shape")
+    out = None
+    if os is not None and len(os) == 4 and all(int(d) > 0 for d in os):
+        out = (int(os[0]), int(os[2]), int(os[3]), 2)
+    elif (th := ctx.in_shape("Theta")) is not None and len(th) == 3:
+        out = (th[0], None, None, 2) if _known(th[0]) else None
+    ctx.set_out("Output", out, ctx.in_dtype("Theta"))
+
+
+def _rule_nll_loss(ctx):
+    x, red = ctx.in_shape("X"), ctx.attr("reduction", "mean")
+    out = None
+    if x is not None:
+        out = (x[0],) if red == "none" else ()
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+    ctx.set_out("Total_weight", (), np.dtype(np.float32))
+
+
+def _rule_mean_iou(ctx):
+    k = ctx.attr("num_classes")
+    kshape = None if not k else (int(k),)
+    ctx.set_out("OutMeanIou", (), np.dtype(np.float32))
+    ctx.set_out("OutWrong", kshape, np.dtype(np.float32))
+    ctx.set_out("OutCorrect", kshape, np.dtype(np.float32))
+
+
+def _rule_unique_padded(ctx):
+    """unique / unique_with_counts (static/ops_tail4.py): static-shape
+    lowering pads Out/Index/Count(s) to len(X); ValidCount is scalar."""
+    x = ctx.in_shape("X")
+    idt = np.dtype(np.int64 if int(ctx.attr("dtype", 3)) == 3 else np.int32)
+    ctx.set_out("Out", x, ctx.in_dtype("X"))
+    for slot in ("Index", "Counts", "Count"):
+        ctx.set_out(slot, x, idt)
+    ctx.set_out("ValidCount", (), idt)
+
+
+def _rule_where_index(ctx):
+    """where_index (nonzero): padded (numel, rank) int64 + ValidCount."""
+    x = ctx.in_shape("X")
+    out = None
+    if x is not None:
+        if all(_known(d) for d in x):
+            n = 1
+            for d in x:
+                n *= int(d)
+            out = (n, max(1, len(x)))
+        elif len(x) == 1:
+            out = (x[0], 1)
+    ctx.set_out("Out", out, np.dtype(np.int64))
+    ctx.set_out("ValidCount", (), np.dtype(np.int64))
+
+
+def _rule_amp_check(ctx):
+    """amp_check_finite_and_scale: Out list mirrors the X list; the found-
+    infinite flag is a (1,) bool."""
+    for i in range(ctx.n_inputs("X")):
+        ctx.set_out("Out", ctx.in_shape("X", i), ctx.in_dtype("X", i), i=i)
+    ctx.set_out("FoundInfinite", (1,), np.dtype(np.bool_))
+
+
+def _rule_edit_distance(ctx):
+    h = ctx.in_shape("Hyps")
+    ctx.set_out("Out", None if h is None else (h[0], 1),
+                np.dtype(np.float32))
+    ctx.set_out("SequenceNum", (1,), np.dtype(np.int32))
+
+
+def _rule_kron(ctx):
+    x, y = ctx.in_shape("X"), ctx.in_shape("Y")
+    out = None
+    if (x is not None and y is not None and len(x) == len(y)
+            and all(_known(d) for d in x) and all(_known(d) for d in y)):
+        out = tuple(int(a) * int(b) for a, b in zip(x, y))
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+def _rule_batch_column(out_slot, in_slot="X"):
+    """Per-example losses that emit a (B, 1) column from a (B, C) input."""
+    def rule(ctx):
+        x = ctx.in_shape(in_slot)
+        ctx.set_out(out_slot, None if x is None or not len(x) else (x[0], 1),
+                    ctx.in_dtype(in_slot))
+
+    return rule
+
+
+def _rule_modified_huber(ctx):
+    x, dt = ctx.in_shape("X"), ctx.in_dtype("X")
+    ctx.set_out("IntermediateVal", x, dt)
+    ctx.set_out("Out", x, dt)
+
+
+_INFER_RULES.update({
+    # QAT fake-quant / dequant (static/ops_tail.py, ops_tail5.py)
+    "fake_quantize_abs_max": _rule_fake_quant,
+    "fake_quantize_dequantize_abs_max": _rule_fake_quant,
+    "fake_quantize_moving_average_abs_max": _rule_fake_quant,
+    "fake_quantize_dequantize_moving_average_abs_max": _rule_fake_quant,
+    "fake_quantize_range_abs_max": _rule_fake_quant,
+    "moving_average_abs_max_scale": _rule_fake_quant,
+    "fake_channel_wise_quantize_abs_max": _rule_fake_quant_channel,
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        _rule_fake_quant_channel,
+    "fake_quantize_dequantize_fixed_scale": _rule_unary,
+    "fake_dequantize_max_abs": _rule_same_as(
+        "X", "Out", dtype=np.dtype(np.float32)),
+    "dequantize_abs_max": _rule_same_as(
+        "X", "Out", dtype=np.dtype(np.float32)),
+    "fake_channel_wise_dequantize_max_abs": _rule_same_as(
+        "X", "Out", dtype=np.dtype(np.float32)),
+    "dequantize_log": _rule_same_as(
+        "X", "Out", dtype=np.dtype(np.float32)),
+    # value-wise tails (verified against their lowerings)
+    "row_conv": _rule_unary,
+    "add_position_encoding": _rule_unary,
+    "cross": _rule_unary,
+    "cholesky": _rule_unary,
+    "sigmoid_focal_loss": _rule_unary,
+    "print": _rule_same_as("In", "Out"),
+    "gather_tree": _rule_same_as("Ids", "Out"),
+    "modified_huber_loss": _rule_modified_huber,
+    "index_sample": _rule_same_as("Index", "Out"),
+    # scalars / fixed shapes
+    "is_empty": _rule_scalar(dtype=np.dtype(np.bool_)),
+    "isfinite": lambda ctx: ctx.set_out("Out", (1,), np.dtype(np.bool_)),
+    "seed": lambda ctx: ctx.set_out("Out", (1,), np.dtype(np.int32)),
+    # losses
+    "bpr_loss": _rule_batch_column("Y"),
+    "teacher_student_sigmoid_loss": _rule_batch_column("Y"),
+    "nll_loss": _rule_nll_loss,
+    "mean_iou": _rule_mean_iou,
+    "edit_distance": _rule_edit_distance,
+    # search / movement with static-shape (padded) lowerings
+    "unique": _rule_unique_padded,
+    "unique_with_counts": _rule_unique_padded,
+    "where_index": _rule_where_index,
+    # masked_select's length is data-dependent: propagate dtype only
+    "masked_select": lambda ctx: ctx.set_out("Y", None, ctx.in_dtype("X")),
+    "amp_check_finite_and_scale": _rule_amp_check,
+    # vision
+    "roi_align": _rule_roi,
+    "roi_pool": _rule_roi,
+    "grid_sampler": _rule_grid_sampler,
+    "affine_grid": _rule_affine_grid,
+    # math
+    "kron": _rule_kron,
+})
+
+
 def shape_rule_coverage() -> Dict[str, object]:
     """Declared engine coverage over the registered op set: which ops have
     a forward inference rule and/or a PV009 plausibility checker.  The

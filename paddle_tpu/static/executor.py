@@ -422,6 +422,11 @@ _m_mem_code = _monitor.gauge(
 _m_mem_total = _monitor.gauge(
     "executor.device_mem_total_bytes", "args + out + temp + code bytes of "
     "the last-compiled executable.", labelnames=("program",))
+_m_predicted_peak = _monitor.gauge(
+    "executor.predicted_peak_bytes", "memcheck's static per-device peak-HBM "
+    "estimate for this program, set before the trace/compile it prices — "
+    "compare against executor.device_mem_total_bytes to watch calibration "
+    "in production.", labelnames=("program",))
 # Collect-time census of what is actually resident: every live jax.Array in
 # the process (donated state, prefetch staging, stray host copies included).
 _m_mem_live_bytes = _monitor.gauge(
@@ -658,6 +663,24 @@ class Executor:
                     from .shardcheck import check_with_plan as _check_plan
 
                     _check_plan(program, plan, feed_arrays)
+                if _flags.get_flag("check_memory"):
+                    # tier-three: static peak-HBM pricing (MC001-MC007) —
+                    # a predicted OOM aborts here, before the trace XLA
+                    # would spend minutes on; advisory findings are
+                    # flight-recorded, never raised.  Memoized like
+                    # check_with_plan: zero steady-state cost
+                    from .memcheck import check_memory_cached as _check_mem
+
+                    mem_report = _check_mem(program, plan, feed_arrays,
+                                            fetch_names)
+                    if mem_report.mem is not None and _monitor.enabled():
+                        _m_predicted_peak.set(
+                            mem_report.mem.peak_bytes, program=str(token))
+                    for d in mem_report.diagnostics:
+                        _trace.flight_recorder().record(
+                            "memcheck_violation", code=d.code,
+                            severity=d.severity, var=d.var or "",
+                            message=d.message)
                 # verified graph-rewrite pipeline (static/passes.py):
                 # compile-path only — hot-path steps never re-enter this
                 # branch, and a verification failure rolls back to the
@@ -715,6 +738,32 @@ class Executor:
                     from ..utils import xprof as _xprof
 
                     entry.mem = _xprof.memory_stats(entry.aot)
+                    if entry.mem and plan is not None:
+                        # sharded build: when memory_analysis() priced a
+                        # per-partition SPMD module, report the
+                        # addressable-shard sum (this process's slice of
+                        # the mesh) so memory_stats()/gauges cover meshes.
+                        # Some backends (XLA:CPU) compile the module at
+                        # global shapes instead — detected by comparing
+                        # the reported args leg against the example's
+                        # known global bytes; those are left unscaled.
+                        mesh_l = plan.resolve_mesh()
+                        try:
+                            pi = jax.process_index()
+                            n_local = sum(
+                                1 for d in mesh_l.devices.flat
+                                if d.process_index == pi) or 1
+                        except Exception:
+                            n_local = int(mesh_l.devices.size)
+                        global_args = sum(
+                            int(np.asarray(v).nbytes)
+                            for part in (feed_arrays, d_state, p_state)
+                            for v in (part or {}).values())
+                        per_partition = (
+                            entry.mem["args_bytes"] < 0.75 * global_args)
+                        if n_local > 1 and per_partition:
+                            entry.mem = {k: int(v) * n_local
+                                         for k, v in entry.mem.items()}
                     if entry.mem:
                         prog = str(token)
                         _m_mem_args.set(entry.mem["args_bytes"], program=prog)
@@ -956,8 +1005,11 @@ class Executor:
         # resolve which state leaves are embedding tables BEFORE placement:
         # state_shardings must see the bound names to vocab-shard them
         plan.bind_embedding_tables(program)
+        from .memcheck import _optimizer_slots
         return self._build_sharded(raw, plan, example, donate,
-                                   state_constraints, disk, disk_key)
+                                   state_constraints, disk, disk_key,
+                                   optimizer_slots=frozenset(
+                                       _optimizer_slots(program)))
 
     @staticmethod
     def _load_or_export(raw, example, donate, disk, disk_key):
@@ -1075,7 +1127,7 @@ class Executor:
 
     @staticmethod
     def _build_sharded(raw, plan, example, donate, state_constraints,
-                       disk=None, disk_key=None):
+                       disk=None, disk_key=None, optimizer_slots=None):
         """Sharded build: the SAME traced computation with feeds and
         persistable state placed by the ShardingPlan's NamedShardings.
         GSPMD partitions the compute and inserts the collectives the
@@ -1096,7 +1148,8 @@ class Executor:
                    for k, v in feeds0.items()}
         state_all = dict(p0)
         state_all.update(d0)
-        state_sh = plan.state_shardings(state_all, mesh)
+        state_sh = plan.state_shardings(state_all, mesh,
+                                        optimizer_slots=optimizer_slots)
         state_constraints.update(state_sh)
 
         def place(v, sh):
@@ -1118,7 +1171,7 @@ class Executor:
                     {n: place(v, state_sh[n]) for n, v in carried.items()})
 
         placed_example = None
-        if disk is not None:
+        if disk is not None or _monitor.enabled():
             placed_example = (*place_all(feeds0, d0, p0), step0)
         core, status = Executor._load_or_export(raw, placed_example, donate,
                                                 disk, disk_key)
@@ -1127,10 +1180,29 @@ class Executor:
             pf, pd, pc = place_all(feeds, donated, carried)
             return core(pf, pd, pc, step)
 
-        # no AOT handle on the sharded path (GSPMD partitions per mesh; the
-        # per-shard attribution story is an open roadmap item) — xprof
-        # reports and device_mem_* gauges cover single-device entries
-        return call, status, None, None
+        if placed_example is None or not _monitor.enabled():
+            return call, status, None, None
+        # AOT-compile the placed example so the sharded path reports
+        # cost_analysis()/memory_analysis() like the single-device one —
+        # the compiled module is the per-partition SPMD program, so its
+        # memory numbers are per-device shards (memory_stats() scales them
+        # to the addressable-shard sum).  Dispatch stays on the jitted
+        # `core`: the AOT handle is observability-only here, the
+        # per-shard attribution story remains a roadmap item.
+        try:
+            aot = core.lower(*placed_example).compile()
+        except Exception:
+            return call, status, None, None
+        cost = None
+        try:
+            ca = aot.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                cost = ca
+        except Exception:
+            pass
+        return call, status, cost, aot
 
     # -- observability (utils/xprof.py) --------------------------------------
     def memory_stats(self) -> Dict[str, int]:

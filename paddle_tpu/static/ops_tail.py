@@ -1003,7 +1003,10 @@ def _center_loss(ins, attrs, op):
     diff = x - centers[label]
     loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
     if attrs.get("need_update", True) and rate is not None:
+        # the dense center-table update IS the op's semantics (ref
+        # center_loss_op.cc)  # proglint: dense-intermediate-ok
         counts = jnp.zeros(centers.shape[0]).at[label].add(1.0)
+        # proglint: dense-intermediate-ok
         delta = jnp.zeros_like(centers).at[label].add(diff)
         centers_new = centers + rate.reshape(()) * delta / (
             counts[:, None] + 1.0)

@@ -73,6 +73,7 @@ def _unique_parts(x, index_dtype):
     is_first = firstpos == jnp.arange(n)
     rank = jnp.cumsum(is_first) - 1                  # dense id per first-occ
     index = rank[firstpos].astype(index_dtype)       # reference Index output
+    # len(X)-padded static-shape contract  # proglint: dense-intermediate-ok
     out = jnp.zeros_like(x).at[
         jnp.where(is_first, rank, n)].set(x, mode="drop")
     counts = jnp.zeros((n,), index_dtype).at[index].add(1)
@@ -202,6 +203,7 @@ def _sequence_erase(ins, attrs, op):
         hit = hit | (x == jnp.asarray(t, x.dtype))
     keep = in_len & ~hit
     tgt = jnp.cumsum(keep, axis=1) - 1                            # (B, T)
+    # same-shape compaction contract  # proglint: dense-intermediate-ok
     out = jnp.zeros_like(x).at[
         jnp.arange(B)[:, None],
         jnp.where(keep, tgt, T)].set(x, mode="drop")

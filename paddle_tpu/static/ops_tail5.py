@@ -138,6 +138,7 @@ def _diag_embed(ins, attrs, op):
     n = x.shape[-1] + abs(offset)
     i = jnp.arange(x.shape[-1])
     r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+    # the (n, n) buffer IS the output  # proglint: dense-intermediate-ok
     out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype).at[..., r, c].set(x)
     nd = out.ndim
     d1, d2 = dim1 % nd, dim2 % nd

@@ -139,6 +139,35 @@ def _root_reads(block: Block, fetch_names: Sequence[str]) -> Set[str]:
     return set(fetch_names or ())
 
 
+def subblock_free_reads(op: Operator, block: Block) -> Set[str]:
+    """Names the op's sub-blocks read from an enclosing scope.
+
+    Walks every sub-block the op references (recursively), tracking which
+    names are defined *by earlier ops within that sub-block*; any read of
+    a name not so defined is a free read — the outer scope must keep it
+    live for the whole duration of the carrying op (while/cond carries,
+    rnn sequence inputs, backward_region's forward reads).  Names that
+    turn out not to exist in the outer block are harmless over-approximation
+    (the caller's live-set simply carries a name nobody produces)."""
+    free: Set[str] = set()
+    program = block.program
+
+    def walk(block_idx: int, defined: Set[str]) -> None:
+        sub = program.blocks[block_idx]
+        local = set(defined)
+        for sop in sub.ops:
+            for n in sop.input_names():
+                if n not in local:
+                    free.add(n)
+            for _attr, sbi in sop.sub_block_indices():
+                walk(sbi, local)
+            local.update(sop.output_names())
+
+    for _attr, bi in op.sub_block_indices():
+        walk(bi, set())
+    return free
+
+
 def _op_is_root(block: Block, op: Operator) -> bool:
     """Ops that must survive DCE regardless of dataflow: effects, control
     flow, and writes to persistable state (the executor writes persistable
@@ -163,7 +192,12 @@ def liveness(block: Block, fetch_names: Sequence[str]
     Returns ``(live_ops, live_after)``: per-op liveness (is the op needed
     for any fetch / persistable write / side effect?) and the set of names
     live *after* each op.  The classic kill-then-gen walk handles
-    redefinition (a persistable written mid-block) correctly."""
+    redefinition (a persistable written mid-block) correctly.
+
+    Ops that carry sub-blocks (while/cond/rnn/backward_region) gen not
+    just their declared inputs but every free read of their sub-blocks
+    (``subblock_free_reads``) — a while carry read only inside the loop
+    body must stay live across the whole loop."""
     n = len(block.ops)
     needed: Set[str] = _root_reads(block, fetch_names)
     live = [False] * n
@@ -176,6 +210,8 @@ def liveness(block: Block, fetch_names: Sequence[str]
             live[idx] = True
             needed -= outs
             needed |= set(op.input_names())
+            if op.sub_block_indices():
+                needed |= subblock_free_reads(op, block)
     return live, live_after
 
 

@@ -168,10 +168,13 @@ class CommEstimate:
 
 @dataclass
 class PlanReport:
-    """verify_plan output: diagnostics + the communication estimate."""
+    """verify_plan output: diagnostics + the communication estimate +
+    the resident-memory estimate (static/memcheck.py) — one call prices
+    a plan in both bytes-moved and bytes-resident."""
 
     diagnostics: List[Diagnostic] = field(default_factory=list)
     comm: Optional[CommEstimate] = None
+    mem: Optional[Any] = None          # memcheck.MemEstimate
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -201,6 +204,8 @@ class PlanReport:
             for site, w, axes, b in c.gather_sites:
                 lines.append(f"  gather @{site} weight={w} axes={axes} "
                              f"~{b}B")
+        if self.mem is not None:
+            lines.append(self.mem.render())
         return "\n".join(lines)
 
 
@@ -752,7 +757,20 @@ def verify_plan(program: Program, plan,
     est = estimate_comm(program, plan, mesh)
     _check_contractions(program, plan, mesh, out, est)
     _check_embedding(program, plan, mesh, out)
-    return PlanReport(diagnostics=out, comm=est)
+    # the memory dimension (static/memcheck.py): the same call that prices
+    # the plan in bytes moved prices it in bytes resident.  Findings stay
+    # out of this report (the Executor's check_memory hook owns MC
+    # enforcement) — here the estimate is the deliverable, the HBM leg of
+    # the auto-sharding scorer next to `comm`.  Deferred import: memcheck
+    # builds on this module.
+    mem = None
+    try:
+        from .memcheck import estimate_peak
+
+        mem = estimate_peak(program, plan, feed_shapes)
+    except Exception:      # pragma: no cover - defensive
+        pass               # a sizing failure must never mask SC findings
+    return PlanReport(diagnostics=out, comm=est, mem=mem)
 
 
 def check_plan(program: Program, plan,

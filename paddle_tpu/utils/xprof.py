@@ -76,34 +76,42 @@ _m_mfu = _monitor.gauge(
 # ---------------------------------------------------------------------------
 # Device peak table.
 # ---------------------------------------------------------------------------
-# (device_kind substring, peak dense flops/sec (bf16), peak HBM bytes/sec)
-# per *jax device* — chips for v4+, cores for v2/v3.  Public spec numbers;
-# the table is deliberately coarse: the roofline classifies and ranks, it
-# does not promise cycle accuracy.
-_TPU_PEAKS: Tuple[Tuple[str, float, float], ...] = (
-    ("v6e", 918e12, 1640e9), ("trillium", 918e12, 1640e9),
-    ("v5p", 459e12, 2765e9),
-    ("v5 lite", 197e12, 819e9), ("v5e", 197e12, 819e9),
-    ("v4", 275e12, 1228e9),
-    ("v3", 61.5e12, 450e9),   # per core (2 cores/chip)
-    ("v2", 22.5e12, 150e9),   # per core
+# (device_kind substring, peak dense flops/sec (bf16), peak HBM bytes/sec,
+# HBM capacity bytes) per *jax device* — chips for v4+, cores for v2/v3.
+# Public spec numbers; the table is deliberately coarse: the roofline
+# classifies and ranks, it does not promise cycle accuracy.  The capacity
+# column is what static/memcheck.py prices peak residency against (MC001).
+_GB = 1 << 30
+_TPU_PEAKS: Tuple[Tuple[str, float, float, int], ...] = (
+    ("v6e", 918e12, 1640e9, 32 * _GB), ("trillium", 918e12, 1640e9, 32 * _GB),
+    ("v5p", 459e12, 2765e9, 95 * _GB),
+    ("v5 lite", 197e12, 819e9, 16 * _GB), ("v5e", 197e12, 819e9, 16 * _GB),
+    ("v4", 275e12, 1228e9, 32 * _GB),
+    ("v3", 61.5e12, 450e9, 16 * _GB),   # per core (2 cores/chip)
+    ("v2", 22.5e12, 150e9, 8 * _GB),    # per core
 )
 # Order-of-magnitude CPU fallback (one host core running XLA:CPU): the
 # absolute MFU is meaningless there, but the ridge point (5 flops/byte)
 # still separates compute-bound matmuls from memory-bound elementwise, so
-# classification and ranking work on CPU CI.
-_CPU_PEAK = (200e9, 40e9)
+# classification and ranking work on CPU CI.  No HBM capacity: host RAM is
+# not a budget memcheck can meaningfully enforce, so hbm_bytes stays None
+# and MC001 only fires under an explicit capacity override.
+_CPU_PEAK = (200e9, 40e9, None)
 
 
 class PeakSpec:
-    __slots__ = ("kind", "flops_per_sec", "bytes_per_sec", "source")
+    __slots__ = ("kind", "flops_per_sec", "bytes_per_sec", "source",
+                 "hbm_bytes")
 
     def __init__(self, kind: str, flops_per_sec: float,
-                 bytes_per_sec: float, source: str):
+                 bytes_per_sec: float, source: str,
+                 hbm_bytes: Optional[int] = None):
         self.kind = kind
         self.flops_per_sec = float(flops_per_sec)
         self.bytes_per_sec = float(bytes_per_sec)
         self.source = source
+        # per-device HBM capacity in bytes; None when unknown (CPU fallback)
+        self.hbm_bytes = None if hbm_bytes is None else int(hbm_bytes)
 
     @property
     def ridge(self) -> float:
@@ -115,6 +123,7 @@ class PeakSpec:
         return {"kind": self.kind, "peak_flops_per_sec": self.flops_per_sec,
                 "peak_bytes_per_sec": self.bytes_per_sec,
                 "ridge_flops_per_byte": round(self.ridge, 3),
+                "hbm_bytes": self.hbm_bytes,
                 "source": self.source}
 
 
@@ -135,10 +144,11 @@ def resolve_peaks(device_kind: Optional[str] = None,
         return PeakSpec(device_kind, peak_flops, peak_bytes_per_sec,
                         "override")
     low = device_kind.lower()
-    for sub, fl, bw in _TPU_PEAKS:
+    for sub, fl, bw, hbm in _TPU_PEAKS:
         if sub in low:
-            return PeakSpec(device_kind, fl, bw, "table")
-    return PeakSpec(device_kind, *_CPU_PEAK, "fallback")
+            return PeakSpec(device_kind, fl, bw, "table", hbm_bytes=hbm)
+    fl, bw, hbm = _CPU_PEAK
+    return PeakSpec(device_kind, fl, bw, "fallback", hbm_bytes=hbm)
 
 
 # ---------------------------------------------------------------------------

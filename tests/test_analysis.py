@@ -398,6 +398,45 @@ def test_proglint_flags_seeded_violations(tmp_path):
     assert codes == ["PL001", "PL002", "PL003", "PL004"]
 
 
+_SEEDED_DENSE = textwrap.dedent('''
+    import jax.numpy as jnp
+    from .registry import register_op
+
+    @register_op("scatter_dense")
+    def _scatter(ins, attrs, op):
+        x = ins["X"][0]
+        ids = ins["Ids"][0]
+        out = jnp.zeros_like(x).at[ids].add(1.0)
+        return {"Out": [out]}
+
+    @register_op("scatter_waived")
+    def _scatter_ok(ins, attrs, op):
+        x = ins["X"][0]
+        ids = ins["Ids"][0]
+        # proglint: dense-intermediate-ok
+        out = jnp.zeros(x.shape).at[ids].add(1.0)
+        return {"Out": [out]}
+
+    @register_op("scatter_static")
+    def _scatter_static(ins, attrs, op):
+        ids = ins["Ids"][0]
+        out = jnp.zeros((4, 4)).at[ids].add(1.0)
+        return {"Out": [out]}
+''')
+
+
+def test_proglint_pl007_dense_intermediate(tmp_path):
+    """PL007 flags an input-sized dense allocation scattered into; the
+    waiver comment and static (literal-shape) allocations stay quiet."""
+    from tools.proglint import lint_file
+
+    bad = tmp_path / "ops_dense.py"
+    bad.write_text(_SEEDED_DENSE)
+    hits = [v for v in lint_file(bad) if v.code == "PL007"]
+    assert len(hits) == 1
+    assert "zeros_like" in hits[0].message or "dense" in hits[0].message
+
+
 def test_proglint_cli(tmp_path):
     # clean repo → exit 0
     clean = subprocess.run([sys.executable, "-m", "tools.proglint"],
@@ -503,8 +542,9 @@ def test_shape_rule_coverage_report():
     assert cov["covered"] == cov["inference_rules"] or \
         cov["covered"] >= cov["inference_rules"]
     # the declared-coverage RATCHET: currently ~60.8%; raise this floor
-    # when coverage grows, never lower it (PR 11 moved it 0.4 -> 0.55)
-    assert cov["coverage"] >= 0.55
+    # when coverage grows, never lower it (PR 11 moved it 0.4 -> 0.55;
+    # the memcheck PR moved it 0.55 -> 0.65)
+    assert cov["coverage"] >= 0.65
     assert all(isinstance(n, str) for n in cov["uncovered"])
     # every covered op really is registered
     assert cov["covered"] + len(cov["uncovered"]) == cov["registered"]

@@ -1,0 +1,579 @@
+"""Static peak-HBM verifier stage (static/memcheck.py): MC001-MC007.
+
+The headline contract is calibration: ``estimate_peak`` must land within
+1.5x of what ``aot.memory_analysis()`` reports for the same compiled step
+(args + out + temp), across the fixture spread — single-device fc towers
+(SGD and Adam), a conv/batch-norm residual block (backward-region
+transients), data-parallel replication, ZeRO-2 optimizer-slot sharding,
+and a vocab-sharded embedding model on a 2x2 dp×mp mesh.  On the CPU test
+backend XLA compiles sharded modules at *global* shapes, so the sharded
+fixtures pin per-device-estimate vs global-measured with donation held
+equal on both sides (donate=False) — replicated state dominates these
+toys, which keeps the pair inside the same 1.5x gate.
+
+Every MC misconfiguration fixture pairs the new static diagnostic with
+the legacy behavior it front-runs, in the shardcheck style: same setup,
+named MC code *before* the late OOM / silent waste.  Also covered: the
+Executor wiring (check_memory flag, MC001 aborts before any trace,
+memoized check_memory_cached, zero steady-state retraces), the sharded
+memory_stats() aggregate, the shardcheck PlanReport memory dimension,
+and the ``python -m tools.memcheck --selfcheck`` CLI.
+"""
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu.static as static
+import paddle_tpu.static.memcheck as mc
+import paddle_tpu.static.shardcheck as sc
+from paddle_tpu.core import errors, flags
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.sharding import ShardingPlan
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import monitor, xprof
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs the virtual CPU mesh")
+
+# the pinned contract: estimate within 1.5x of memory_analysis either way
+GATE = 1.5
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    from paddle_tpu.static import framework as _fw
+    _fw._unique.counters = {}
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = flags.get_flags(["metrics", "check_memory",
+                             "memcheck_capacity_gb"])
+    yield
+    flags.set_flags(saved)
+
+
+def _mesh(n=2, axes=("dp",)):
+    devs = np.asarray(jax.devices()[:n])
+    if len(axes) == 2:
+        devs = devs.reshape(n // 2, 2)
+    return Mesh(devs, axes)
+
+
+def _fc_tower(opt="sgd"):
+    x = L.data("x", [32])
+    y = L.data("y", [1])
+    h = L.fc(x, 64, act="relu")
+    h = L.fc(h, 64, act="relu")
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    o = (static.optimizer.Adam(learning_rate=0.01) if opt == "adam"
+         else static.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+         if opt == "momentum"
+         else static.optimizer.SGD(learning_rate=0.01))
+    o.minimize(loss)
+    return loss
+
+
+def _conv_block():
+    """conv/bn residual block — its grads live inside backward_region, the
+    fixture that pins the reverse-mode transient model."""
+    x = L.data("img", [3, 16, 16])
+    y = L.data("y", [1])
+    h = L.conv2d(x, 8, 3, padding=1)
+    h = L.batch_norm(h, act="relu")
+    h2 = L.conv2d(h, 8, 3, padding=1)
+    h2 = L.batch_norm(h2)
+    h = h + h2
+    h = L.pool2d(h, pool_size=2, pool_type="avg", global_pooling=True)
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _embedding_net(vocab=4096, width=32, opt="adam", is_sparse=False):
+    ids = L.data("ids", [16], dtype="int64")
+    y = L.data("y", [1])
+    emb = L.embedding(ids, size=(vocab, width), is_sparse=is_sparse)
+    h = L.fc(emb, 64, act="relu")
+    h = L.layer_norm(h)
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    o = (static.optimizer.Adam(learning_rate=0.01) if opt == "adam"
+         else static.optimizer.SGD(learning_rate=0.01))
+    o.minimize(loss)
+    return loss
+
+
+FEED_FC = {"x": np.zeros((16, 32), np.float32),
+           "y": np.zeros((16, 1), np.float32)}
+
+
+def _measured(exe):
+    """args+out+temp straight off the single compiled entry's
+    memory_analysis() — the unscaled ground truth the estimate predicts."""
+    entries = {id(e): e for e in exe._hot.values() if e.aot is not None}
+    assert len(entries) == 1, f"expected one compiled entry: {len(entries)}"
+    ms = xprof.memory_stats(next(iter(entries.values())).aot)
+    return ms["args_bytes"] + ms["out_bytes"] + ms["temp_bytes"]
+
+
+def _calibrate(main, startup, loss, feed, mesh=None, **plan_kwargs):
+    exe = static.Executor()
+    flags.set_flags({"metrics": False})
+    exe.run(startup)
+    flags.set_flags({"metrics": True})
+    prog = main
+    if mesh is not None:
+        prog = static.CompiledProgram(main).with_sharding(
+            mesh=mesh, **plan_kwargs)
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    measured = _measured(exe)
+    plan = prog._sharding_plan() if mesh is not None else None
+    est = mc.estimate_peak(main, plan,
+                           feeds={k: v.shape for k, v in feed.items()},
+                           fetch_list=[loss.name])
+    ratio = est.peak_bytes / measured
+    assert 1 / GATE <= ratio <= GATE, (
+        f"estimate {est.peak_bytes}B vs measured {measured}B "
+        f"(ratio {ratio:.3f}) outside the {GATE}x gate\n{est.render()}")
+    return est, measured
+
+
+# ---------------------------------------------------------------------------
+# calibration: estimate vs aot.memory_analysis() within 1.5x
+# ---------------------------------------------------------------------------
+
+def test_calibration_fc_sgd_single(_fresh, _flags_guard):
+    main, startup = _fresh
+    loss = _fc_tower()
+    _calibrate(main, startup, loss, FEED_FC)
+
+
+def test_calibration_fc_adam_single(_fresh, _flags_guard):
+    """Adam triples the resident state (moments ride along) — the args leg
+    must track it."""
+    main, startup = _fresh
+    loss = _fc_tower("adam")
+    est, _ = _calibrate(main, startup, loss, FEED_FC)
+    assert est.state_bytes > 3 * 25000      # params + 2 moment slots
+
+
+def test_calibration_conv_block_single(_fresh, _flags_guard):
+    """The reverse-mode transient model: backward_region's interior holds
+    the saved forward activations plus a cotangent, which dominates this
+    fixture's peak — dropping that term under-prices it ~40%."""
+    main, startup = _fresh
+    loss = _conv_block()
+    feed = {"img": np.zeros((8, 3, 16, 16), np.float32),
+            "y": np.zeros((8, 1), np.float32)}
+    est, measured = _calibrate(main, startup, loss, feed)
+    assert est.peak_op is not None
+    # proof the backward model carries the peak: without it the old sweep
+    # flat-lined at the forward residency and sat outside the gate
+    bw = [b for _i, t, b in est.timeline if t == "backward_region"]
+    assert bw and max(bw) == max(b for _i, _t, b in est.timeline)
+
+
+@needs_devices
+def test_calibration_fc_dp2_replicated(_fresh, _flags_guard):
+    main, startup = _fresh
+    loss = _fc_tower()
+    _calibrate(main, startup, loss, FEED_FC, mesh=_mesh(2), donate=False)
+
+
+@needs_devices
+def test_calibration_fc_zero2_slots_sharded(_fresh, _flags_guard):
+    """ZeRO-2 calibration: the estimate divides the Momentum velocity slot
+    the same way state_shardings places it, and the pair stays in gate."""
+    main, startup = _fresh
+    loss = _fc_tower("momentum")
+    est, _ = _calibrate(main, startup, loss, FEED_FC, mesh=_mesh(2),
+                        zero_stage=2, donate=False)
+    # the slot halves per device: args < params + full slot + feeds
+    est0 = mc.estimate_peak(main, ShardingPlan(mesh=_mesh(2), donate=False),
+                            feeds={k: v.shape for k, v in FEED_FC.items()},
+                            fetch_list=[loss.name])
+    assert est.state_bytes < est0.state_bytes
+
+
+@needs_devices
+def test_calibration_embedding_sharded_2x2(_fresh, _flags_guard):
+    """The ERNIE-shaped fixture: vocab-sharded table over mp, batch over
+    dp, Adam moments sharded with the table."""
+    main, startup = _fresh
+    loss = _embedding_net()
+    feed = {"ids": np.zeros((16, 16), np.int64),
+            "y": np.zeros((16, 1), np.float32)}
+    _calibrate(main, startup, loss, feed, mesh=_mesh(4, ("dp", "mp")),
+               embedding_shard="mp", donate=False)
+
+
+# ---------------------------------------------------------------------------
+# donation timeline regression
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_donation_drops_update_copies(_fresh):
+    """Donation aliases the state update in place: the out leg falls to
+    the fetches alone and every timeline entry is no higher."""
+    main, _ = _fresh
+    loss = _fc_tower("adam")
+    feeds = {k: v.shape for k, v in FEED_FC.items()}
+    est_n = mc.estimate_peak(main, ShardingPlan(mesh=_mesh(2), donate=False),
+                             feeds=feeds, fetch_list=[loss.name])
+    est_d = mc.estimate_peak(main, ShardingPlan(mesh=_mesh(2), donate=True),
+                             feeds=feeds, fetch_list=[loss.name])
+    assert est_d.out_bytes == 4                       # just the f32 loss
+    assert est_n.out_bytes > est_d.out_bytes
+    # the dropped copies are the *updated* state (everything but the
+    # never-written learning-rate scalar)
+    dropped = est_n.out_bytes - est_d.out_bytes
+    assert est_n.state_bytes - 64 <= dropped <= est_n.state_bytes
+    assert est_d.peak_bytes == est_n.peak_bytes - dropped
+
+
+# ---------------------------------------------------------------------------
+# MC001 — predicted OOM, named before any trace/compile
+# ---------------------------------------------------------------------------
+
+def test_mc001_capacity_exceeded(_fresh):
+    main, _ = _fresh
+    loss = _fc_tower()
+    report = mc.verify_memory(main, feeds={"x": (16, 32), "y": (16, 1)},
+                              fetch_list=[loss.name], capacity_bytes=1024)
+    errs = [d for d in report.errors if d.code == "MC001"]
+    assert errs and "OOM" in errs[0].message
+    with pytest.raises(errors.ProgramVerificationError) as ei:
+        mc.check_memory(main, feeds={"x": (16, 32), "y": (16, 1)},
+                        fetch_list=[loss.name], capacity_bytes=1024)
+    assert "MC001" in str(ei.value)
+    # generous capacity: quiet
+    ok = mc.verify_memory(main, feeds={"x": (16, 32), "y": (16, 1)},
+                          fetch_list=[loss.name], capacity_bytes=1 << 40)
+    assert not ok.errors
+
+
+def test_executor_front_runs_mc001(_fresh, _flags_guard):
+    """The acceptance counter-proof: with a tiny capacity flag the run dies
+    as a named MC001 with ZERO traces spent — the legacy path (flag off)
+    happily traces and compiles the very same program, which is exactly
+    the minutes-long path the verifier front-runs."""
+    main, startup = _fresh
+    loss = _fc_tower()
+    exe = static.Executor()
+    flags.set_flags({"metrics": True})
+    exe.run(startup)
+    reg = monitor.default_registry()
+    traces0 = reg.get("executor.traces").value()
+    flags.set_flags({"memcheck_capacity_gb": 1e-6})   # ~1KiB "HBM"
+    with pytest.raises(errors.ProgramVerificationError) as ei:
+        exe.run(main, feed=FEED_FC, fetch_list=[loss])
+    assert "MC001" in str(ei.value)
+    assert reg.get("executor.traces").value() == traces0   # pre-trace abort
+    # the flag-off counter-proof: identical call, no check, compiles fine
+    flags.set_flags({"check_memory": False})
+    exe.run(main, feed=FEED_FC, fetch_list=[loss])
+    assert reg.get("executor.traces").value() == traces0 + 1
+
+
+def test_executor_zero_steady_state_retraces(_fresh, _flags_guard):
+    """check_memory on must not perturb the fast path: one trace on the
+    cold run, none after (the memoized report is keyed off plan token x
+    program version x feed shapes)."""
+    main, startup = _fresh
+    loss = _fc_tower()
+    exe = static.Executor()
+    flags.set_flags({"metrics": True, "check_memory": True})
+    exe.run(startup)
+    reg = monitor.default_registry()
+    traces0 = reg.get("executor.traces").value()
+    for _ in range(4):
+        exe.run(main, feed=FEED_FC, fetch_list=[loss])
+    assert reg.get("executor.traces").value() == traces0 + 1
+
+
+def test_check_memory_cached_memoized(_fresh):
+    main, _ = _fresh
+    loss = _fc_tower()
+    r1 = mc.check_memory_cached(main, None, FEED_FC, (loss.name,))
+    assert mc.check_memory_cached(main, None, FEED_FC, (loss.name,)) is r1
+    feed2 = {"x": np.zeros((32, 32), np.float32),
+             "y": np.zeros((32, 1), np.float32)}
+    assert mc.check_memory_cached(main, None, feed2, (loss.name,)) is not r1
+
+
+# ---------------------------------------------------------------------------
+# MC002 — large trainable state updated without donation
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_mc002_undonated_state(_fresh):
+    """Legacy behavior: the step silently returns fresh parameter copies
+    next to the old buffers — pure avoidable residency, visible only as a
+    2x out leg.  MC002 names it when the copies are big enough to care."""
+    main, _ = _fresh
+    x = L.data("x", [4096])
+    y = L.data("y", [1])
+    h = L.fc(x, 2176)                 # (4096, 2176) f32 = 34MiB trainable
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    feeds = {"x": (16, 4096), "y": (16, 1)}
+    rep = mc.verify_memory(main, ShardingPlan(mesh=_mesh(2), donate=False),
+                           feeds=feeds, fetch_list=[loss.name])
+    codes = [d.code for d in rep.diagnostics]
+    assert "MC002" in codes
+    # the silent-waste proof: donation removes exactly that out-leg copy
+    rep_d = mc.verify_memory(main, ShardingPlan(mesh=_mesh(2), donate=True),
+                             feeds=feeds, fetch_list=[loss.name])
+    assert "MC002" not in [d.code for d in rep_d.diagnostics]
+    assert rep_d.mem.out_bytes < rep.mem.out_bytes
+
+
+# ---------------------------------------------------------------------------
+# MC003 — dense gradient through a big vocab
+# ---------------------------------------------------------------------------
+
+def test_mc003_dense_vocab_gradient(_fresh):
+    """Legacy behavior: backward materializes a vocab-sized dense gradient
+    every step — no error, just an 8MiB+ buffer nobody asked for."""
+    main, _ = _fresh
+    loss = _embedding_net(vocab=65536)
+    rep = mc.verify_memory(main, feeds={"ids": (16, 16), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    hits = [d for d in rep.diagnostics if d.code == "MC003"]
+    assert hits and "dense" in hits[0].message
+    assert hits[0].var is not None
+
+
+@needs_devices
+def test_mc003_covered_by_plan_or_sparse(_fresh):
+    main, _ = _fresh
+    loss = _embedding_net(vocab=65536)
+    # an embedding_shard plan covers the table: quiet
+    plan = ShardingPlan(mesh=_mesh(4, ("dp", "mp")), embedding_shard="mp")
+    rep = mc.verify_memory(main, plan, feeds={"ids": (16, 16), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    assert "MC003" not in [d.code for d in rep.diagnostics]
+
+
+def test_mc003_sparse_gradient_quiet(_fresh):
+    main, _ = _fresh
+    loss = _embedding_net(vocab=65536, is_sparse=True)
+    rep = mc.verify_memory(main, feeds={"ids": (16, 16), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    assert "MC003" not in [d.code for d in rep.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# MC004 — replicated optimizer state a zero_stage would shard
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_mc004_zero_opportunity(_fresh):
+    """Legacy behavior: Adam moments replicate across the dp world — each
+    device pays the full 32MiB for state it only ever updates 1/world of.
+    zero_stage=2 shards it with no change to the math; MC004 points there."""
+    main, _ = _fresh
+    x = L.data("x", [2048])
+    y = L.data("y", [1])
+    h = L.fc(x, 2048)                 # 16MiB param -> 32MiB adam slots
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    static.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    feeds = {"x": (16, 2048), "y": (16, 1)}
+    rep = mc.verify_memory(main, ShardingPlan(mesh=_mesh(2), zero_stage=0),
+                           feeds=feeds, fetch_list=[loss.name])
+    hits = [d for d in rep.diagnostics if d.code == "MC004"]
+    assert hits and "zero_stage=2" in hits[0].message
+    # with zero_stage=2 the slots shard and the advice (and bytes) go away
+    rep2 = mc.verify_memory(main, ShardingPlan(mesh=_mesh(2), zero_stage=2),
+                            feeds=feeds, fetch_list=[loss.name])
+    assert "MC004" not in [d.code for d in rep2.diagnostics]
+    assert rep2.mem.state_bytes < rep.mem.state_bytes
+
+
+# ---------------------------------------------------------------------------
+# MC005 — resident state nothing ever reads
+# ---------------------------------------------------------------------------
+
+def test_mc005_dead_state(_fresh):
+    main, _ = _fresh
+    loss = _fc_tower()
+    L.create_parameter([256, 256], name="orphan_w")   # never consumed
+    rep = mc.verify_memory(main, feeds={"x": (16, 32), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    hits = [d for d in rep.diagnostics if d.code == "MC005"]
+    assert [d.var for d in hits] == ["orphan_w"]
+
+
+# ---------------------------------------------------------------------------
+# MC006 — serving ladder working set over capacity
+# ---------------------------------------------------------------------------
+
+def test_mc006_serving_ladder_oversubscribed(_fresh):
+    main, _ = _fresh
+    loss = _fc_tower()
+    feeds = {"x": (16, 32), "y": (16, 1)}
+    single = mc.estimate_peak(main, feeds=feeds, fetch_list=[loss.name])
+    cap = single.peak_bytes * 2       # room for 2 tenants, not 4
+    rep = mc.verify_memory(main, feeds=feeds, fetch_list=[loss.name],
+                           bucket_edges=(16,), max_live_programs=4,
+                           capacity_bytes=cap)
+    hits = [d for d in rep.diagnostics if d.code == "MC006"]
+    assert hits and "max_live_programs=4" in hits[0].message
+    # 1 live program fits: quiet (MC001 quiet too — peak < cap)
+    rep1 = mc.verify_memory(main, feeds=feeds, fetch_list=[loss.name],
+                            bucket_edges=(16,), max_live_programs=1,
+                            capacity_bytes=cap)
+    assert not [d for d in rep1.diagnostics
+                if d.code in ("MC001", "MC006")]
+
+
+# ---------------------------------------------------------------------------
+# MC007 — embedding exchange capacity below the uniform floor
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_mc007_exchange_capacity_floor(_fresh):
+    """Legacy behavior: an over-tight embedding_capacity silently DROPS ids
+    on every batch (the exchange truncates) — training converges worse
+    with no error anywhere.  MC007 computes the uniform lower bound."""
+    main, _ = _fresh
+    loss = _embedding_net(vocab=65536)
+    plan = ShardingPlan(mesh=_mesh(4, ("dp", "mp")), embedding_shard="mp",
+                        embedding_capacity=0.01)
+    rep = mc.verify_memory(main, plan, feeds={"ids": (16, 16), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    hits = [d for d in rep.diagnostics if d.code == "MC007"]
+    assert hits and "dropped" in hits[0].message
+    # skew-proof default (None): quiet
+    plan2 = ShardingPlan(mesh=_mesh(4, ("dp", "mp")), embedding_shard="mp")
+    rep2 = mc.verify_memory(main, plan2,
+                            feeds={"ids": (16, 16), "y": (16, 1)},
+                            fetch_list=[loss.name])
+    assert "MC007" not in [d.code for d in rep2.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# satellite: sharded runs land in Executor.memory_stats()
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_memory_stats_includes_sharded_entries(_fresh, _flags_guard):
+    main, startup = _fresh
+    loss = _fc_tower()
+    exe = static.Executor()
+    flags.set_flags({"metrics": False})
+    exe.run(startup)
+    flags.set_flags({"metrics": True})
+    prog = static.CompiledProgram(main).with_sharding(mesh=_mesh(2))
+    exe.run(prog, feed=FEED_FC, fetch_list=[loss])
+    agg = exe.memory_stats()
+    assert agg["programs"] >= 1
+    assert agg["args_bytes"] > 0 and agg["total_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: shardcheck PlanReport gained the memory dimension
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_plan_report_carries_mem_estimate(_fresh):
+    main, _ = _fresh
+    _fc_tower()
+    report = sc.verify_plan(main, ShardingPlan(mesh=_mesh(2)),
+                            feed_shapes={"x": (16, 32), "y": (16, 1)})
+    assert report.mem is not None and report.mem.peak_bytes > 0
+    assert "mem estimate" in report.render()
+
+
+# ---------------------------------------------------------------------------
+# estimate surface: timeline + render + to_dict
+# ---------------------------------------------------------------------------
+
+def test_estimate_timeline_and_render(_fresh):
+    main, _ = _fresh
+    loss = _fc_tower()
+    est = mc.estimate_peak(main, feeds={"x": (16, 32), "y": (16, 1)},
+                           fetch_list=[loss.name])
+    assert len(est.timeline) == len(main.global_block().ops)
+    assert max(b for _i, _t, b in est.timeline) <= est.peak_bytes
+    d = est.to_dict()
+    assert d["peak_bytes"] == est.peak_bytes
+    assert "mem estimate" in est.render()
+    assert "high water" in est.render(timeline=True)
+
+
+def test_estimate_peak_descends_sub_blocks(_fresh, _flags_guard):
+    """Sub-block-carrying ops (StaticRNN here; while/cond share the
+    attr-walk) must price their carried block, and the executor front
+    must not choke on them — sub_block_indices() yields (attr, idx)
+    pairs, not bare indices (regression: tier-1 rnn/control-flow runs
+    broke when check_memory landed)."""
+    from paddle_tpu.static.control_flow import StaticRNN
+    main, startup = _fresh
+    T, B, D, H = 5, 2, 3, 4
+    x = L.data("x", [T, B, D], append_batch_size=False)
+    h0 = L.data("h0", [B, H], append_batch_size=False)
+    rnn = StaticRNN()
+    with rnn.step():
+        w = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        h = L.fc(L.concat([w, prev], axis=1), H, act="tanh",
+                 param_attr="rnn_w", bias_attr="rnn_b")
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    assert len(main.blocks) > 1   # the recurrence really carries a block
+
+    est = mc.estimate_peak(main, feeds={"x": (T, B, D), "h0": (B, H)},
+                           fetch_list=[out.name])
+    assert est.peak_bytes > 0
+
+    exe = static.Executor()
+    exe.run(startup)
+    got, = exe.run(main,
+                   feed={"x": np.zeros((T, B, D), np.float32),
+                         "h0": np.zeros((B, H), np.float32)},
+                   fetch_list=[out])
+    assert np.asarray(got).shape == (T, B, H)
+
+
+# ---------------------------------------------------------------------------
+# the CLI selfcheck that rides tier-1
+# ---------------------------------------------------------------------------
+
+def test_memcheck_cli_selfcheck():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.memcheck", "--selfcheck"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "memcheck selfcheck: OK" in r.stdout
+
+
+def test_memcheck_cli_mc001_exit_code():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.memcheck",
+         "--capacity-gb", "0.000001"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1
+    assert "MC001" in r.stdout

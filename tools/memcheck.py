@@ -1,0 +1,145 @@
+"""memcheck — CLI front-end for the static peak-HBM verifier.
+
+The third static-analysis tier (``static/memcheck.py``) prices a Program
+× ShardingPlan pairing in bytes before anything traces or compiles:
+per-device resident state, feed/fetch footprint, and the transient
+high-water from sub-block-aware buffer lifetimes, decomposed the same
+way ``aot.memory_analysis()`` reports it (args / out / temp) so the
+prediction is directly comparable to what XLA later allocates.  MC001
+(over capacity) is the only error; MC002–MC007 are advisory (missed
+donation, dense embedding gradients, ZeRO opportunity, dead state, the
+serving-ladder bound, embedding-capacity drops).
+
+Usage::
+
+    python -m tools.memcheck                     # demo fc tower, text
+    python -m tools.memcheck --timeline          # per-op high-water bars
+    python -m tools.memcheck --format json
+    python -m tools.memcheck --capacity-gb 0.001 # force an MC001 verdict
+    python -m tools.memcheck --selfcheck         # CI probe (rides tier-1)
+
+There is no stable serialized Program format to load from disk yet, so
+the CLI runs against the same built-in demo tower as ``tools.shardcheck``
+under the current mesh.  ``--capacity-gb`` overrides the detected HBM
+capacity (the ``memcheck_capacity_gb`` flag does the same for embedded
+use); ``--selfcheck`` asserts the demo prices to a sane, internally
+consistent estimate, that an impossible capacity yields MC001 (and a
+generous one does not), and that the timeline peak matches the reported
+peak — non-zero exit on any deviation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_demo():
+    from tools.shardcheck import _build_demo as build
+
+    return build()
+
+
+def _demo_report(capacity_bytes=None, timeline=False):
+    """(MemReport, MemEstimate) for the demo tower under the current
+    mesh's default data-parallel plan."""
+    from paddle_tpu.parallel import mesh as M
+    from paddle_tpu.parallel.sharding import ShardingPlan
+    from paddle_tpu.static.memcheck import verify_memory
+
+    program, _startup, feed_shapes = _build_demo()
+    mesh = M.current_mesh()
+    plan = ShardingPlan(mesh=mesh) if getattr(mesh, "size", 1) > 1 else None
+    report = verify_memory(program, plan, feeds=feed_shapes,
+                           capacity_bytes=capacity_bytes)
+    return report
+
+
+def selfcheck() -> int:
+    """Price the demo tower; assert the estimate is sane and the MC001
+    gate flips with capacity.  Rides tier-1 via subprocess."""
+    report = _demo_report()
+    est = report.mem
+    if est is None:
+        print("memcheck selfcheck: no estimate produced:\n"
+              + report.render(), file=sys.stderr)
+        return 1
+    if est.peak_bytes <= 0 or est.args_bytes <= 0:
+        print(f"memcheck selfcheck: degenerate estimate "
+              f"(peak={est.peak_bytes}, args={est.args_bytes})",
+              file=sys.stderr)
+        return 1
+    if not est.timeline:
+        print("memcheck selfcheck: empty per-op timeline", file=sys.stderr)
+        return 1
+    high = max(b for _i, _t, b in est.timeline)
+    if high > est.peak_bytes:
+        print(f"memcheck selfcheck: timeline high-water {high} exceeds "
+              f"reported peak {est.peak_bytes}", file=sys.stderr)
+        return 1
+    if report.errors:
+        print("memcheck selfcheck: demo tower over capacity?!:\n"
+              + report.render(), file=sys.stderr)
+        return 1
+
+    # the gate must flip: 1 KiB capacity -> MC001, 1 TiB -> clean
+    tight = _demo_report(capacity_bytes=1024)
+    if "MC001" not in {d.code for d in tight.diagnostics}:
+        print("memcheck selfcheck: 1 KiB capacity did not raise MC001:\n"
+              + tight.render(), file=sys.stderr)
+        return 1
+    roomy = _demo_report(capacity_bytes=1 << 40)
+    if any(d.code == "MC001" for d in roomy.diagnostics):
+        print("memcheck selfcheck: MC001 under a 1 TiB capacity",
+              file=sys.stderr)
+        return 1
+
+    print(f"priced demo tower: peak {est.peak_bytes} bytes "
+          f"(args {est.args_bytes} / out {est.out_bytes} / "
+          f"temp {est.temp_bytes}) across {len(est.timeline)} ops; "
+          f"MC001 gate flips with capacity")
+    print("memcheck selfcheck: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.memcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--timeline", action="store_true",
+                        help="render the per-op high-water timeline")
+    parser.add_argument("--capacity-gb", type=float, default=None,
+                        help="override the detected per-device HBM "
+                        "capacity (GiB); MC001 fires when the predicted "
+                        "peak exceeds it")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="CI probe: assert a sane estimate and the "
+                        "MC001 gate on the built-in demo")
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+
+    capacity = (None if args.capacity_gb is None
+                else int(args.capacity_gb * (1 << 30)))
+    report = _demo_report(capacity_bytes=capacity)
+
+    if args.format == "json":
+        payload = {
+            "diagnostics": [
+                {"code": d.code, "severity": d.severity,
+                 "message": d.message, "var": d.var, "hint": d.hint}
+                for d in report.diagnostics],
+            "mem": report.mem.to_dict() if report.mem else None,
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(report.render())
+        if args.timeline and report.mem is not None:
+            print(report.mem.render(timeline=True))
+    return 1 if report.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,10 @@ _NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 # The metric-name inventory: every name any instrumented module registers.
 # Grouped by family; keep sorted within each group.
 _KNOWN_NAMES = frozenset({
-    # static/analysis.py + static/shardcheck.py (the two-tier verifier)
+    # static/analysis.py + static/shardcheck.py + static/memcheck.py
+    # (the three-tier verifier)
+    "analysis.mem_checks",
+    "analysis.mem_violations",
     "analysis.plans_checked",
     "analysis.programs_checked",
     "analysis.violations",
@@ -72,6 +75,7 @@ _KNOWN_NAMES = frozenset({
     "executor.device_mem_total_bytes",
     "executor.dispatch_time_ms",
     "executor.donated_bytes",
+    "executor.predicted_peak_bytes",
     "executor.program_ops",
     "executor.state_size_bytes",
     "executor.step_time_ms",

@@ -48,6 +48,18 @@ trace-time heisenbugs.  Checks:
   (``paddle_tpu/static/``, ``paddle_tpu/slim/``, ``tools/``), not just
   lowering modules.
 
+- ``PL007`` dense O(vocab)/O(param) intermediates in a lowering module: a
+  ``jnp.zeros``/``ones``/``full`` (or ``*_like``) buffer whose size comes
+  from a *runtime array* (a ``.shape`` access or a ``_like`` callee) used
+  directly as a scatter target (``.at[...]``).  This is the embedding-
+  gradient anti-pattern: ``jnp.zeros(table.shape).at[ids].add(g)``
+  materializes the whole table per step, which memcheck's MC003 sees as
+  an O(vocab) transient.  Constant- or attrs-sized buffers are exempt
+  (compile-time bounded); a deliberately-bounded site (e.g. the padded
+  static-shape ``unique`` contract, or a center-loss table update that IS
+  the op's semantics) is waived with ``# proglint: dense-intermediate-ok``
+  on the allocation's line or the line above it.
+
 CLI:  ``python -m tools.proglint [files...]`` — defaults to every
 ``paddle_tpu/static/ops*.py`` in the repo for PL001–PL005 plus the
 static-graph surface for PL006; exits 0 when clean, 1 when any violation
@@ -263,6 +275,55 @@ def _check_host_sync(path: str, fn, aliases: Dict[str, str], lines,
             f"`# {_HOST_SYNC_WAIVER}`)"))
 
 
+_DENSE_WAIVER = "proglint: dense-intermediate-ok"
+_DENSE_ALLOCS = frozenset((
+    "zeros", "zeros_like", "ones", "ones_like", "full", "full_like",
+    "empty", "empty_like"))
+
+
+def _sized_from_runtime_array(call: ast.Call) -> bool:
+    """True when the allocation's extent is tied to a runtime array: a
+    ``*_like`` callee, or a ``.shape`` access anywhere in the arguments.
+    Constant / attrs-derived sizes are compile-time bounded and exempt."""
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr.endswith("_like"):
+        return True
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Attribute) and n.attr == "shape":
+                return True
+    return False
+
+
+def _check_dense_intermediate(path: str, tree: ast.Module, lines,
+                              out: List[Violation]) -> None:
+    """PL007: an input-sized dense buffer immediately scattered into —
+    the anti-pattern memcheck prices as an O(vocab)/O(param) transient."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute) and node.attr == "at"
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _DENSE_ALLOCS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("jnp", "np", "numpy")):
+            continue
+        if not _sized_from_runtime_array(call):
+            continue
+        waiver_lines = lines[max(0, call.lineno - 2):call.lineno]
+        if any(_DENSE_WAIVER in ln for ln in waiver_lines):
+            continue
+        out.append(Violation(
+            path, call.lineno, "PL007",
+            f"`{f.value.id}.{f.attr}` sized from a runtime array is used "
+            "as a scatter target — this materializes a dense "
+            "O(param)/O(vocab) intermediate every step (dedup the ids or "
+            "use segment ops; waive a deliberately-bounded site with "
+            f"`# {_DENSE_WAIVER}`)"))
+
+
 _RAW_MUTATION_WAIVER = "proglint: raw-mutation-ok"
 _MUTATING_LIST_METHODS = frozenset((
     "append", "insert", "pop", "remove", "clear", "extend", "sort",
@@ -364,6 +425,7 @@ def lint_file(path, descoped: Optional[Dict[str, str]] = None,
     lines = source.splitlines()
     out: List[Violation] = []
     _check_forbidden_idioms(rel, tree, out)
+    _check_dense_intermediate(rel, tree, lines, out)
     aliases = _module_aliases(tree)
     for node in ast.walk(tree):
         if _is_lowering_fn(node):

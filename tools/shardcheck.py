@@ -127,7 +127,7 @@ def selfcheck() -> int:
         return 1
 
     cov = _coverage()
-    if cov["coverage"] < 0.55:
+    if cov["coverage"] < 0.65:
         print(f"shardcheck selfcheck: shape-rule coverage regressed to "
               f"{cov['coverage']:.2%}", file=sys.stderr)
         return 1
