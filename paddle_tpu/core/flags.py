@@ -245,3 +245,32 @@ define_flag("memcheck_capacity_gb", 0.0, "Override the per-device HBM "
             "xprof.resolve_peaks (CPU backends have no table entry, so "
             "MC001 only fires there under an explicit override — set "
             "this in tests/CI to exercise the OOM gate).")
+define_flag("ledger", True, "Calibration ledger (utils/ledger.py): on every "
+            "Executor compile event and every closed steady-state step "
+            "window, append a record joining the static cost models' "
+            "predictions (shardcheck comm bytes, memcheck peak HBM, xprof "
+            "roofline ms) with what the run actually measured "
+            "(executor.step_time_ms, comm.allreduce_bytes, "
+            "Executor.memory_stats), and export per-model drift gauges "
+            "(ledger.drift_ratio{model=comm|mem|roofline}).  Drift outside "
+            "a model's calibration band is flight-recorded as a "
+            "ledger_drift anomaly the watchdog counts.  Records are kept "
+            "in a bounded in-memory ring served at /ledger?since=; set "
+            "ledger_dir (or PDTPU_LEDGER_DIR) to also append them as "
+            "JSONL.  Pure observation: estimates reuse the memoized "
+            "compile-path analyses, never trace, and never raise into "
+            "Executor.run — warm persistent-cache starts and zero "
+            "steady-state retraces are preserved.  Inert while the "
+            "metrics flag is off.")
+define_flag("ledger_window", 32, "Steady-state window size for the "
+            "calibration ledger: every N measured executor.step_time_ms "
+            "observations of one compiled entry close a window record "
+            "joining the window's median step time against the entry's "
+            "roofline-modeled ms (and re-stating the compile-time "
+            "comm/mem drift for continuity in the JSONL stream).")
+define_flag("ledger_dir", "", "Directory for per-rank calibration-ledger "
+            "JSONL sinks (ledger.rank<N>.jsonl, one O_APPEND write per "
+            "record so concurrent ranks on a shared filesystem never "
+            "interleave mid-line).  Empty (default): in-memory ring only.  "
+            "`launch --ledger_dir DIR` exports PDTPU_LEDGER_DIR per "
+            "worker, the same pattern as the telemetry/elastic dirs.")

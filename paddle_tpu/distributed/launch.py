@@ -46,7 +46,8 @@ def launch(training_script: str, script_args: List[str],
            log_dir: Optional[str] = None, backend_env: str = "",
            trace_dir: Optional[str] = None, max_restarts: int = 0,
            elastic_dir: Optional[str] = None,
-           telemetry_port: Optional[int] = None) -> int:
+           telemetry_port: Optional[int] = None,
+           ledger_dir: Optional[str] = None) -> int:
     """Spawn `nproc` worker processes with the trainer-env contract.
     Returns the first nonzero exit code, or 0.
 
@@ -72,7 +73,13 @@ def launch(training_script: str, script_args: List[str],
     import bootstrap starts that rank's HTTP telemetry plane on it
     (utils/telemetry.py) — deterministic ports, so an operator scrapes
     ``/metrics`` and ``/healthz`` of every rank of a live job without any
-    discovery step.  A restarted rank reuses its port (same rank env)."""
+    discovery step.  A restarted rank reuses its port (same rank env).
+
+    Calibration ledger: ``ledger_dir`` is exported as PDTPU_LEDGER_DIR so
+    every rank appends its measured-vs-predicted records to
+    ``ledger.rank<r>.jsonl`` in one shared directory (utils/ledger.py) —
+    the durable twin of the ``/ledger`` endpoint ``tools/fleetview``
+    scrapes live."""
     base_port = started_port or _free_port()
     endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
     job_trace_id = uuid.uuid4().hex
@@ -82,6 +89,8 @@ def launch(training_script: str, script_args: List[str],
         os.makedirs(trace_dir, exist_ok=True)
     if elastic_dir:
         os.makedirs(elastic_dir, exist_ok=True)
+    if ledger_dir:
+        os.makedirs(ledger_dir, exist_ok=True)
     procs: List[subprocess.Popen] = []
     logs = []
     exit_code = 0
@@ -104,6 +113,8 @@ def launch(training_script: str, script_args: List[str],
             env["PDTPU_ELASTIC_DIR"] = elastic_dir
         if telemetry_port:
             env["PDTPU_TELEMETRY_PORT"] = str(int(telemetry_port) + rank)
+        if ledger_dir:
+            env["PDTPU_LEDGER_DIR"] = ledger_dir
         for kv in backend_env.split(","):
             if "=" in kv:
                 k, v = kv.split("=", 1)
@@ -207,15 +218,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--telemetry_port", type=int, default=None,
                         help="base port for the per-rank HTTP telemetry "
                         "plane: rank r serves /metrics, /healthz, /flight, "
-                        "/xprof, /spans on telemetry_port + r "
+                        "/xprof, /spans, /ledger on telemetry_port + r "
                         "(utils/telemetry.py)")
+    parser.add_argument("--ledger_dir", type=str, default=None,
+                        help="shared directory for per-rank calibration "
+                        "ledger JSONL sinks, exported to workers as "
+                        "PDTPU_LEDGER_DIR (utils/ledger.py)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.training_script, args.script_args, args.nproc,
                   args.started_port, args.log_dir, args.backend_env,
                   args.trace_dir, args.max_restarts, args.elastic_dir,
-                  args.telemetry_port)
+                  args.telemetry_port, args.ledger_dir)
 
 
 if __name__ == "__main__":

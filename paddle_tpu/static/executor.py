@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import random as _random
+from ..utils import ledger as _ledger
 from ..utils import monitor as _monitor
 from ..utils import profiler as _profiler
 from ..utils import trace as _trace
@@ -643,6 +644,12 @@ class Executor:
         t_compile0 = time.perf_counter()
         if cache_miss:
             _m_cache_miss.inc()
+            # calibration ledger: traced comm bytes accumulate in a
+            # process-wide histogram, so the delta across this compile is
+            # what *this* trace moved (utils/ledger.py joins it against
+            # shardcheck's estimate); mem_report joins the memcheck leg
+            ledger_pre = _ledger.pre_compile()
+            mem_report = None
             with _trace.span("executor::trace_compile",
                              program=entry.fingerprint,
                              ops=entry.op_count) as sp:
@@ -774,6 +781,14 @@ class Executor:
                                          program=prog)
             if _monitor.enabled():
                 _m_prog_ops.set(entry.op_count, program=str(token))
+            # measured-vs-predicted compile record: joins estimate_comm /
+            # estimate_peak / roofline against entry.mem and the traced
+            # comm delta.  Guarded inside — an estimator bug degrades to
+            # an unpriced record, never a failed run
+            _ledger.observe_compile(entry=entry, program=program, plan=plan,
+                                    feed_arrays=feed_arrays,
+                                    fetch_names=fetch_names,
+                                    mem_report=mem_report, pre=ledger_pre)
         else:
             _m_cache_hit.inc()
 
@@ -820,7 +835,12 @@ class Executor:
                 next(iter(new_state.values()), None)
             if isinstance(sync, jax.Array):
                 sync.block_until_ready()
-                _m_step_ms.observe((time.perf_counter() - t_run0) * 1000.0)
+                step_ms = (time.perf_counter() - t_run0) * 1000.0
+                _m_step_ms.observe(step_ms)
+                # same measured value feeds the calibration ledger's
+                # steady-state window (a list append; the window closes
+                # into a record every ledger_window steps)
+                _ledger.observe_step(entry.fingerprint, step_ms)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -804,3 +804,36 @@ def check_memory_cached(program: Program, plan=None,
             _MEMO.clear()
         _MEMO[key] = report
     return report
+
+
+_EST_MEMO: Dict[tuple, Optional[MemEstimate]] = {}
+
+
+def estimate_peak_cached(program: Program, plan=None,
+                         feed_arrays: Optional[Dict[str, Any]] = None,
+                         fetch_names: Optional[Sequence[str]] = None
+                         ) -> Optional[MemEstimate]:
+    """Never-raising, memoized ``estimate_peak`` for the calibration ledger
+    (utils/ledger.py): the ledger prices *every* compile event, including
+    runs where the check_memory flag (and its MC001 abort) is off, and a
+    broken estimate there must degrade to an unpriced record, never a
+    failed compile.  Same memo key shape as ``check_memory_cached`` (minus
+    the capacity — no gate is enforced here), sharing its lock and
+    clear-on-cap policy."""
+    try:
+        feed_shapes = _feed_shape_dict(feed_arrays)
+        sig = tuple(sorted(feed_shapes.items()))
+        key = ("est", plan.token if plan is not None else None,
+               program._version, sig, tuple(fetch_names or ()))
+        with _memo_lock:
+            if key in _EST_MEMO:
+                return _EST_MEMO[key]
+        est = estimate_peak(program, plan, feeds=feed_shapes,
+                            fetch_list=list(fetch_names or ()))
+        with _memo_lock:
+            if len(_EST_MEMO) >= _MEMO_CAP:
+                _EST_MEMO.clear()
+            _EST_MEMO[key] = est
+        return est
+    except Exception:
+        return None

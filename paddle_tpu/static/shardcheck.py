@@ -138,15 +138,7 @@ class CommEstimate:
         """Sum of the ``comm.allreduce_bytes`` histogram (recorded at trace
         time by compress._record_comm) for cross-checking the estimate.
         ``axis=None`` sums every labeled cell."""
-        hist = _monitor.histogram(
-            "comm.allreduce_bytes", "wire bytes per allreduce",
-            labelnames=("axis", "dtype"),
-            buckets=(1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 26, 1 << 30))
-        total = 0.0
-        for labels, stat in hist.samples():
-            if axis is None or labels.get("axis") == axis:
-                total += stat["sum"]
-        return total
+        return measured_comm_bytes(axis)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -695,6 +687,23 @@ def _grad_leaves(program) -> List[Tuple[str, int, np.dtype]]:
         leaves.append((p.name, int(np.prod(shape, dtype=np.int64)) if shape
                        else 1, np.dtype(p.dtype)))
     return list(reversed(leaves))
+
+
+def measured_comm_bytes(axis: Optional[str] = None) -> float:
+    """Cumulative sum of the ``comm.allreduce_bytes`` histogram (wire bytes
+    recorded when a step is *traced*, compress._record_comm) — the shared
+    snapshot/delta primitive behind ``CommEstimate.measured_bytes`` and the
+    calibration ledger's per-compile comm attribution (utils/ledger.py
+    snapshots it before a compile and charges the delta to that trace)."""
+    hist = _monitor.histogram(
+        "comm.allreduce_bytes", "wire bytes per allreduce",
+        labelnames=("axis", "dtype"),
+        buckets=(1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 26, 1 << 30))
+    total = 0.0
+    for labels, stat in hist.samples():
+        if axis is None or labels.get("axis") == axis:
+            total += stat["sum"]
+    return total
 
 
 def estimate_comm(program: Program, plan, mesh=None) -> CommEstimate:

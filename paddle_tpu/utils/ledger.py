@@ -1,0 +1,400 @@
+"""Calibration ledger: measured-vs-predicted drift tracking.
+
+The platform carries three static cost models — shardcheck's
+``CommEstimate`` (allreduce wire bytes), memcheck's ``MemEstimate``
+(peak HBM), and the xprof roofline (modeled step ms) — whose accuracy
+is pinned once by tests (2x comm, 1.5x HBM) and then trusted blindly.
+This module closes that loop at run time: every ``Executor.run``
+compile event and every closed steady-state step window appends a
+record keyed by (program fingerprint x plan fingerprint x mesh
+fingerprint) that joins what the models *predicted* with what the run
+actually *measured* (``executor.step_time_ms``,
+``comm.allreduce_bytes``, ``Executor.memory_stats()``), computes a
+symmetric drift ratio per model, and raises a ``ledger_drift`` flight
+anomaly (counted by the watchdog) when a ratio leaves its calibration
+band.  The records are the data source the autoplan scorer
+(ROADMAP item 2) gates against and the ``/ledger`` telemetry endpoint
+plus ``tools/fleetview`` aggregate across ranks — the reference's
+platform/monitor.h StatValue ancestry, turned into a self-auditing
+measure-to-verify loop over our own estimators (TACCL, arxiv
+2111.04867).
+
+Design rules, in order:
+
+* **Never into the run path.**  Every public hook is wrapped — a
+  broken estimator degrades to an unpriced record, never a failed
+  ``Executor.run``.
+* **Observation only.**  Predictions reuse the memoized compile-path
+  analyses (``estimate_peak_cached``; ``estimate_comm`` is pure
+  Program arithmetic); nothing here traces, so zero steady-state
+  retraces and warm persistent-cache starts hold under the ``ledger``
+  flag (pinned in tests/test_ledger.py).
+* **Drift is symmetric**: ``max(pred/meas, meas/pred) >= 1.0``, so one
+  band bounds both over- and under-prediction — the same two-sided
+  contract the shardcheck/memcheck calibration tests pin.
+* **Appends are atomic.**  The optional JSONL sink issues one
+  ``O_APPEND`` ``os.write`` per record, so concurrent ranks on a
+  shared filesystem never interleave mid-line (same idiom as the
+  elastic heartbeat files).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import flags as _flags
+from . import monitor as _monitor
+from . import trace as _trace
+
+__all__ = [
+    "BANDS", "LEDGER_DIR_ENV", "Ledger", "ledger", "drift_ratio",
+    "enabled", "pre_compile", "observe_compile", "observe_step",
+]
+
+LEDGER_DIR_ENV = "PDTPU_LEDGER_DIR"
+
+# Calibration bands: a drift ratio above the band flight-records a
+# ledger_drift anomaly.  comm/mem mirror the test-pinned 2x / 1.5x
+# envelopes of estimate_comm / estimate_peak.  The roofline leg is
+# tracked but unbanded (None): its peak tables model TPU hardware, so
+# measured-vs-modeled ms on CPU CI hosts drifts by design — a band
+# lands once TPU-measured calibration data exists (ROADMAP item 2).
+BANDS: Dict[str, Optional[float]] = {
+    "comm": 2.0,
+    "mem": 1.5,
+    "roofline": None,
+}
+
+_m_records = _monitor.counter(
+    "ledger.records", "Calibration-ledger records appended, by kind "
+    "(compile event vs steady-state window).", labelnames=("kind",))
+_m_drift = _monitor.gauge(
+    "ledger.drift_ratio", "Latest symmetric measured-vs-predicted drift "
+    "ratio per cost model (>= 1.0; 1.0 = perfectly calibrated).",
+    labelnames=("model",))
+_m_alarms = _monitor.counter(
+    "ledger.drift_alarms", "Drift ratios observed outside a model's "
+    "calibration band (each one is also a ledger_drift flight anomaly).",
+    labelnames=("model",))
+
+
+def drift_ratio(predicted: Optional[float],
+                measured: Optional[float]) -> Optional[float]:
+    """Symmetric calibration ratio: ``max(p/m, m/p)``, or None when either
+    leg is missing/non-positive (no prediction, no measurement — e.g. a
+    warm persistent-cache start records no traced comm bytes)."""
+    try:
+        p, m = float(predicted), float(measured)
+    except (TypeError, ValueError):
+        return None
+    if p <= 0.0 or m <= 0.0:
+        return None
+    r = p / m
+    return max(r, 1.0 / r)
+
+
+class Ledger:
+    """Bounded in-memory ring of calibration records + optional JSONL sink.
+
+    The ring mirrors the flight recorder's cursor contract: records carry a
+    monotonic ``seq``, ``read_since(seq)`` returns the still-retained tail
+    plus an explicit truncation verdict, and ``last_seq`` anchors the next
+    incremental ``/ledger?since=`` poll."""
+
+    def __init__(self, capacity: int = 256, path: Optional[str] = None):
+        self._records: "deque" = deque(maxlen=max(1, int(capacity)))
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._path = path
+        # per-program join state: the latest compile event's predictions and
+        # compile-time measurements, re-joined by later window records
+        self._join: Dict[str, Dict[str, Any]] = {}
+        # per-program open step window (measured step_time_ms samples)
+        self._win: Dict[str, List[float]] = {}
+
+    # -- cursor reads (telemetry /ledger) ---------------------------------
+
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+    def read_since(self, seq: int) -> Tuple[List[Dict[str, Any]], bool]:
+        """Records with seq strictly greater than ``seq`` still in the
+        ring, plus True when the cursor fell behind the bounded window
+        (same verdict rule as FlightRecorder.read_since)."""
+        with self._lock:
+            records = list(self._records)
+            last = self._seq
+        if last <= seq:
+            truncated = False
+        elif not records:
+            truncated = True
+        else:
+            truncated = min(r["seq"] for r in records) > seq + 1
+        return [r for r in records if r["seq"] > seq], truncated
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._records)
+
+    # -- appends ----------------------------------------------------------
+
+    def append(self, kind: str, key: Dict[str, Optional[str]],
+               predicted: Dict[str, Optional[float]],
+               measured: Dict[str, Optional[float]],
+               **extra: Any) -> Dict[str, Any]:
+        """Join one prediction/measurement pair into a record: compute the
+        per-model drifts, update the gauges, flag band exits, append to the
+        ring (and the JSONL sink), and return the record."""
+        drift = {
+            "comm": drift_ratio(predicted.get("comm_bytes"),
+                                measured.get("allreduce_bytes")),
+            "mem": drift_ratio(predicted.get("peak_hbm_bytes"),
+                               measured.get("mem_total_bytes")),
+            "roofline": drift_ratio(predicted.get("roofline_ms"),
+                                    measured.get("step_time_ms")),
+        }
+        violations = []
+        for model, ratio in drift.items():
+            if ratio is None:
+                continue
+            _m_drift.set(ratio, model=model)
+            band = BANDS.get(model)
+            if band is not None and ratio > band:
+                violations.append(model)
+        with self._lock:
+            self._seq += 1
+            record = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "kind": kind,
+                "rank": _trace._rank(),
+                "key": dict(key),
+                "predicted": dict(predicted),
+                "measured": dict(measured),
+                "drift": drift,
+                "band_violations": violations,
+            }
+            record.update(extra)
+            self._records.append(record)
+        _m_records.inc(kind=kind)
+        for model in violations:
+            _m_alarms.inc(model=model)
+            # the watchdog's flight drain counts these into its anomaly
+            # report; band exits are advisory (they never flip /healthz)
+            _trace.flight_recorder().record(
+                "ledger_drift", name=model, model=model,
+                drift=round(drift[model], 4), band=BANDS[model],
+                program=key.get("program") or "")
+        if self._path:
+            self._append_line(record)
+        return record
+
+    def _append_line(self, record: Dict[str, Any]) -> None:
+        """One O_APPEND write per line: atomic on POSIX local filesystems,
+        so N ranks sharing a ledger_dir never interleave mid-record."""
+        try:
+            data = (json.dumps(record, sort_keys=True,
+                               default=repr) + "\n").encode("utf-8")
+            fd = os.open(self._path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # a full/readonly disk must not take down training
+
+    # -- Executor hooks (see module functions for the guarded entry) ------
+
+    def compile_event(self, *, entry, program, plan, feed_arrays,
+                      fetch_names, mem_report, pre) -> None:
+        program_fp = entry.fingerprint
+        plan_fp = None
+        mesh_fp = None
+        if plan is not None:
+            try:
+                plan_fp = plan.fingerprint()
+            except Exception:
+                plan_fp = None
+            try:
+                from ..parallel.mesh import mesh_fingerprint
+                mesh_fp = mesh_fingerprint(plan.resolve_mesh())
+            except Exception:
+                mesh_fp = None
+        key = {"program": program_fp, "plan": plan_fp, "mesh": mesh_fp}
+
+        predicted: Dict[str, Optional[float]] = {
+            "comm_bytes": None, "peak_hbm_bytes": None, "roofline_ms": None}
+        if plan is not None:
+            try:
+                from ..static.shardcheck import estimate_comm
+                est = estimate_comm(program, plan)
+                # the measured leg is the traced comm.allreduce_bytes
+                # histogram, which records allreduce wire bytes only —
+                # compare like with like (gather_bytes stays out)
+                predicted["comm_bytes"] = float(est.allreduce_bytes)
+            except Exception:
+                pass
+        mem_est = mem_report.mem if mem_report is not None else None
+        if mem_est is None:
+            try:
+                from ..static.memcheck import estimate_peak_cached
+                mem_est = estimate_peak_cached(program, plan, feed_arrays,
+                                               fetch_names)
+            except Exception:
+                mem_est = None
+        if mem_est is not None:
+            predicted["peak_hbm_bytes"] = float(mem_est.peak_bytes)
+        if entry.aot is not None:
+            try:
+                from . import xprof as _xprof
+                totals = _xprof.roofline_totals(entry.aot)
+                if totals and totals.get("modeled_ms"):
+                    predicted["roofline_ms"] = float(totals["modeled_ms"])
+            except Exception:
+                pass
+
+        measured: Dict[str, Optional[float]] = {
+            "step_time_ms": None, "allreduce_bytes": None,
+            "mem_total_bytes": None}
+        # comm bytes are recorded at TRACE time (compress._record_comm):
+        # the histogram delta across this compile is what the trace moved
+        # per step.  A warm persistent-cache start deserializes without
+        # tracing — delta 0 — and the comm leg stays honestly unmeasured.
+        if pre is not None and pre.get("comm_bytes") is not None:
+            try:
+                from ..static.shardcheck import measured_comm_bytes
+                delta = measured_comm_bytes() - pre["comm_bytes"]
+                if delta > 0:
+                    measured["allreduce_bytes"] = float(delta)
+            except Exception:
+                pass
+        if entry.mem:
+            # args+out+temp — the exact quantity estimate_peak models and
+            # the memcheck calibration tests measure (code bytes excluded
+            # on both sides)
+            measured["mem_total_bytes"] = float(
+                entry.mem.get("args_bytes", 0)
+                + entry.mem.get("out_bytes", 0)
+                + entry.mem.get("temp_bytes", 0))
+
+        self.append("compile", key, predicted, measured,
+                    disk_cache=getattr(entry, "disk_cache", None))
+        self._join[program_fp] = {
+            "key": key, "predicted": predicted,
+            "measured": dict(measured),
+        }
+
+    def step_observed(self, program_fp: str, step_ms: float) -> None:
+        window = int(_flags.get_flag("ledger_window"))
+        if window <= 0:
+            return
+        samples = self._win.setdefault(program_fp, [])
+        samples.append(float(step_ms))
+        if len(samples) < window:
+            return
+        self._win[program_fp] = []
+        samples.sort()
+        median = samples[len(samples) // 2]
+        join = self._join.get(program_fp, {})
+        predicted = dict(join.get("predicted") or {
+            "comm_bytes": None, "peak_hbm_bytes": None, "roofline_ms": None})
+        measured = dict(join.get("measured") or {
+            "allreduce_bytes": None, "mem_total_bytes": None})
+        measured["step_time_ms"] = median
+        key = join.get("key") or {"program": program_fp, "plan": None,
+                                  "mesh": None}
+        self.append("window", key, predicted, measured,
+                    window_steps=len(samples),
+                    window_min_ms=round(samples[0], 4),
+                    window_max_ms=round(samples[-1], 4))
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton + guarded Executor-facing hooks.
+# ---------------------------------------------------------------------------
+_singleton: Optional[Ledger] = None
+_singleton_lock = threading.Lock()
+
+
+def _sink_path() -> Optional[str]:
+    d = str(_flags.get_flag("ledger_dir") or "").strip() \
+        or os.environ.get(LEDGER_DIR_ENV, "").strip()
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    return os.path.join(d, f"ledger.rank{_trace._rank()}.jsonl")
+
+
+def ledger() -> Ledger:
+    """The process-wide ledger (created on first use; the JSONL sink path
+    is resolved then, after launch has exported PDTPU_LEDGER_DIR)."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = Ledger(path=_sink_path())
+        return _singleton
+
+
+def reset() -> None:
+    """Drop the singleton (tests): the next ledger() call re-resolves the
+    sink path and starts a fresh ring/cursor space."""
+    global _singleton
+    with _singleton_lock:
+        _singleton = None
+
+
+def enabled() -> bool:
+    """Ledger hooks run only when both the ledger flag and the metrics
+    plane are on — without metrics there is no measured leg to join."""
+    return bool(_flags.get_flag("ledger")) and _monitor.enabled()
+
+
+def pre_compile() -> Optional[Dict[str, float]]:
+    """Snapshot taken at the top of the Executor's miss branch: the
+    cumulative traced comm bytes *before* this compile, so the compile
+    event can attribute the histogram delta to its own trace."""
+    if not enabled():
+        return None
+    try:
+        from ..static.shardcheck import measured_comm_bytes
+        return {"comm_bytes": measured_comm_bytes()}
+    except Exception:
+        return None
+
+
+def observe_compile(*, entry, program, plan, feed_arrays, fetch_names,
+                    mem_report=None, pre=None) -> None:
+    """Append the compile-event record (guarded: never raises into
+    Executor.run; a failing estimator means an unpriced leg, not a failed
+    compile)."""
+    if not enabled():
+        return
+    try:
+        ledger().compile_event(entry=entry, program=program, plan=plan,
+                               feed_arrays=feed_arrays,
+                               fetch_names=fetch_names,
+                               mem_report=mem_report, pre=pre)
+    except Exception:
+        pass
+
+
+def observe_step(program_fp: str, step_ms: float) -> None:
+    """Feed one measured steady-state step time into the program's open
+    window (guarded; the caller already paid the device sync for
+    executor.step_time_ms — this adds a list append)."""
+    if not bool(_flags.get_flag("ledger")):
+        return
+    try:
+        ledger().step_observed(program_fp, step_ms)
+    except Exception:
+        pass

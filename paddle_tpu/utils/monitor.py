@@ -465,18 +465,29 @@ _UNESCAPE_RE = re.compile(r'\\(.)')
 
 
 def _unescape_label(value: str) -> str:
+    # Only \n, \" and \\ are escapes in the exposition format; any other
+    # backslash pair passes through verbatim (m.group(0), backslash kept) so
+    # a literal like "C:\temp" written by a non-escaping producer survives a
+    # parse -> re-expose round trip instead of silently losing backslashes.
     return _UNESCAPE_RE.sub(
         lambda m: {"n": "\n", '"': '"', "\\": "\\"}.get(m.group(1),
-                                                        m.group(1)), value)
+                                                        m.group(0)), value)
 
 
 def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
     """Parse Prometheus text exposition back to {(name, labelitems): value}
     — the inverse of `to_prometheus_text` over `prom_samples` (used by the
-    round-trip tests and metricsdump consumers)."""
+    round-trip tests, metricsdump consumers, and fleetview's rank scraper).
+
+    Records are split on "\n" ONLY — the exposition format's line
+    terminator.  Label values may legally carry a raw \r, \v, \f or
+    U+2028-style separator (only backslash, double-quote and newline are
+    escaped on the wire), and str.splitlines() splits on all of those, so
+    it would tear such a sample apart mid-value (regression-pinned with
+    hostile label values in tests/test_metrics.py)."""
     out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
-    for line in text.splitlines():
-        line = line.strip()
+    for line in text.split("\n"):
+        line = line.strip(" \t\r")
         if not line or line.startswith("#"):
             continue
         m = _PROM_LINE_RE.match(line)

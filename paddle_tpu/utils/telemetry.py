@@ -21,7 +21,12 @@ run (``telemetry_port`` flag; ``launch --telemetry_port BASE`` assigns
 * ``/xprof``    — the last published ``Executor.xprof_report()`` snapshot
   (the Executor publishes automatically via :func:`publish_snapshot`)
 * ``/spans``    — recent span begin/end events from the flight ring
-  (``?n=200`` bounds the reply; ``?since=SEQ`` reads incrementally)
+  (``?n=200`` bounds the reply; ``?since=SEQ`` reads incrementally, with
+  an explicit ``truncated: true`` when the cursor fell behind the ring)
+* ``/ledger``   — calibration-ledger records (utils/ledger.py): the
+  measured-vs-predicted drift stream per compiled program, same
+  ``?since=``/``truncated`` cursor contract as ``/spans``, plus the
+  per-model calibration bands
 
 Server threads are daemons (``ThreadingHTTPServer.daemon_threads``) and the
 accept loop runs on a daemon thread, so a scraped process — including a
@@ -166,6 +171,7 @@ class TelemetryServer:
             "/flight": self._flight,
             "/xprof": self._xprof,
             "/spans": self._spans,
+            "/ledger": self._ledger,
         }
 
     def _index(self, query) -> tuple:
@@ -230,11 +236,34 @@ class TelemetryServer:
             return (400, "application/json",
                     json.dumps({"error": "n/since must be integers"}))
         fr = _trace.flight_recorder()
-        events = [e for e in fr.events_since(since)
+        events, truncated = fr.read_since(since)
+        events = [e for e in events
                   if e.get("kind") in ("span_begin", "span_end")]
         return 200, "application/json", json.dumps({
             "last_seq": fr.last_seq,
+            # the ring already evicted events past the cursor: the poller
+            # fell behind the bounded window (distinct from the ?n= trim,
+            # which only bounds this reply)
+            "truncated": truncated,
             "spans": events[-max(0, n):],
+        }, default=repr)
+
+    def _ledger(self, query) -> tuple:
+        try:
+            n = int(query.get("n", ["200"])[0])
+            since = int(query.get("since", ["0"])[0])
+        except ValueError:
+            return (400, "application/json",
+                    json.dumps({"error": "n/since must be integers"}))
+        from . import ledger as _ledger_mod
+
+        led = _ledger_mod.ledger()
+        records, truncated = led.read_since(since)
+        return 200, "application/json", json.dumps({
+            "last_seq": led.last_seq,
+            "truncated": truncated,
+            "bands": _ledger_mod.BANDS,
+            "records": records[-max(0, n):],
         }, default=repr)
 
     # -- lifecycle -----------------------------------------------------------

@@ -41,7 +41,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import flags as _flags
 from . import profiler as _profiler
@@ -275,6 +275,26 @@ class FlightRecorder:
         telemetry ``/spans`` endpoint poll with (events evicted between
         polls are simply gone; the ring is a window, not a log)."""
         return [e for e in self._events if e.get("seq", 0) > seq]
+
+    def read_since(self, seq: int) -> Tuple[List[Dict[str, Any]], bool]:
+        """:meth:`events_since` plus an explicit truncation verdict: True
+        when the ring has already evicted (or :meth:`clear`-ed) events the
+        ``seq`` cursor was entitled to, so pollers of ``/spans`` and
+        ``/ledger`` can tell "nothing happened" apart from "you fell
+        behind the window" instead of silently losing events."""
+        events = list(self._events)
+        if self._seq <= seq:
+            truncated = False          # cursor is current (or from the
+            #                            future after a restart) — nothing
+            #                            was missed
+        elif not events:
+            truncated = True           # events were recorded past the
+            #                            cursor but none survive (cleared
+            #                            ring, or size-0 window)
+        else:
+            oldest = min(e.get("seq", 0) for e in events)
+            truncated = oldest > seq + 1
+        return [e for e in events if e.get("seq", 0) > seq], truncated
 
     def record(self, kind: str, name: str = "",
                ctx: Optional[SpanContext] = None, **fields: Any) -> None:

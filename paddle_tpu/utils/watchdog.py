@@ -114,7 +114,8 @@ class Watchdog:
         self._time_ms: Dict[str, float] = {
             "productive": 0.0, "compile": 0.0, "restore": 0.0,
             "checkpoint": 0.0, "idle": 0.0}
-        self._counts = {"step_time": 0, "nan_loss": 0, "loss_spike": 0}
+        self._counts = {"step_time": 0, "nan_loss": 0, "loss_spike": 0,
+                        "ledger_drift": 0}
         self._flushed: Dict[str, float] = {}  # time_ms already exported
         self._ckpts_taken = 0
         self._steps = 0
@@ -214,6 +215,19 @@ class Watchdog:
                 cat = self._EVENT_CATEGORIES.get(e.get("kind", ""))
             if cat is not None:
                 self._time_ms[cat] += float(e.get("dur_ms", 0.0) or 0.0)
+            if e.get("kind") == "ledger_drift":
+                # a cost model left its calibration band (utils/ledger.py):
+                # counted as an anomaly so /healthz and watchdog.anomalies
+                # surface estimator drift, but advisory — never unhealthy
+                self._counts["ledger_drift"] += 1
+                _m_anomalies.inc(kind="ledger_drift")
+                self._last_anomaly = {
+                    "kind": "ledger_drift",
+                    "model": e.get("model", ""),
+                    "drift": e.get("drift"),
+                    "band": e.get("band"),
+                    "program": e.get("program", ""),
+                }
 
     def _publish_locked(self) -> None:
         wall_ms = max((time.time() - self._t_start) * 1000.0, 1e-9)

@@ -646,6 +646,17 @@ def profile_aot(aot, measured_ms: Optional[float] = None,
                         measured_ms=measured_ms, peaks=peaks, top=top)
 
 
+def roofline_totals(aot) -> Optional[Dict[str, Any]]:
+    """The roofline ``totals`` block straight off an AOT executable — the
+    modeled-ms leg the calibration ledger (utils/ledger.py) joins against
+    measured step time.  None when the backend yields no profile (e.g. a
+    deserialized persistent-cache artifact without cost analysis)."""
+    try:
+        return profile_aot(aot)["totals"]
+    except Exception:
+        return None
+
+
 def profile_jit(fn, *example, measured_ms: Optional[float] = None,
                 peaks: Optional[PeakSpec] = None,
                 top: Optional[int] = None) -> Dict[str, Any]:

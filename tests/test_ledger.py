@@ -1,0 +1,391 @@
+"""Calibration ledger (utils/ledger.py): measured-vs-predicted drift.
+
+The acceptance contract: on the existing calibration fixtures run
+end-to-end through the Executor, the ledger's own records — not test-side
+arithmetic — show ``drift_ratio{mem} <= 1.5``, and on a real traced
+collective run ``drift_ratio{comm} <= 2.0`` (the same two-sided envelopes
+test_memcheck / test_shardcheck pin for the estimators themselves).  Also
+covered: the steady-state window records (median step ms joined against
+the compile event's predictions), zero steady-state retraces and warm
+persistent-cache starts under the ``ledger`` flag, the bounded ring's
+``read_since`` truncation verdict, the atomic JSONL sink, and the
+band-exit -> ``ledger_drift`` flight anomaly -> watchdog accounting loop.
+"""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu.static as static
+import paddle_tpu.static.shardcheck as sc
+from paddle_tpu.core import flags
+from paddle_tpu.parallel import compress
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.sharding import ShardingPlan
+from paddle_tpu.static import layers as L
+from paddle_tpu.utils import ledger, monitor, trace, watchdog
+
+try:
+    from jax.experimental.shard_map import shard_map as _smap
+except ImportError:  # newer jax moved it
+    from jax.sharding import shard_map as _smap
+from jax.sharding import PartitionSpec as P
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs the virtual CPU mesh")
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    from paddle_tpu.static import framework as _fw
+    _fw._unique.counters = {}
+    main, startup = static.Program(), static.Program()
+    scope = static.Scope()
+    with static.program_guard(main, startup), static.scope_guard(scope):
+        yield main, startup
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """Each test gets its own singleton ring (and sink-path resolution)."""
+    ledger.reset()
+    yield
+    ledger.reset()
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = flags.get_flags(["metrics", "ledger", "ledger_window",
+                             "ledger_dir", "check_memory", "check_sharding",
+                             "compile_cache_dir"])
+    yield
+    flags.set_flags(saved)
+
+
+def _mesh(n=2, axes=("dp",)):
+    devs = np.asarray(jax.devices()[:n])
+    if len(axes) == 2:
+        devs = devs.reshape(n // 2, 2)
+    return Mesh(devs, axes)
+
+
+def _fc_tower():
+    x = L.data("x", [32])
+    y = L.data("y", [1])
+    h = L.fc(x, 64, act="relu")
+    h = L.fc(h, 64, act="relu")
+    pred = L.fc(h, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+FEED_FC = {"x": np.zeros((16, 32), np.float32),
+           "y": np.zeros((16, 1), np.float32)}
+
+
+def _run_tower(startup, main, loss, steps=1, exe=None):
+    """startup with metrics off (no startup-program ledger record), then
+    `steps` main runs with metrics on — the memcheck calibration recipe."""
+    if exe is None:
+        exe = static.Executor()
+        flags.set_flags({"metrics": False})
+        exe.run(startup)
+    flags.set_flags({"metrics": True})
+    for _ in range(steps):
+        exe.run(main, feed=FEED_FC, fetch_list=[loss])
+    return exe
+
+
+# ---------------------------------------------------------------------------
+# drift arithmetic
+# ---------------------------------------------------------------------------
+
+def test_drift_ratio_symmetric_and_partial():
+    assert ledger.drift_ratio(100.0, 50.0) == 2.0
+    assert ledger.drift_ratio(50.0, 100.0) == 2.0    # two-sided, same band
+    assert ledger.drift_ratio(7.0, 7.0) == 1.0
+    # a missing or non-positive leg is honestly unpriced, never a crash
+    assert ledger.drift_ratio(None, 5.0) is None
+    assert ledger.drift_ratio(5.0, None) is None
+    assert ledger.drift_ratio(0.0, 5.0) is None
+    assert ledger.drift_ratio("zebra", 5.0) is None
+
+
+def test_bands_pin_the_calibration_envelopes():
+    # the bands ARE the estimator acceptance gates; roofline stays
+    # unbanded until TPU-measured tables exist (its peak numbers model
+    # TPU hardware, so CPU CI drifts by design)
+    assert ledger.BANDS == {"comm": 2.0, "mem": 1.5, "roofline": None}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: drift from ledger records of REAL runs (no test-side math)
+# ---------------------------------------------------------------------------
+
+def test_executor_compile_record_mem_drift_within_band(_fresh, _flags_guard):
+    """One real Executor compile of the memcheck fc fixture: the ledger's
+    own compile record joins estimate_peak against memory_analysis() and
+    its mem drift sits inside the 1.5x calibration band."""
+    main, startup = _fresh
+    loss = _fc_tower()
+    flags.set_flags({"ledger": True})
+    _run_tower(startup, main, loss)
+
+    led = ledger.ledger()
+    recs = [r for r in led.records() if r["kind"] == "compile"]
+    assert len(recs) == 1, recs
+    rec = recs[0]
+    assert rec["key"]["program"]
+    assert rec["predicted"]["peak_hbm_bytes"] > 0
+    assert rec["measured"]["mem_total_bytes"] > 0
+    ratio = rec["drift"]["mem"]
+    assert ratio is not None and 1.0 <= ratio <= ledger.BANDS["mem"], rec
+    assert "mem" not in rec["band_violations"]
+    # the drift gauge carries the same ledger-computed number
+    g = monitor.gauge("ledger.drift_ratio", labelnames=("model",))
+    assert g.value(model="mem") == pytest.approx(ratio)
+    # single-device fc: no plan, no traced comm -> honestly unpriced
+    assert rec["measured"]["allreduce_bytes"] is None
+    assert rec["drift"]["comm"] is None
+
+
+@needs_devices
+def test_comm_drift_within_band_from_real_traced_run(_fresh, _flags_guard):
+    """The shardcheck calibration fixture, joined by the ledger: predicted
+    wire bytes from estimate_comm, measured bytes from the trace-time
+    comm.allreduce_bytes delta the ledger snapshots around the trace
+    (pre_compile + measured_comm_bytes — the Executor hook's own
+    machinery), drift computed by Ledger.append.  The Executor's sharded
+    build is pure GSPMD (XLA inserts the collectives), so its traces never
+    pass through compress — the calibrated path is the bucketer itself."""
+    flags.set_flags({"metrics": True, "ledger": True})
+    main, _ = _fresh
+    _fc_tower()
+    plan = ShardingPlan(mesh=_mesh(8), comm_quantize="int8",
+                        comm_hierarchy=None)
+    est = sc.estimate_comm(main, plan)
+    assert est.allreduce_bytes > 0
+
+    pre = ledger.pre_compile()            # the Executor miss-branch snapshot
+    assert pre is not None and "comm_bytes" in pre
+
+    shapes = [tuple(p.shape) for p in main.all_parameters() if p.trainable]
+    arrs = [np.ones(s, np.float32) for s in shapes]
+    m = _mesh(8)
+
+    def f(*gs):
+        return tuple(compress.bucketed_all_reduce(
+            list(gs), "dp", compress="int8", hierarchy=None))
+
+    specs = (P(),) * len(arrs)
+    try:
+        smap = _smap(f, mesh=m, in_specs=specs, out_specs=specs,
+                     check_rep=False)
+    except TypeError:  # newer jax renamed the replication-check kwarg
+        smap = _smap(f, mesh=m, in_specs=specs, out_specs=specs,
+                     check_vma=False)
+    with m:
+        jax.block_until_ready(smap(*arrs))
+
+    delta = sc.measured_comm_bytes() - pre["comm_bytes"]
+    assert delta > 0
+    led = ledger.ledger()
+    rec = led.append(
+        "compile",
+        {"program": "comm-calibration", "plan": plan.fingerprint(),
+         "mesh": None},
+        {"comm_bytes": float(est.allreduce_bytes)},
+        {"allreduce_bytes": float(delta)})
+    ratio = rec["drift"]["comm"]
+    assert ratio is not None and 1.0 <= ratio <= ledger.BANDS["comm"], rec
+    assert "comm" not in rec["band_violations"]
+    g = monitor.gauge("ledger.drift_ratio", labelnames=("model",))
+    assert g.value(model="comm") == pytest.approx(ratio)
+
+
+@needs_devices
+def test_sharded_executor_record_carries_plan_and_mesh_key(_fresh,
+                                                          _flags_guard):
+    """A dp-sharded Executor compile keys its record by program x plan x
+    mesh fingerprints; the GSPMD trace moves no compress-side bytes, so
+    the comm leg stays None instead of recording a fake zero."""
+    main, startup = _fresh
+    loss = _fc_tower()
+    flags.set_flags({"ledger": True, "check_sharding": True})
+    exe = static.Executor()
+    flags.set_flags({"metrics": False})
+    exe.run(startup)
+    flags.set_flags({"metrics": True})
+    # donate=False: the memcheck sharded calibration fixtures hold donation
+    # equal on both sides (test_memcheck §calibration), and so must the
+    # ledger's join of the same two quantities
+    compiled = static.CompiledProgram(main).with_sharding(mesh=_mesh(2),
+                                                          donate=False)
+    exe.run(compiled, feed=FEED_FC, fetch_list=[loss])
+
+    recs = [r for r in ledger.ledger().records() if r["kind"] == "compile"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["key"]["plan"] and "dp" in rec["key"]["mesh"]
+    assert rec["predicted"]["comm_bytes"] is not None   # plan -> priced
+    assert rec["measured"]["allreduce_bytes"] is None   # GSPMD -> unmeasured
+    assert rec["drift"]["comm"] is None
+    ratio = rec["drift"]["mem"]
+    assert ratio is not None and ratio <= ledger.BANDS["mem"], rec
+
+
+# ---------------------------------------------------------------------------
+# steady-state windows, zero retraces, warm persistent-cache starts
+# ---------------------------------------------------------------------------
+
+def test_window_records_join_median_step_time(_fresh, _flags_guard):
+    main, startup = _fresh
+    loss = _fc_tower()
+    flags.set_flags({"ledger": True, "ledger_window": 4})
+    traces = monitor.counter("executor.traces")
+    exe = _run_tower(startup, main, loss)          # the one compile
+    t0 = traces.value()
+    _run_tower(startup, main, loss, steps=8, exe=exe)
+    assert traces.value() == t0                    # zero steady-state retraces
+
+    led = ledger.ledger()
+    compiles = [r for r in led.records() if r["kind"] == "compile"]
+    windows = [r for r in led.records() if r["kind"] == "window"]
+    assert len(compiles) == 1
+    assert len(windows) == 2                       # 8 steady steps / window 4
+    for w in windows:
+        assert w["window_steps"] == 4
+        assert w["key"] == compiles[0]["key"]      # re-joined to the compile
+        med = w["measured"]["step_time_ms"]
+        assert w["window_min_ms"] <= med <= w["window_max_ms"]
+        # the compile event's predictions ride along into the window join
+        assert w["predicted"]["peak_hbm_bytes"] == \
+            compiles[0]["predicted"]["peak_hbm_bytes"]
+    # records and their seqs are strictly ordered
+    seqs = [r["seq"] for r in led.records()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_warm_compile_cache_start_preserved_under_ledger(_fresh, tmp_path,
+                                                         _flags_guard):
+    """A warm persistent-cache start deserializes without tracing; the
+    ledger must neither force a trace nor invent a comm measurement."""
+    main, startup = _fresh
+    loss = _fc_tower()
+    flags.set_flags({"ledger": True, "compile_cache_dir": str(tmp_path)})
+    exe = _run_tower(startup, main, loss)
+    assert sorted(tmp_path.glob("*.pdtc")), "cold run stored no executables"
+
+    traces = monitor.counter("executor.traces")
+    t0 = traces.value()
+    warm = static.Executor()                       # fresh hot map, same scope
+    warm.run(main, feed=FEED_FC, fetch_list=[loss])
+    assert traces.value() == t0                    # deserialized, not retraced
+
+    recs = [r for r in ledger.ledger().records() if r["kind"] == "compile"]
+    assert len(recs) == 2
+    cold, hot = recs
+    assert cold["disk_cache"] == "miss" and hot["disk_cache"] == "hit"
+    assert cold["key"] == hot["key"]
+    # no trace ran, so the trace-time comm delta is zero -> unmeasured
+    assert hot["measured"]["allreduce_bytes"] is None
+
+
+def test_disabled_ledger_records_nothing(_fresh, _flags_guard):
+    main, startup = _fresh
+    loss = _fc_tower()
+    flags.set_flags({"ledger": False})
+    _run_tower(startup, main, loss, steps=3)
+    assert ledger.ledger().records() == []
+    assert not ledger.enabled()
+    assert ledger.pre_compile() is None
+    # metrics off also disables (no measured leg to join)
+    flags.set_flags({"ledger": True, "metrics": False})
+    assert not ledger.enabled()
+
+
+# ---------------------------------------------------------------------------
+# ring cursor + JSONL sink + band-exit anomaly loop
+# ---------------------------------------------------------------------------
+
+def test_read_since_truncation_verdict():
+    led = ledger.Ledger(capacity=4)
+    assert led.read_since(0) == ([], False)        # fresh: nothing missed
+    for i in range(10):
+        led.append("compile", {"program": f"p{i}"}, {}, {})
+    recs, truncated = led.read_since(0)
+    assert truncated                               # seqs 1..6 evicted
+    assert [r["seq"] for r in recs] == [7, 8, 9, 10]
+    recs, truncated = led.read_since(6)            # cursor exactly at edge
+    assert not truncated and [r["seq"] for r in recs] == [7, 8, 9, 10]
+    assert led.read_since(led.last_seq) == ([], False)
+    recs, truncated = led.read_since(2)
+    assert truncated                               # fell behind the window
+
+
+def test_jsonl_sink_appends_atomic_lines(tmp_path, _flags_guard):
+    flags.set_flags({"ledger_dir": str(tmp_path)})
+    ledger.reset()                                 # re-resolve the sink path
+    led = ledger.ledger()
+    for i in range(3):
+        led.append("compile", {"program": f"p{i}"},
+                   {"peak_hbm_bytes": 100.0}, {"mem_total_bytes": 90.0})
+    path = tmp_path / f"ledger.rank{trace._rank()}.jsonl"
+    assert path.exists()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    docs = [json.loads(l) for l in lines]          # every line full JSON
+    assert [d["seq"] for d in docs] == [1, 2, 3]
+    assert docs[0]["drift"]["mem"] == pytest.approx(100.0 / 90.0)
+    # env-var resolution (the launch --ledger_dir contract)
+    flags.set_flags({"ledger_dir": ""})
+    os.environ[ledger.LEDGER_DIR_ENV] = str(tmp_path)
+    try:
+        ledger.reset()
+        ledger.ledger().append("window", {"program": "env"}, {}, {})
+    finally:
+        os.environ.pop(ledger.LEDGER_DIR_ENV, None)
+    assert len(path.read_text().splitlines()) == 4
+
+
+def test_band_exit_flight_anomaly_reaches_watchdog(_flags_guard):
+    flags.set_flags({"metrics": True, "ledger": True})
+    wd = watchdog.Watchdog(min_samples=3)          # cursor before the exit
+    alarms = monitor.counter("ledger.drift_alarms", labelnames=("model",))
+    a0 = alarms.value(model="comm")
+    seq0 = trace.flight_recorder().last_seq
+
+    rec = ledger.ledger().append(
+        "compile", {"program": "drifty"},
+        {"comm_bytes": 1000.0}, {"allreduce_bytes": 100.0})  # 10x >> 2x band
+    assert rec["band_violations"] == ["comm"]
+    assert alarms.value(model="comm") == a0 + 1
+    events = [e for e in trace.flight_recorder().events_since(seq0)
+              if e["kind"] == "ledger_drift"]
+    assert len(events) == 1
+    assert events[0]["model"] == "comm" and events[0]["band"] == 2.0
+    assert events[0]["drift"] == pytest.approx(10.0)
+
+    wd.observe_step(1, 10.0)                       # drain the flight ring
+    doc = wd.report()
+    assert doc["anomalies"]["ledger_drift"] == 1
+    assert doc["last_anomaly"]["kind"] == "ledger_drift"
+    assert doc["last_anomaly"]["program"] == "drifty"
+    assert doc["healthy"]                          # advisory, never unhealthy
+
+    # inside-band appends raise no alarm
+    rec = ledger.ledger().append(
+        "compile", {"program": "calibrated"},
+        {"comm_bytes": 100.0}, {"allreduce_bytes": 90.0})
+    assert rec["band_violations"] == []
+    assert alarms.value(model="comm") == a0 + 1

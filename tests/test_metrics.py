@@ -191,6 +191,49 @@ def test_prometheus_text_round_trip():
         assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), name
 
 
+HOSTILE_LABELS = [
+    'back\\slash', 'double\\\\slash', 'trailing\\', 'quo"te', '\\"both\\"',
+    'new\nline', 'cr\rmid', 'vt\x0bmid', 'ff\x0cmid', 'nel\x85mid',
+    'ls\u2028mid', 'ps\u2029mid',            # str.splitlines() tears these
+    'brace} space 1.0', 'a="b",c="d"', 'C:\\temp\\x', ' lead-and-trail ',
+]
+
+
+def test_prometheus_round_trip_hostile_label_values():
+    """Lossless exposition round-trip for every label value an operator
+    (or an adversary) can produce: exotic line separators that splitlines()
+    would split on, unescaped backslashes, quotes, braces and whitespace.
+    Regression for the parse_prometheus_text line-splitting/unescape fix."""
+    r = monitor.MetricRegistry()
+    c = r.counter("t.hostile", "hostile label values", labelnames=("v",))
+    for i, v in enumerate(HOSTILE_LABELS):
+        c.inc(i + 1, v=v)
+    parsed = monitor.parse_prometheus_text(r.to_prometheus_text())
+    for i, v in enumerate(HOSTILE_LABELS):
+        assert parsed[("t_hostile", (("v", v),))] == float(i + 1), repr(v)
+    assert len(parsed) == len(HOSTILE_LABELS)    # no sample torn in two
+    # a second expose->parse generation stays fixed (true losslessness)
+    r2 = monitor.MetricRegistry()
+    c2 = r2.counter("t.hostile", "gen 2", labelnames=("v",))
+    for (name, labels), value in parsed.items():
+        c2.inc(value, v=dict(labels)["v"])
+    assert monitor.parse_prometheus_text(r2.to_prometheus_text()) == parsed
+
+
+def test_parse_keeps_unknown_escapes_verbatim():
+    """Only \\n, \\" and \\\\ are escapes in the exposition format; a
+    non-escaping producer's literal like C:\\temp must survive the parse
+    instead of silently dropping its backslash."""
+    text = 'ext_path{dir="C:\\temp\\x"} 1.0\n'
+    parsed = monitor.parse_prometheus_text(text)
+    assert parsed == {("ext_path", (("dir", "C:\\temp\\x"),)): 1.0}
+    # CRLF exposition (allowed by the wire format) parses cleanly too
+    crlf = 'ext_a 1.0\r\next_b{k="v"} 2.0\r\n'
+    parsed = monitor.parse_prometheus_text(crlf)
+    assert parsed[("ext_a", ())] == 1.0
+    assert parsed[("ext_b", (("k", "v"),))] == 2.0
+
+
 def test_json_export_round_trips_and_matches():
     r = _populated_registry()
     doc = r.to_json()

@@ -1,6 +1,7 @@
 """Live telemetry plane (utils/telemetry.py): HTTP exposition of metrics /
-health / flight ring / xprof / spans, per-rank servers under `launch
---telemetry_port`, and the tools/benchdiff regression gate.
+health / flight ring / xprof / spans / calibration ledger, per-rank
+servers under `launch --telemetry_port`, and the tools/benchdiff
+regression gate.
 
 The server smoke here is the tier-1 CI gate the ISSUE requires: start,
 scrape /metrics + /healthz, round-trip the exposition through
@@ -106,6 +107,55 @@ def test_flight_and_spans_endpoints(_server):
     assert doc["last_seq"] >= seq0 + 3
     status, doc = _get(_server.port, "/spans?n=zebra")
     assert status == 400
+
+
+def test_spans_truncated_when_cursor_falls_behind_ring(_server):
+    """A poller whose ?since= cursor was overwritten past the bounded ring
+    gets an explicit truncated:true, never a silent gap."""
+    fr = trace.flight_recorder()
+    seq0 = fr.last_seq
+    status, doc = _get(_server.port, f"/spans?since={seq0}")
+    assert status == 200 and doc["truncated"] is False   # nothing missed yet
+    size = int(flags.get_flag("flight_recorder_size"))
+    for i in range(size + 32):                           # wrap the ring
+        fr.record("t_spin", name=f"e{i}")
+    status, doc = _get(_server.port, f"/spans?since={seq0}")
+    assert status == 200 and doc["truncated"] is True
+    # a cursor at the live head is whole again
+    status, doc = _get(_server.port, f"/spans?since={fr.last_seq}")
+    assert status == 200
+    assert doc["truncated"] is False and doc["spans"] == []
+
+
+def test_ledger_endpoint_cursor_and_truncation(_server):
+    from paddle_tpu.utils import ledger
+
+    ledger.reset()
+    try:
+        led = ledger.ledger()
+        led.append("compile", {"program": "t_led"},
+                   {"peak_hbm_bytes": 130.0}, {"mem_total_bytes": 100.0})
+        status, doc = _get(_server.port, "/ledger")
+        assert status == 200
+        assert doc["truncated"] is False and doc["last_seq"] == 1
+        assert doc["bands"]["mem"] == 1.5                # bands ride along
+        (rec,) = doc["records"]
+        assert rec["kind"] == "compile"
+        assert rec["drift"]["mem"] == pytest.approx(1.3)
+        # incremental poll from the head: empty, not truncated
+        status, doc = _get(_server.port, f"/ledger?since={led.last_seq}")
+        assert status == 200
+        assert doc["records"] == [] and doc["truncated"] is False
+        # wrap the 256-record ring: the stale cursor is told explicitly
+        for i in range(300):
+            led.append("window", {"program": f"w{i}"}, {}, {})
+        status, doc = _get(_server.port, "/ledger?since=1")
+        assert status == 200 and doc["truncated"] is True
+        assert len(doc["records"]) <= 256
+        status, doc = _get(_server.port, "/ledger?since=zebra")
+        assert status == 400
+    finally:
+        ledger.reset()
 
 
 def test_xprof_endpoint_404_then_published(_server):

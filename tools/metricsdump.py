@@ -80,9 +80,17 @@ _KNOWN_NAMES = frozenset({
     "executor.state_size_bytes",
     "executor.step_time_ms",
     "executor.traces",
+    # tools/fleetview.py (the job-level aggregator's own instruments)
+    "fleet.ranks",
+    "fleet.scrape_errors",
+    "fleet.scrapes",
     # io/prefetch.py
     "io.prefetch_batches",
     "io.prefetch_depth",
+    # utils/ledger.py (measured-vs-predicted calibration)
+    "ledger.drift_alarms",
+    "ledger.drift_ratio",
+    "ledger.records",
     # ops/pallas/config.py (kernel dispatch telemetry)
     "pallas.fallbacks",
     "pallas.kernel_calls",
@@ -184,9 +192,11 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.ops.pallas.config  # noqa: F401 — the pallas.* family
     import paddle_tpu.static.passes  # noqa: F401 — passes.* + quant.*
     import paddle_tpu.utils.debug  # noqa: F401
+    import paddle_tpu.utils.ledger  # noqa: F401 — the ledger.* family
     import paddle_tpu.utils.telemetry  # noqa: F401 — the telemetry.* family
     import paddle_tpu.utils.watchdog  # noqa: F401 — watchdog.* + goodput
     import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
+    import tools.fleetview  # noqa: F401 — the fleet.* family
     from paddle_tpu.hapi.callbacks import MetricsLogger
 
     MetricsLogger()  # registers the train.* family
