@@ -274,3 +274,30 @@ define_flag("ledger_dir", "", "Directory for per-rank calibration-ledger "
             "interleave mid-line).  Empty (default): in-memory ring only.  "
             "`launch --ledger_dir DIR` exports PDTPU_LEDGER_DIR per "
             "worker, the same pattern as the telemetry/elastic dirs.")
+define_flag("slo", True, "SLO engine (utils/slo.py): a background sampler "
+            "snapshots registry metrics into the history ring every "
+            "slo_sample_secs and evaluates declarative SLO objectives with "
+            "multi-window burn-rate alerting (Google-SRE fast/slow window "
+            "pairs).  Firing page-severity alerts flip /healthz to 503; all "
+            "alerts are served at /alerts and the retained samples at "
+            "/history.  Observation-only: reads metrics, never touches the "
+            "compile or dispatch path.  The engine only starts when the "
+            "telemetry plane starts (telemetry_port / PDTPU_TELEMETRY_PORT) "
+            "or via paddle_tpu.utils.slo.start().")
+define_flag("slo_sample_secs", 5.0, "Self-sample interval (seconds) of the "
+            "SLO engine's metrics-history sampler, and its alert-evaluation "
+            "cadence.  Each tick snapshots counters as rates, gauges as "
+            "values and histograms as inter-tick p50/p99 into bounded "
+            "per-series rings (utils/monitor.py MetricsHistory).")
+define_flag("slo_objectives", "", "Path to a TOML or JSON SLO-objective "
+            "file loaded when the SLO engine starts (see utils/slo.py "
+            "load_objectives; `python -m tools.slocheck FILE` validates one "
+            "against the metric inventory).  Empty (default): the built-in "
+            "default objectives (serve.ttft_p99_ms, serve.load_shed rate, "
+            "train.goodput_pct, ledger.drift_ratio).")
+define_flag("history_dir", "", "Directory for per-rank metrics-history "
+            "JSONL mirrors (history.rank<N>.jsonl): each SLO-engine sample "
+            "tick appends one line with the tick's {series: value} snapshot "
+            "via a single O_APPEND write.  Empty (default): in-memory ring "
+            "only.  `launch --history_dir DIR` exports PDTPU_HISTORY_DIR "
+            "per worker, the same pattern as the ledger dir.")

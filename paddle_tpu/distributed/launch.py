@@ -47,7 +47,8 @@ def launch(training_script: str, script_args: List[str],
            trace_dir: Optional[str] = None, max_restarts: int = 0,
            elastic_dir: Optional[str] = None,
            telemetry_port: Optional[int] = None,
-           ledger_dir: Optional[str] = None) -> int:
+           ledger_dir: Optional[str] = None,
+           history_dir: Optional[str] = None) -> int:
     """Spawn `nproc` worker processes with the trainer-env contract.
     Returns the first nonzero exit code, or 0.
 
@@ -79,7 +80,12 @@ def launch(training_script: str, script_args: List[str],
     every rank appends its measured-vs-predicted records to
     ``ledger.rank<r>.jsonl`` in one shared directory (utils/ledger.py) —
     the durable twin of the ``/ledger`` endpoint ``tools/fleetview``
-    scrapes live."""
+    scrapes live.
+
+    Metrics history: ``history_dir`` is exported as PDTPU_HISTORY_DIR so
+    every rank's SLO-engine sampler mirrors its history ticks to
+    ``history.rank<r>.jsonl`` (utils/slo.py) — the durable twin of the
+    ``/history`` endpoint."""
     base_port = started_port or _free_port()
     endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nproc))
     job_trace_id = uuid.uuid4().hex
@@ -91,6 +97,8 @@ def launch(training_script: str, script_args: List[str],
         os.makedirs(elastic_dir, exist_ok=True)
     if ledger_dir:
         os.makedirs(ledger_dir, exist_ok=True)
+    if history_dir:
+        os.makedirs(history_dir, exist_ok=True)
     procs: List[subprocess.Popen] = []
     logs = []
     exit_code = 0
@@ -115,6 +123,8 @@ def launch(training_script: str, script_args: List[str],
             env["PDTPU_TELEMETRY_PORT"] = str(int(telemetry_port) + rank)
         if ledger_dir:
             env["PDTPU_LEDGER_DIR"] = ledger_dir
+        if history_dir:
+            env["PDTPU_HISTORY_DIR"] = history_dir
         for kv in backend_env.split(","):
             if "=" in kv:
                 k, v = kv.split("=", 1)
@@ -218,19 +228,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--telemetry_port", type=int, default=None,
                         help="base port for the per-rank HTTP telemetry "
                         "plane: rank r serves /metrics, /healthz, /flight, "
-                        "/xprof, /spans, /ledger on telemetry_port + r "
-                        "(utils/telemetry.py)")
+                        "/xprof, /spans, /ledger, /history, /alerts on "
+                        "telemetry_port + r (utils/telemetry.py)")
     parser.add_argument("--ledger_dir", type=str, default=None,
                         help="shared directory for per-rank calibration "
                         "ledger JSONL sinks, exported to workers as "
                         "PDTPU_LEDGER_DIR (utils/ledger.py)")
+    parser.add_argument("--history_dir", type=str, default=None,
+                        help="shared directory for per-rank metrics-history "
+                        "JSONL mirrors, exported to workers as "
+                        "PDTPU_HISTORY_DIR (utils/slo.py)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.training_script, args.script_args, args.nproc,
                   args.started_port, args.log_dir, args.backend_env,
                   args.trace_dir, args.max_restarts, args.elastic_dir,
-                  args.telemetry_port, args.ledger_dir)
+                  args.telemetry_port, args.ledger_dir,
+                  args.history_dir)
 
 
 if __name__ == "__main__":

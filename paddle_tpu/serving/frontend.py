@@ -107,6 +107,9 @@ class Server:
         self.max_wait_ms = float(max_wait_ms)
         self.tenants = TenantManager(max_live_programs=max_live_programs)
         self.slo = slo if slo is not None else SLOPolicy()
+        # the serve.projected_p99_ms{tenant} gauge samples the same queue
+        # view admit() decides on
+        self.slo.bind_queue(lambda: self._queued_rows, self.max_batch)
         self.device = device
         self._queue: "deque[_Request]" = deque()
         self._queued_rows = 0

@@ -51,8 +51,8 @@ from ..utils import monitor as _monitor
 __all__ = ["AdmissionError", "QuotaExceededError", "SLOPolicy",
            "QUEUE_DEPTH", "BATCH_SIZE", "BATCH_OCCUPANCY", "TTFT_MS",
            "TTFT_QUEUE_MS", "TTFT_BATCH_MS", "TTFT_COMPILE_MS",
-           "TTFT_EXECUTE_MS", "TTFT_P50", "TTFT_P99", "REQUEST_MS",
-           "REQUESTS", "LOAD_SHED"]
+           "TTFT_EXECUTE_MS", "TTFT_P50", "TTFT_P99", "PROJECTED_P99",
+           "REQUEST_MS", "REQUESTS", "LOAD_SHED"]
 
 
 class AdmissionError(ResourceExhaustedError):
@@ -122,6 +122,13 @@ TTFT_P99 = _monitor.gauge(
     "serve.ttft_p99_ms", "p99 serve.ttft_ms, interpolated from the "
     "histogram at collect time (nan until a request has dispatched).")
 TTFT_P99.set_function(lambda: TTFT_MS.percentile(99))
+PROJECTED_P99 = _monitor.gauge(
+    "serve.projected_p99_ms", "Per-tenant projected request p99 (ms) at "
+    "collect time: the SAME SLOPolicy.projected_p99 number admission "
+    "decides on — observed worst-bucket p99 scaled by the live queue "
+    "backlog (nan until a tenant has min_samples mature observations).  "
+    "Alert rules and the future router scrape what the shedder enforces.",
+    labelnames=("tenant",))
 
 
 class SLOPolicy:
@@ -150,13 +157,36 @@ class SLOPolicy:
         # (tenant, bucket) label pairs this policy has recorded — the cells
         # projected_p99 scans (Histogram has no label enumeration by design)
         self._cells: Dict[Tuple[str, str], None] = {}
+        # live-queue view for the collect-time PROJECTED_P99 gauge; the
+        # frontend binds its real queue in __init__, an unbound policy
+        # projects at depth 0 (projected == observed)
+        self._queue_depth_fn = lambda: 0
+        self._max_batch = 1
 
     # -- recording -----------------------------------------------------------
+    def bind_queue(self, depth_fn, max_batch: int) -> None:
+        """Attach the live queue view the PROJECTED_P99 gauge samples at
+        collect time — the frontend passes its real ``_queued_rows`` and
+        ``max_batch`` so the exported projection is the exact number
+        ``admit`` evaluates."""
+        self._queue_depth_fn = depth_fn
+        self._max_batch = max(1, int(max_batch))
+
     def observe(self, tenant: str, bucket: str, request_ms: float) -> None:
         """Record one completed request's end-to-end latency."""
-        REQUEST_MS.observe(request_ms, tenant=str(tenant), bucket=str(bucket))
+        tenant, bucket = str(tenant), str(bucket)
+        REQUEST_MS.observe(request_ms, tenant=tenant, bucket=bucket)
         with self._lock:
-            self._cells[(str(tenant), str(bucket))] = None
+            first = (tenant, bucket) not in self._cells
+            self._cells[(tenant, bucket)] = None
+        if first:
+            # register the tenant's collect-time projection on first sight;
+            # last-registered policy wins per tenant (one live policy per
+            # frontend in practice)
+            PROJECTED_P99.set_function(
+                lambda t=tenant: self.projected_p99(
+                    t, int(self._queue_depth_fn()), self._max_batch),
+                tenant=tenant)
 
     # -- projection ----------------------------------------------------------
     def observed_p99(self, tenant: Optional[str] = None) -> float:

@@ -26,6 +26,7 @@ import math
 import re
 import threading
 import time
+from collections import deque as _deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..core import flags as _flags
@@ -36,6 +37,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry",
     "default_registry", "counter", "gauge", "histogram", "enabled",
     "parse_prometheus_text", "TIME_MS_BUCKETS",
+    # metrics history (the SLO engine's data plane)
+    "SeriesRing", "MetricsHistory", "series_key",
     # native StatRegistry compat shim
     "stat_add", "stat_set", "stat_get", "stat_reset", "stats",
 ]
@@ -526,6 +529,281 @@ def histogram(name: str, description: str = "",
               labelnames: Sequence[str] = (),
               buckets: Optional[Sequence[float]] = None) -> Histogram:
     return _default.histogram(name, description, labelnames, buckets)
+
+
+# ---------------------------------------------------------------------------
+# Metrics history: bounded per-series rings fed by a self-sampler.
+#
+# The registry above is a point-in-time snapshot plane; the SLO engine
+# (utils/slo.py) needs *retained* measurements to compute windowed burn
+# rates.  `MetricsHistory.sample()` takes one pass over a registry and
+# appends derived scalar series into bounded rings:
+#
+#   counters   -> ``name{k=v,...}:rate``  (delta / dt between ticks, plus an
+#                 aggregate sum-rate under the bare ``name:rate`` for
+#                 labeled families so e.g. total `serve.load_shed` rate is
+#                 addressable without enumerating tenants)
+#   gauges     -> ``name{k=v,...}``       (non-finite samples skipped)
+#   histograms -> ``name{k=v,...}:p50`` / ``:p99`` computed over the BUCKET
+#                 DELTAS since the previous tick — the windowed-percentile
+#                 semantics of Prometheus `histogram_quantile(rate(...))`.
+#                 A cumulative-cell percentile never recovers after a latency
+#                 spike (old samples dominate forever); the per-interval
+#                 estimate does, which is what makes alert *resolution*
+#                 possible.  Ticks with no new observations emit nothing.
+#
+# Cursor contract: every appended sample carries a seq from one history-wide
+# monotonic counter, and `read_since(series, since)` reports
+# ``truncated=True`` iff the ring has evicted samples newer than `since` —
+# the same verdict rule as FlightRecorder and the calibration Ledger, so
+# pollers share one resume idiom across /flight, /ledger and /history.
+# Downsampling is applied at read time (`max_points` even thinning, newest
+# sample always kept) so the stored ring stays exact.
+# ---------------------------------------------------------------------------
+
+
+def series_key(name: str, labels: Dict[str, str]) -> str:
+    """Canonical history-series key: ``name`` or ``name{k=v,...}`` with keys
+    sorted — the same rendering `stats()` uses for labeled samples."""
+    if not labels:
+        return name
+    body = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{body}}}"
+
+
+class SeriesRing:
+    """Bounded ring of (seq, ts, value) samples for one history series."""
+
+    __slots__ = ("_items", "_capacity", "_evicted_seq", "last_seq")
+
+    def __init__(self, capacity: int = 1024):
+        self._items: "deque" = _deque(maxlen=max(2, int(capacity)))
+        self._capacity = max(2, int(capacity))
+        self._evicted_seq = 0   # seq of the newest sample ever evicted
+        self.last_seq = 0
+
+    def append(self, seq: int, ts: float, value: float) -> None:
+        if len(self._items) == self._capacity:
+            self._evicted_seq = self._items[0][0]
+        self._items.append((seq, float(ts), float(value)))
+        self.last_seq = seq
+
+    def read_since(self, since: int = 0) -> Tuple[List[Tuple[int, float, float]], bool]:
+        """Samples with seq > since, oldest first, plus a truncated verdict:
+        True iff the ring evicted samples the cursor never saw."""
+        items = [s for s in self._items if s[0] > since]
+        return items, since < self._evicted_seq
+
+    def values_since_ts(self, since_ts: float) -> List[float]:
+        """Values of samples with ts >= since_ts (the evaluator's window
+        read)."""
+        return [v for (_, ts, v) in self._items if ts >= since_ts]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class MetricsHistory:
+    """Per-series `SeriesRing`s fed by `sample()` passes over a registry.
+
+    Thread-safe: the sampler thread appends while HTTP scrape threads read.
+    Series count is capped (`max_series`) as a label-cardinality backstop —
+    once full, new series are silently not created (existing ones keep
+    recording), and `dropped_series()` reports how many were refused.
+    Series whose key starts with a *priority prefix* (the SLO engine
+    registers its own ``slo.`` family plus every objective's metric) are
+    exempt from the cap up to a 2× hard ceiling — a cardinality explosion
+    elsewhere in the registry must not starve the alerting plane of the
+    very series it alerts on."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 capacity: int = 1024, max_series: int = 4096,
+                 priority_prefixes: Optional[Iterable[str]] = None):
+        self.registry = registry if registry is not None else _default
+        self.capacity = max(2, int(capacity))
+        self.max_series = max(1, int(max_series))
+        self._priority: Tuple[str, ...] = tuple(priority_prefixes or ())
+        self._series: Dict[str, SeriesRing] = {}
+        self._lock = threading.Lock()
+        self._seq = 0              # history-wide monotonic sample counter
+        self._dropped = 0
+        # per-series counter state: key -> (ts, cumulative total)
+        self._last_counter: Dict[str, Tuple[float, float]] = {}
+        # per-cell histogram state: key -> (count, bucket_counts tuple)
+        self._last_hist: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, now: Optional[float] = None) -> Dict[str, float]:
+        """One snapshot pass: derive scalar samples from every registry
+        metric and append them to the rings.  Returns {series: value} for
+        this tick (the JSONL mirror's payload).  Never raises — a metric
+        whose collection fails is skipped."""
+        ts = time.time() if now is None else float(now)
+        out: Dict[str, float] = {}
+        for m in self.registry.metrics():
+            try:
+                if m.kind == "counter":
+                    self._sample_counter(m, ts, out)
+                elif m.kind == "gauge":
+                    self._sample_gauge(m, out)
+                elif m.kind == "histogram":
+                    self._sample_histogram(m, out)
+            except Exception:
+                continue
+        with self._lock:
+            for key in sorted(out):
+                ring = self._series.get(key)
+                if ring is None:
+                    if len(self._series) >= self.max_series and not (
+                            self._is_priority(key)
+                            and len(self._series) < 2 * self.max_series):
+                        self._dropped += 1
+                        continue
+                    ring = self._series[key] = SeriesRing(self.capacity)
+                self._seq += 1
+                ring.append(self._seq, ts, out[key])
+        return out
+
+    def set_priority_prefixes(self, prefixes: Iterable[str]) -> None:
+        """Replace the cap-exempt prefix set (the SLO engine calls this
+        whenever its objective set changes)."""
+        with self._lock:
+            self._priority = tuple(dict.fromkeys(prefixes))
+
+    def _is_priority(self, key: str) -> bool:
+        return any(key.startswith(p) for p in self._priority)
+
+    def _sample_counter(self, m: Metric, ts: float,
+                        out: Dict[str, float]) -> None:
+        agg, any_rate = 0.0, False
+        for labels, total in m.samples():
+            key = series_key(m.name, labels) + ":rate"
+            last = self._last_counter.get(key)
+            self._last_counter[key] = (ts, float(total))
+            if last is None:
+                continue
+            dt = ts - last[0]
+            delta = float(total) - last[1]
+            if dt <= 0 or delta < 0:   # same tick, or counter reset
+                continue
+            rate = delta / dt
+            out[key] = rate
+            agg += rate
+            any_rate = True
+        if m.labelnames and any_rate:
+            out[m.name + ":rate"] = agg
+
+    def _sample_gauge(self, m: Metric, out: Dict[str, float]) -> None:
+        for labels, value in m.samples():
+            v = float(value)
+            if math.isfinite(v):
+                out[series_key(m.name, labels)] = v
+
+    def _sample_histogram(self, m: Histogram, out: Dict[str, float]) -> None:
+        for labels, stat in m.samples():
+            base = series_key(m.name, labels)
+            # stat["buckets"] is cumulative (prometheus-style le counts);
+            # de-cumulate to per-bucket counts before differencing ticks
+            cums = [int(stat["buckets"][_fmt_le(b)]) for b in m.buckets]
+            counts = tuple(c - p for c, p in zip(cums, [0] + cums[:-1]))
+            last = self._last_hist.get(base)
+            self._last_hist[base] = (int(stat["count"]), counts)
+            if last is None:
+                continue
+            deltas = [c - p for c, p in zip(counts, last[1])]
+            dcount = int(stat["count"]) - last[0]
+            if dcount <= 0 or any(d < 0 for d in deltas):
+                continue   # no new observations, or the cell was reset
+            hi_cap = float(stat["max"])
+            out[base + ":p50"] = _delta_percentile(m.buckets, deltas, 50.0,
+                                                   hi_cap)
+            out[base + ":p99"] = _delta_percentile(m.buckets, deltas, 99.0,
+                                                   hi_cap)
+
+    # -- reads ---------------------------------------------------------------
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def dropped_series(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def read_since(self, series: str, since: int = 0,
+                   max_points: int = 0) -> Dict[str, Any]:
+        """{"last_seq", "truncated", "samples": [[seq, ts, value], ...]} for
+        one series (samples with seq > since, oldest first).  `max_points`
+        > 0 thins the reply by even-stride downsampling that always keeps
+        the newest sample; `truncated` keeps the ring-eviction meaning and
+        is never set by thinning."""
+        with self._lock:
+            ring = self._series.get(series)
+            if ring is None:
+                return {"last_seq": 0, "truncated": False, "samples": []}
+            items, truncated = ring.read_since(since)
+            last = ring.last_seq
+        if max_points and len(items) > max_points:
+            stride = len(items) / float(max_points)
+            picked = [items[min(len(items) - 1, int(i * stride))]
+                      for i in range(max_points)]
+            picked[-1] = items[-1]
+            items = picked
+        return {"last_seq": last, "truncated": truncated,
+                "samples": [[s, ts, v] for (s, ts, v) in items]}
+
+    def window_values(self, series: str, since_ts: float) -> List[float]:
+        """Values recorded at ts >= since_ts for one series (the burn-rate
+        evaluator's window read)."""
+        with self._lock:
+            ring = self._series.get(series)
+            return ring.values_since_ts(since_ts) if ring else []
+
+    def match_series(self, metric: str, suffix: str = "") -> List[str]:
+        """Series for one metric family: the bare ``metric + suffix`` key
+        plus every labeled ``metric{...}`` cell with that suffix."""
+        prefix = metric + "{"
+        with self._lock:
+            return sorted(
+                k for k in self._series
+                if (k == metric + suffix
+                    or (k.startswith(prefix) and k.endswith(suffix)
+                        and (suffix or "}" == k[-1]))))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._last_counter.clear()
+            self._last_hist.clear()
+            self._dropped = 0
+
+
+def _delta_percentile(bounds: Sequence[float], deltas: Sequence[int],
+                      q: float, hi_cap: float) -> float:
+    """Percentile estimate over one inter-tick bucket-count delta — the
+    interpolation of `Histogram._cell_percentile` applied to an increment
+    instead of a cumulative cell.  `hi_cap` bounds the open +Inf bucket
+    (the cell's lifetime max: the best honest upper bound available once
+    per-interval extrema are gone)."""
+    total = sum(deltas)
+    if total <= 0:
+        return math.nan
+    rank = (q / 100.0) * total
+    cum, lo = 0, 0.0
+    for bound, n in zip(bounds, deltas):
+        prev = cum
+        cum += n
+        if cum >= rank and n:
+            hi = hi_cap if math.isinf(bound) else float(bound)
+            if hi < lo:
+                hi = lo
+            return lo + (hi - lo) * ((rank - prev) / n)
+        if not math.isinf(bound):
+            lo = float(bound)
+    return hi_cap
 
 
 # ---------------------------------------------------------------------------

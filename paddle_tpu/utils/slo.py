@@ -1,0 +1,763 @@
+"""SLO engine: declarative objectives + multi-window burn-rate alerting.
+
+The telemetry plane exposes live signals and the calibration ledger joins
+predictions with measurements, but every scrape is a point-in-time
+snapshot — nothing retains history, and nothing turns "p99 is bad" into a
+*decision*.  The reference's platform/monitor.h StatValue plane existed to
+feed exactly such threshold monitors; this module rebuilds that loop the
+SRE way:
+
+* **History** — a background self-sampler (``slo_sample_secs`` flag,
+  default 5s) snapshots the metric registry into the bounded per-series
+  rings of :class:`~paddle_tpu.utils.monitor.MetricsHistory` (counters as
+  rates, gauges as values, histograms as inter-tick p50/p99), served at
+  ``/history`` and optionally mirrored to per-rank JSONL
+  (``history_dir`` flag / ``PDTPU_HISTORY_DIR``).
+* **Objectives** — declarative :class:`SLO` records
+  ``(name, metric, op, threshold, objective_pct, windows)`` registered in
+  code or loaded from a TOML/JSON file (``slo_objectives`` flag;
+  ``python -m tools.slocheck`` validates one against the metric
+  inventory).  ``op`` is the *violation* comparator: a sample for which
+  ``value <op> threshold`` holds is a bad sample.
+* **Burn rates** — per evaluation tick, each objective's bad-sample
+  fraction over every configured window is divided by the error budget
+  ``(100 - objective_pct) / 100``; a burn rate of 1.0 consumes the budget
+  exactly at the sustainable pace, 14.4 consumes a 30-day budget in ~2
+  days (the classic page threshold).
+* **Multi-window alerting** (Google SRE workbook ch.5): an alert
+  condition requires the burn threshold to be exceeded on BOTH a short
+  and a long window — the long window proves the burn is sustained (no
+  paging on a blip), the short window makes the alert *resolve* quickly
+  once the system recovers (bad samples age out of the short window
+  first).  Each (slo, severity) pair runs a pending → firing → resolved
+  state machine; every transition is flight-recorded (``slo_alert``
+  events — the watchdog counts firings into its anomaly report) and
+  exported as ``slo.alerts_firing{slo,severity}`` /
+  ``slo.burn_rate{slo,window}``.  Firing page-severity alerts flip
+  ``/healthz`` to 503 via the standard health-provider hook.
+
+Observation-only, same contract as the calibration ledger: the engine
+reads metrics and never touches the compile or dispatch path — zero
+steady-state retraces and warm persistent-cache starts hold with the
+``slo`` flag on (pinned in tests/test_slo.py).  Every hook is guarded:
+a broken objective degrades to a skipped evaluation, never a failed run.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core import flags as _flags
+from . import monitor as _monitor
+from . import trace as _trace
+
+__all__ = [
+    "HISTORY_DIR_ENV", "DEFAULT_WINDOWS", "VALID_OPS", "VALID_SEVERITIES",
+    "Window", "SLO", "SLOEngine", "default_objectives", "load_objectives",
+    "parse_objectives", "engine", "get_engine", "history", "start", "stop",
+    "reset", "start_from_env",
+]
+
+HISTORY_DIR_ENV = "PDTPU_HISTORY_DIR"
+
+VALID_OPS = (">", ">=", "<", "<=")
+VALID_SEVERITIES = ("page", "ticket", "warn")
+VALID_SIGNALS = ("value", "rate", "p50", "p99")
+
+_m_burn = _monitor.gauge(
+    "slo.burn_rate", "Latest error-budget burn rate per objective and "
+    "evaluation window (1.0 = consuming budget exactly at the sustainable "
+    "pace).", labelnames=("slo", "window"))
+_m_firing = _monitor.gauge(
+    "slo.alerts_firing", "1 while the (slo, severity) alert is firing, "
+    "else 0.", labelnames=("slo", "severity"))
+_m_evals = _monitor.counter(
+    "slo.evaluations", "SLO evaluation ticks run by the engine.")
+
+
+class Window:
+    """One fast/slow burn-rate window pair with its alert severity.
+
+    ``short_secs``/``long_secs`` are the lookback windows (seconds);
+    ``burn`` is the burn-rate threshold BOTH windows must exceed for the
+    alert condition to hold."""
+
+    __slots__ = ("short_secs", "long_secs", "burn", "severity")
+
+    def __init__(self, short_secs: float, long_secs: float, burn: float,
+                 severity: str = "page"):
+        self.short_secs = float(short_secs)
+        self.long_secs = float(long_secs)
+        self.burn = float(burn)
+        self.severity = str(severity)
+        if self.short_secs <= 0 or self.long_secs <= 0:
+            raise ValueError("window seconds must be > 0")
+        if self.short_secs >= self.long_secs:
+            raise ValueError(
+                f"short window ({self.short_secs}s) must be shorter than "
+                f"the long window ({self.long_secs}s)")
+        if self.burn <= 0:
+            raise ValueError("burn threshold must be > 0")
+        if self.severity not in VALID_SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {VALID_SEVERITIES}")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"short_secs": self.short_secs, "long_secs": self.long_secs,
+                "burn": self.burn, "severity": self.severity}
+
+    def __repr__(self):
+        return (f"Window({self.short_secs:g}s/{self.long_secs:g}s, "
+                f"burn>{self.burn:g}, {self.severity})")
+
+
+# The SRE-workbook standard pairs: 5m+1h pages, 30m+6h tickets.  Burn
+# thresholds assume a ~30-day budget (14.4 = budget gone in 2 days).
+DEFAULT_WINDOWS = (Window(300.0, 3600.0, 14.4, "page"),
+                   Window(1800.0, 21600.0, 6.0, "ticket"))
+
+
+class SLO:
+    """One declarative objective over a history series.
+
+    ``metric`` names a registry metric family; ``signal`` picks which
+    derived history series to judge: ``value`` (gauge samples), ``rate``
+    (counter delta/dt), ``p50``/``p99`` (inter-tick histogram
+    percentiles).  A sample is *bad* when ``value <op> threshold`` holds;
+    ``objective_pct`` says what fraction of samples must be good, which
+    fixes the error budget the burn rates are measured against.  Labeled
+    families are judged per cell with the worst cell winning (one bad
+    tenant pages like all-bad traffic would)."""
+
+    def __init__(self, name: str, metric: str, op: str, threshold: float,
+                 objective_pct: float = 99.0,
+                 windows: Optional[Sequence[Window]] = None,
+                 signal: str = "value", description: str = ""):
+        self.name = str(name)
+        self.metric = str(metric)
+        self.op = str(op)
+        self.threshold = float(threshold)
+        self.objective_pct = float(objective_pct)
+        self.windows = tuple(windows) if windows is not None \
+            else DEFAULT_WINDOWS
+        self.signal = str(signal)
+        self.description = str(description)
+        if not self.name:
+            raise ValueError("SLO name must be non-empty")
+        if not self.metric:
+            raise ValueError(f"SLO {self.name!r}: metric must be non-empty")
+        if self.op not in VALID_OPS:
+            raise ValueError(
+                f"SLO {self.name!r}: op {self.op!r} not in {VALID_OPS}")
+        if not 0.0 < self.objective_pct < 100.0:
+            raise ValueError(
+                f"SLO {self.name!r}: objective_pct must be in (0, 100), "
+                f"got {self.objective_pct}")
+        if self.signal not in VALID_SIGNALS:
+            raise ValueError(
+                f"SLO {self.name!r}: signal {self.signal!r} not in "
+                f"{VALID_SIGNALS}")
+        if not self.windows:
+            raise ValueError(f"SLO {self.name!r}: needs >= 1 window")
+        for w in self.windows:
+            if not isinstance(w, Window):
+                raise TypeError(
+                    f"SLO {self.name!r}: windows must be Window instances")
+
+    @property
+    def error_budget(self) -> float:
+        """Allowed bad-sample fraction: (100 - objective_pct) / 100."""
+        return (100.0 - self.objective_pct) / 100.0
+
+    @property
+    def series_suffix(self) -> str:
+        """The history-series suffix the signal selects ('' for gauges)."""
+        return "" if self.signal == "value" else ":" + self.signal
+
+    def violates(self, value: float) -> bool:
+        if self.op == ">":
+            return value > self.threshold
+        if self.op == ">=":
+            return value >= self.threshold
+        if self.op == "<":
+            return value < self.threshold
+        return value <= self.threshold
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "metric": self.metric, "op": self.op,
+                "threshold": self.threshold,
+                "objective_pct": self.objective_pct,
+                "signal": self.signal, "description": self.description,
+                "windows": [w.to_json() for w in self.windows]}
+
+    def __repr__(self):
+        return (f"SLO({self.name!r}: {self.metric}:{self.signal} "
+                f"{self.op} {self.threshold:g} @ {self.objective_pct:g}%)")
+
+
+def default_objectives() -> List[SLO]:
+    """The shipped defaults: serving latency/shedding, training goodput,
+    and cost-model calibration — one objective per operational surface the
+    platform already instruments.  Fresh instances every call (engines
+    mutate nothing, but tests clear/re-register freely)."""
+    return [
+        SLO("serve-ttft-p99", "serve.ttft_p99_ms", ">", 500.0,
+            objective_pct=99.0, signal="value",
+            description="End-to-end time-to-first-token p99 stays under "
+                        "500ms."),
+        SLO("serve-load-shed", "serve.load_shed", ">", 0.0,
+            objective_pct=99.0, signal="rate",
+            description="The admission controller is not shedding "
+                        "requests."),
+        SLO("train-goodput", "train.goodput_pct", "<", 50.0,
+            objective_pct=95.0, signal="value",
+            description="At least half of train wall time is productive "
+                        "step time (watchdog accounting)."),
+        SLO("ledger-drift", "ledger.drift_ratio", ">", 2.0,
+            objective_pct=95.0, signal="value",
+            description="Static cost-model predictions stay within 2x of "
+                        "measurements (calibration ledger)."),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Objective files: TOML (stdlib tomllib when available, else a minimal
+# built-in subset parser) or JSON — both describe the same shape:
+#
+#   [[slo]]                          {"slo": [
+#   name = "ttft"                      {"name": "ttft",
+#   metric = "serve.ttft_p99_ms"        "metric": "serve.ttft_p99_ms",
+#   op = ">"                            "op": ">",
+#   threshold = 500.0                   "threshold": 500.0,
+#   objective_pct = 99.0                "objective_pct": 99.0,
+#   signal = "value"                    "signal": "value",
+#   windows = [ { short_secs = 300, long_secs = 3600, burn = 14.4, severity = "page" } ]
+#   ...                               ]}
+# ---------------------------------------------------------------------------
+
+
+def parse_objectives(doc: Dict[str, Any]) -> List[SLO]:
+    """Build SLOs from a parsed objective document ({"slo": [table, ...]}).
+    Raises ValueError on structural problems (slocheck surfaces these)."""
+    tables = doc.get("slo")
+    if not isinstance(tables, list) or not tables:
+        raise ValueError("objective file needs a non-empty [[slo]] list "
+                         "(JSON: a top-level \"slo\" array)")
+    out: List[SLO] = []
+    seen = set()
+    for i, t in enumerate(tables):
+        if not isinstance(t, dict):
+            raise ValueError(f"slo[{i}] is not a table/object")
+        unknown = set(t) - {"name", "metric", "op", "threshold",
+                            "objective_pct", "windows", "signal",
+                            "description"}
+        if unknown:
+            raise ValueError(f"slo[{i}]: unknown keys {sorted(unknown)}")
+        windows = None
+        if "windows" in t:
+            windows = []
+            for j, w in enumerate(t["windows"]):
+                if not isinstance(w, dict):
+                    raise ValueError(
+                        f"slo[{i}].windows[{j}] is not a table/object")
+                try:
+                    windows.append(Window(
+                        w.get("short_secs", 0), w.get("long_secs", 0),
+                        w.get("burn", 0), w.get("severity", "page")))
+                except (TypeError, ValueError) as e:
+                    raise ValueError(f"slo[{i}].windows[{j}]: {e}")
+        try:
+            slo = SLO(t.get("name", ""), t.get("metric", ""),
+                      t.get("op", ""), t.get("threshold", math.nan),
+                      objective_pct=t.get("objective_pct", 99.0),
+                      windows=windows, signal=t.get("signal", "value"),
+                      description=t.get("description", ""))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"slo[{i}]: {e}")
+        if not math.isfinite(slo.threshold):
+            raise ValueError(f"slo[{i}] ({slo.name!r}): threshold must be "
+                             "a finite number")
+        if slo.name in seen:
+            raise ValueError(f"duplicate SLO name {slo.name!r}")
+        seen.add(slo.name)
+        out.append(slo)
+    return out
+
+
+def load_objectives(path: str) -> List[SLO]:
+    """Load an objective file: ``.json`` parses as JSON, anything else as
+    TOML (stdlib ``tomllib`` when the interpreter ships it, else the
+    built-in subset parser below)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    if path.endswith(".json"):
+        doc = json.loads(text)
+    else:
+        try:
+            import tomllib  # Python >= 3.11
+            doc = tomllib.loads(text)
+        except ImportError:
+            doc = _parse_toml_subset(text)
+    return parse_objectives(doc)
+
+
+def _parse_toml_value(s: str):
+    """One scalar / inline value of the TOML subset."""
+    s = s.strip()
+    if (s.startswith('"') and s.endswith('"') and len(s) >= 2) or \
+       (s.startswith("'") and s.endswith("'") and len(s) >= 2):
+        return s[1:-1]
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    if s.startswith("[") and s.endswith("]"):
+        return [_parse_toml_value(p) for p in _split_toml_list(s[1:-1])]
+    if s.startswith("{") and s.endswith("}"):
+        table = {}
+        for part in _split_toml_list(s[1:-1]):
+            if "=" not in part:
+                raise ValueError(f"bad inline-table entry {part!r}")
+            k, _, v = part.partition("=")
+            table[k.strip()] = _parse_toml_value(v)
+        return table
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        raise ValueError(f"unsupported TOML value {s!r}")
+
+
+def _split_toml_list(body: str) -> List[str]:
+    """Split a bracketed body on top-level commas (strings and nested
+    brackets respected)."""
+    parts, depth, quote, cur = [], 0, "", []
+    for ch in body:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = ""
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch in "[{":
+            depth += 1
+            cur.append(ch)
+        elif ch in "]}":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        parts.append("".join(cur))
+    return [p for p in (q.strip() for q in parts) if p]
+
+
+def _parse_toml_subset(text: str) -> Dict[str, Any]:
+    """Minimal TOML for objective files on interpreters without stdlib
+    ``tomllib``: ``[[table]]`` array-of-tables headers, ``[table]``
+    headers, and single-line ``key = value`` pairs with string / number /
+    bool / inline-array / inline-table values.  Exactly the grammar the
+    documented objective format uses; anything fancier should ship as
+    JSON."""
+    root: Dict[str, Any] = {}
+    current: Dict[str, Any] = root
+    for lineno, raw in enumerate(text.split("\n"), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            name = line[2:-2].strip()
+            current = {}
+            root.setdefault(name, []).append(current)
+        elif line.startswith("[") and line.endswith("]"):
+            name = line[1:-1].strip()
+            current = root.setdefault(name, {})
+        elif "=" in line:
+            key, _, value = line.partition("=")
+            try:
+                current[key.strip()] = _parse_toml_value(value)
+            except ValueError as e:
+                raise ValueError(f"TOML line {lineno}: {e}")
+        else:
+            raise ValueError(f"TOML line {lineno}: unparseable {line!r}")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# The engine: sampler thread + evaluator + alert state machines.
+# ---------------------------------------------------------------------------
+
+
+class _AlertState:
+    __slots__ = ("state", "since", "burn_short", "burn_long")
+
+    def __init__(self):
+        self.state = "ok"
+        self.since = 0.0
+        self.burn_short = 0.0
+        self.burn_long = 0.0
+
+
+class SLOEngine:
+    """Owns the metrics history, the registered objectives, and the alert
+    state machines; one daemon thread ("pdtpu-slo") ticks every
+    ``slo_sample_secs``: sample the registry into the history, mirror the
+    tick to the JSONL sink when configured, evaluate every objective.
+
+    State machine per (slo, severity): ``ok`` → (condition) → ``pending``
+    → (still holding after ``for_secs``; 0 by default, so the same tick)
+    → ``firing`` → (condition clears) → ``resolved`` → (condition) →
+    ``pending`` again.  ``pending`` that clears before confirmation goes
+    back to ``ok``.  Every transition lands in the flight ring as an
+    ``slo_alert`` event carrying the burn rates that caused it."""
+
+    def __init__(self, registry: Optional[_monitor.MetricRegistry] = None,
+                 capacity: int = 1024, for_secs: float = 0.0):
+        self.history = _monitor.MetricsHistory(
+            registry, capacity=capacity, priority_prefixes=("slo.",))
+        self.for_secs = float(for_secs)
+        self._objectives: Dict[str, SLO] = {}
+        self._alerts: Dict[Tuple[str, str], _AlertState] = {}
+        self._transitions: "deque" = deque(maxlen=256)
+        self._transition_seq = 0
+        self._lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._sample_override: Optional[float] = None
+        self._sink_path: Optional[str] = None
+        self._last_eval = 0.0
+
+    # -- objectives -----------------------------------------------------------
+    def register(self, slo: SLO) -> SLO:
+        with self._lock:
+            self._objectives[slo.name] = slo
+            self._sync_priority()
+        return slo
+
+    def clear(self) -> None:
+        """Drop every objective and alert state (tests / re-load)."""
+        with self._lock:
+            self._objectives.clear()
+            self._alerts.clear()
+            self._sync_priority()
+
+    def _sync_priority(self) -> None:
+        """Exempt the engine's own series and every objective's metric from
+        the history's cardinality cap — an unrelated label explosion must
+        not evict the series the alerts evaluate over.  Caller holds the
+        lock."""
+        self.history.set_priority_prefixes(
+            ("slo.",) + tuple(s.metric for s in self._objectives.values()))
+
+    def objectives(self) -> List[SLO]:
+        with self._lock:
+            return [self._objectives[n] for n in sorted(self._objectives)]
+
+    def load_default_objectives(self) -> None:
+        """Resolve objectives at start time: the ``slo_objectives`` file
+        when set (a broken file is flight-recorded and the defaults stand
+        in), else the shipped defaults.  No-op when objectives are already
+        registered — code registration wins."""
+        if self.objectives():
+            return
+        path = str(_flags.get_flag("slo_objectives") or "").strip()
+        if path:
+            try:
+                for slo in load_objectives(path):
+                    self.register(slo)
+                return
+            except (OSError, ValueError) as e:
+                _trace.flight_recorder().record(
+                    "slo_objectives_error", name=os.path.basename(path),
+                    path=path, error=repr(e))
+        for slo in default_objectives():
+            self.register(slo)
+
+    # -- evaluation -----------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One evaluation pass over every objective against the history."""
+        ts = time.time() if now is None else float(now)
+        _m_evals.inc()
+        with self._lock:
+            objectives = list(self._objectives.values())
+        for slo in objectives:
+            try:
+                self._evaluate_one(slo, ts)
+            except Exception:
+                continue
+        self._last_eval = ts
+
+    def _evaluate_one(self, slo: SLO, ts: float) -> None:
+        series = self.history.match_series(slo.metric, slo.series_suffix)
+        secs_needed = sorted({s for w in slo.windows
+                              for s in (w.short_secs, w.long_secs)})
+        budget = max(slo.error_budget, 1e-9)
+        burn: Dict[float, float] = {}
+        for secs in secs_needed:
+            worst = 0.0
+            for key in series:
+                values = self.history.window_values(key, ts - secs)
+                if not values:
+                    continue
+                bad = sum(1 for v in values if slo.violates(v))
+                worst = max(worst, bad / len(values))
+            burn[secs] = worst / budget
+            _m_burn.set(burn[secs], slo=slo.name, window=f"{secs:g}s")
+        for w in slo.windows:
+            cond = (burn[w.short_secs] > w.burn
+                    and burn[w.long_secs] > w.burn)
+            self._step_alert(slo, w, cond,
+                             burn[w.short_secs], burn[w.long_secs], ts)
+
+    def _step_alert(self, slo: SLO, w: Window, cond: bool,
+                    burn_short: float, burn_long: float, ts: float) -> None:
+        key = (slo.name, w.severity)
+        with self._lock:
+            st = self._alerts.get(key)
+            if st is None:
+                st = self._alerts[key] = _AlertState()
+            st.burn_short, st.burn_long = burn_short, burn_long
+            prev = st.state
+            if cond:
+                if prev in ("ok", "resolved"):
+                    self._transition(slo, w, st, "pending", ts)
+                if st.state == "pending" and ts - st.since >= self.for_secs:
+                    self._transition(slo, w, st, "firing", ts)
+            else:
+                if prev == "pending":
+                    self._transition(slo, w, st, "ok", ts)
+                elif prev == "firing":
+                    self._transition(slo, w, st, "resolved", ts)
+        _m_firing.set(1.0 if st.state == "firing" else 0.0,
+                      slo=slo.name, severity=w.severity)
+
+    def _transition(self, slo: SLO, w: Window, st: _AlertState,
+                    state: str, ts: float) -> None:
+        """(held under self._lock) Move one alert state machine and record
+        the transition in both the engine ring and the flight ring."""
+        prev, st.state, st.since = st.state, state, ts
+        self._transition_seq += 1
+        record = {
+            "seq": self._transition_seq, "ts": ts, "slo": slo.name,
+            "severity": w.severity, "from": prev, "to": state,
+            "burn_short": round(st.burn_short, 4),
+            "burn_long": round(st.burn_long, 4),
+            "burn_threshold": w.burn,
+            "windows": [w.short_secs, w.long_secs],
+        }
+        self._transitions.append(record)
+        _trace.flight_recorder().record(
+            "slo_alert", name=f"{slo.name}:{w.severity}", **{
+                k: v for k, v in record.items() if k not in ("seq", "ts")})
+
+    # -- reads ----------------------------------------------------------------
+    def alerts_doc(self) -> Dict[str, Any]:
+        """The ``/alerts`` document: every alert state, firing names, the
+        recent transition chain, and the registered objectives."""
+        with self._lock:
+            alerts = []
+            for (name, severity), st in sorted(self._alerts.items()):
+                slo = self._objectives.get(name)
+                alerts.append({
+                    "slo": name, "severity": severity, "state": st.state,
+                    "since": st.since,
+                    "burn_short": round(st.burn_short, 4),
+                    "burn_long": round(st.burn_long, 4),
+                    "metric": slo.metric if slo else None,
+                    "signal": slo.signal if slo else None,
+                    "threshold": slo.threshold if slo else None,
+                    "op": slo.op if slo else None,
+                })
+            transitions = list(self._transitions)
+            objectives = [s.to_json()
+                          for s in self._objectives.values()]
+        return {
+            "running": self.running,
+            "evaluated_at": self._last_eval,
+            "rank": _trace._rank(),
+            "alerts": alerts,
+            "firing": sorted(f"{a['slo']}:{a['severity']}" for a in alerts
+                             if a["state"] == "firing"),
+            "transitions": transitions,
+            "objectives": objectives,
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """The /healthz section: unhealthy iff a page-severity alert is
+        firing (ticket/warn severities degrade the doc, not the probe)."""
+        with self._lock:
+            firing = sorted(f"{n}:{sev}"
+                            for (n, sev), st in self._alerts.items()
+                            if st.state == "firing")
+            pages = sorted(f"{n}:{sev}"
+                           for (n, sev), st in self._alerts.items()
+                           if st.state == "firing" and sev == "page")
+            n_obj = len(self._objectives)
+        return {"healthy": not pages, "firing": firing,
+                "objectives": n_obj, "running": self.running,
+                "evaluated_at": self._last_eval}
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- lifecycle ------------------------------------------------------------
+    def _interval(self) -> float:
+        if self._sample_override is not None:
+            return self._sample_override
+        try:
+            return max(0.01, float(_flags.get_flag("slo_sample_secs")))
+        except (TypeError, ValueError):
+            return 5.0
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, float]:
+        """One sampler+evaluator cycle (the thread body; callable directly
+        from tests for deterministic time control)."""
+        samples = self.history.sample(now)
+        if samples and self._sink_path:
+            self._mirror(samples, now)
+        self.evaluate(now)
+        return samples
+
+    def _mirror(self, samples: Dict[str, float],
+                now: Optional[float]) -> None:
+        """One O_APPEND write per tick — atomic on POSIX local filesystems,
+        same idiom as the ledger sink."""
+        try:
+            line = (json.dumps(
+                {"ts": time.time() if now is None else float(now),
+                 "rank": _trace._rank(), "samples": samples},
+                sort_keys=True, default=repr) + "\n").encode("utf-8")
+            fd = os.open(self._sink_path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # a full/readonly disk must not take down the job
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self.tick()
+            except Exception:
+                pass  # a broken tick must not kill the sampler
+            self._stop_evt.wait(self._interval())
+
+    def start(self, sample_secs: Optional[float] = None) -> "SLOEngine":
+        """Resolve objectives + sink, register the health provider, start
+        the sampler thread.  Idempotent while running."""
+        if self.running:
+            return self
+        if sample_secs is not None:
+            self._sample_override = max(0.01, float(sample_secs))
+        self.load_default_objectives()
+        self._sink_path = _history_sink_path()
+        from . import telemetry as _telemetry
+        _telemetry.register_health_provider("slo", self.health)
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._loop, name="pdtpu-slo",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        thread, self._thread = self._thread, None
+        self._stop_evt.set()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton + worker bootstrap.
+# ---------------------------------------------------------------------------
+_singleton: Optional[SLOEngine] = None
+_singleton_lock = threading.Lock()
+
+
+def _history_sink_path() -> Optional[str]:
+    d = str(_flags.get_flag("history_dir") or "").strip() \
+        or os.environ.get(HISTORY_DIR_ENV, "").strip()
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    return os.path.join(d, f"history.rank{_trace._rank()}.jsonl")
+
+
+def engine() -> SLOEngine:
+    """The process-wide engine (created on first use, NOT started — call
+    :func:`start` or ``engine().start()``)."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = SLOEngine()
+        return _singleton
+
+
+def get_engine() -> Optional[SLOEngine]:
+    """The singleton if it exists (``/alerts`` uses this so a scrape never
+    implicitly creates an engine)."""
+    return _singleton
+
+
+def history() -> _monitor.MetricsHistory:
+    """The singleton engine's history (``/history``'s data source)."""
+    return engine().history
+
+
+def start(sample_secs: Optional[float] = None) -> SLOEngine:
+    """Start the process-wide engine (creating it if needed)."""
+    return engine().start(sample_secs)
+
+
+def stop() -> None:
+    eng = get_engine()
+    if eng is not None:
+        eng.stop()
+
+
+def reset() -> None:
+    """Stop and drop the singleton (tests): the next engine() call starts
+    a fresh history/cursor space and re-resolves the sink path."""
+    global _singleton
+    with _singleton_lock:
+        eng, _singleton = _singleton, None
+    if eng is not None:
+        eng.stop()
+
+
+def enabled() -> bool:
+    """The engine auto-starts only when both the slo flag and the metrics
+    plane are on — without metrics there is nothing to sample."""
+    return bool(_flags.get_flag("slo")) and _monitor.enabled()
+
+
+def start_from_env() -> Optional[SLOEngine]:
+    """Worker bootstrap, called when the telemetry plane starts: bring the
+    engine up when the ``slo`` flag is on.  Guarded — SLO evaluation must
+    never kill a training job."""
+    if not enabled():
+        return None
+    try:
+        return start()
+    except Exception:
+        return None

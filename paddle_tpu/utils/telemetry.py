@@ -27,6 +27,14 @@ run (``telemetry_port`` flag; ``launch --telemetry_port BASE`` assigns
   measured-vs-predicted drift stream per compiled program, same
   ``?since=``/``truncated`` cursor contract as ``/spans``, plus the
   per-model calibration bands
+* ``/history``  — the SLO engine's retained metric samples
+  (utils/monitor.py ``MetricsHistory``): ``?series=a,b`` selects series,
+  ``?since=SEQ`` reads incrementally with the same ``truncated`` verdict,
+  ``?max_points=N`` thins the reply by even-stride downsampling
+* ``/alerts``   — the SLO engine's alert plane (utils/slo.py): every
+  (slo, severity) state machine, firing names, the recent transition
+  chain, and the registered objectives.  Firing page-severity alerts
+  also flip ``/healthz`` to 503 via the health-provider hook.
 
 Server threads are daemons (``ThreadingHTTPServer.daemon_threads``) and the
 accept loop runs on a daemon thread, so a scraped process — including a
@@ -172,6 +180,8 @@ class TelemetryServer:
             "/xprof": self._xprof,
             "/spans": self._spans,
             "/ledger": self._ledger,
+            "/history": self._history,
+            "/alerts": self._alerts,
         }
 
     def _index(self, query) -> tuple:
@@ -265,6 +275,42 @@ class TelemetryServer:
             "bands": _ledger_mod.BANDS,
             "records": records[-max(0, n):],
         }, default=repr)
+
+    def _history(self, query) -> tuple:
+        try:
+            since = int(query.get("since", ["0"])[0])
+            max_points = int(query.get("max_points", ["512"])[0])
+        except ValueError:
+            return (400, "application/json", json.dumps(
+                {"error": "since/max_points must be integers"}))
+        from . import slo as _slo
+
+        hist = _slo.history()
+        names = hist.names()
+        wanted = names
+        if "series" in query:
+            requested = [s for part in query["series"]
+                         for s in part.split(",") if s]
+            wanted = [s for s in requested if s in names]
+        series = {name: hist.read_since(name, since, max_points=max_points)
+                  for name in wanted}
+        return 200, "application/json", json.dumps({
+            "last_seq": hist.last_seq(),
+            "sample_secs": float(_flags.get_flag("slo_sample_secs")),
+            "names": names,
+            "series": series,
+        }, default=repr)
+
+    def _alerts(self, query) -> tuple:
+        from . import slo as _slo
+
+        eng = _slo.get_engine()
+        if eng is None:
+            return 200, "application/json", json.dumps(
+                {"running": False, "alerts": [], "firing": [],
+                 "transitions": [], "objectives": []})
+        return 200, "application/json", json.dumps(eng.alerts_doc(),
+                                                   default=repr)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "TelemetryServer":
@@ -364,11 +410,23 @@ def start_telemetry(port: Optional[int] = None,
 
 
 def stop_telemetry() -> None:
+    """Stop the process-wide server AND reset the plane's shared state:
+    registered health providers and published snapshots are dropped, so a
+    stop/start cycle serves only sections re-registered by live modules —
+    a provider closing over a dead watchdog or stale executor must not
+    haunt the next server's /healthz (idempotence regression-pinned in
+    tests/test_telemetry.py).  Per-instance ``TelemetryServer.stop()``
+    deliberately does NOT clear them: tests run private servers against
+    the same process-wide provider dict."""
     global _server
     with _server_lock:
         if _server is not None:
             _server.stop()
             _server = None
+    with _health_lock:
+        _health_providers.clear()
+    with _snapshots_lock:
+        _snapshots.clear()
 
 
 def start_from_env() -> Optional[TelemetryServer]:
@@ -386,9 +444,18 @@ def start_from_env() -> Optional[TelemetryServer]:
     if port <= 0:
         return None
     try:
-        return start_telemetry(port=port)
+        srv = start_telemetry(port=port)
     except OSError as e:
         _trace.flight_recorder().record(
             "telemetry_bind_failed", name=f"port{port}", port=port,
             error=repr(e))
         return None
+    # the plane is up: bring the SLO engine with it (slo flag gated; a
+    # broken engine start is swallowed — observability must never kill
+    # the job it observes)
+    try:
+        from . import slo as _slo
+        _slo.start_from_env()
+    except Exception:
+        pass
+    return srv

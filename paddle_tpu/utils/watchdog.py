@@ -115,7 +115,7 @@ class Watchdog:
             "productive": 0.0, "compile": 0.0, "restore": 0.0,
             "checkpoint": 0.0, "idle": 0.0}
         self._counts = {"step_time": 0, "nan_loss": 0, "loss_spike": 0,
-                        "ledger_drift": 0}
+                        "ledger_drift": 0, "slo_alert": 0}
         self._flushed: Dict[str, float] = {}  # time_ms already exported
         self._ckpts_taken = 0
         self._steps = 0
@@ -227,6 +227,20 @@ class Watchdog:
                     "drift": e.get("drift"),
                     "band": e.get("band"),
                     "program": e.get("program", ""),
+                }
+            if e.get("kind") == "slo_alert" and e.get("to") == "firing":
+                # an SLO alert started firing (utils/slo.py): counted into
+                # the watchdog's anomaly report; advisory here — the SLO
+                # engine's own health provider is what flips /healthz on
+                # page severity
+                self._counts["slo_alert"] += 1
+                _m_anomalies.inc(kind="slo_alert")
+                self._last_anomaly = {
+                    "kind": "slo_alert",
+                    "slo": e.get("slo", ""),
+                    "severity": e.get("severity", ""),
+                    "burn_short": e.get("burn_short"),
+                    "burn_long": e.get("burn_long"),
                 }
 
     def _publish_locked(self) -> None:

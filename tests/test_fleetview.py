@@ -267,3 +267,86 @@ def test_launch_three_ranks_straggler_attributed_by_both_views(tmp_path):
     assert metrics["calibration.mem_drift"][0] == pytest.approx(1.2)
     same = benchdiff.diff_metrics(metrics, metrics)
     assert same["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# the job-level alert plane: dedupe, state precedence, sparklines, gate
+# ---------------------------------------------------------------------------
+def _alerts_leg(state, slo_name="s", severity="page", burn_short=5.0,
+                burn_long=3.0):
+    return {"_status": 200, "alerts": [
+        {"slo": slo_name, "severity": severity, "state": state,
+         "metric": "t.m", "burn_short": burn_short,
+         "burn_long": burn_long}]}
+
+
+def test_alerts_section_dedupes_and_state_precedence():
+    s0 = {"endpoint": "e0", "alerts": _alerts_leg("resolved",
+                                                  burn_short=1.0)}
+    s1 = {"endpoint": "e1", "alerts": _alerts_leg("firing", burn_short=9.0)}
+    sec = fleetview._alerts_section([s0, s1], [0, 1])
+    assert sec["ranks_reporting"] == 2
+    (row,) = sec["alerts"]                   # ONE job alert, not two
+    assert row["state"] == "firing"          # firing on ANY rank wins
+    assert row["ranks"] == [0, 1]
+    assert row["burn_short"] == 9.0          # worst burn survives the merge
+    assert sec["firing"] == [row]
+    # ok states are dropped; pending beats resolved; different (slo,
+    # severity) pairs stay separate rows
+    s2 = {"endpoint": "e0", "alerts": {"_status": 200, "alerts": [
+        {"slo": "s", "severity": "page", "state": "ok"},
+        {"slo": "q", "severity": "ticket", "state": "pending",
+         "burn_short": 2.0, "burn_long": 2.0}]}}
+    s3 = {"endpoint": "e1", "alerts": _alerts_leg(
+        "resolved", slo_name="q", severity="ticket", burn_short=0.1,
+        burn_long=0.1)}
+    sec = fleetview._alerts_section([s2, s3], [0, 1])
+    (row,) = sec["alerts"]
+    assert (row["slo"], row["state"]) == ("q", "pending")
+    assert sec["firing"] == []
+    # an unreachable /alerts leg is skipped, never a crash
+    dead = {"endpoint": "e", "alerts": {"error": "ConnectionRefused"}}
+    sec = fleetview._alerts_section([dead], [0])
+    assert sec == {"ranks_reporting": 0, "alerts": [], "firing": []}
+
+
+def test_burn_history_and_sparkline():
+    scr = {"endpoint": "e", "history": {"_status": 200, "series": {
+        "slo.burn_rate{slo=s,window=5s}": {
+            "samples": [[1, 0.0, 0.5], [2, 1.0, 2.0]]},
+        "t.other": {"samples": [[3, 0.0, 1.0]]}}}}
+    bh = fleetview._burn_history([scr], [0])
+    assert list(bh) == ["slo.burn_rate{slo=s,window=5s}"]
+    assert bh["slo.burn_rate{slo=s,window=5s}"]["0"] == [0.5, 2.0]
+    # sparklines: empty-safe, normalized to the series max, width-thinned
+    assert fleetview._sparkline([]) == ""
+    line = fleetview._sparkline([0.0, 0.0, 8.0])
+    assert len(line) == 3
+    assert line[0] == fleetview._SPARK_GLYPHS[0]
+    assert line[-1] == fleetview._SPARK_GLYPHS[-1]
+    assert len(fleetview._sparkline([float(i) for i in range(100)],
+                                    width=24)) == 24
+
+
+def test_merge_alerts_ride_report_record_and_text():
+    s0 = _scrape(0, 10.0)
+    s1 = _scrape(1, 10.0)
+    s0["alerts"] = _alerts_leg("firing")
+    s1["alerts"] = _alerts_leg("firing")
+    s0["history"] = {"_status": 200, "series": {
+        "slo.burn_rate{slo=s,window=5s}": {
+            "samples": [[1, 0.0, 0.0], [2, 1.0, 6.0]]}}}
+    report = fleetview.merge([s0, s1])
+    assert report["alerts"]["ranks_reporting"] == 2
+    assert report["alerts"]["alerts"][0]["ranks"] == [0, 1]
+    assert report["record"]["slo"] == {"alerts_firing": 1,
+                                       "pages_firing": 1}
+    text = fleetview.render_text(report)
+    assert "FIRING" in text and "s:page" in text
+    assert "slo.burn_rate{slo=s,window=5s}" in text
+    # ranks without /alerts legs (older planes) degrade to an empty section
+    empty = fleetview.merge([_scrape(0, 10.0)])
+    assert empty["alerts"] == {"ranks_reporting": 0, "alerts": [],
+                               "firing": []}
+    assert empty["record"]["slo"]["alerts_firing"] == 0
+    json.dumps(report)

@@ -716,3 +716,52 @@ def test_stress_continuous_churn_parity():
                for i in range(64)]
     multi = cb.decode(prompts, max_new_tokens=16)
     assert multi == _sequential_reference(prompts, 16, max_len=40)
+
+
+def test_projected_p99_gauge_tracks_queue_backlog():
+    """serve.projected_p99_ms{tenant} is a collect-time function gauge over
+    SLOPolicy.projected_p99: equal to the observed p99 on an empty queue,
+    inflated by the queued-dispatch factor under backlog."""
+    slo = SLOPolicy(p99_ms=None, min_samples=1)
+    depth = {"n": 0}
+    slo.bind_queue(lambda: depth["n"], 8)
+    for _ in range(20):
+        slo.observe("t_proj", "4", 10.0)
+    gauge = monitor.default_registry().get("serve.projected_p99_ms")
+    observed = slo.observed_p99("t_proj")
+    assert 9.0 <= observed <= 11.0
+    # empty queue: the projection IS the observed p99
+    assert gauge.value(tenant="t_proj") == pytest.approx(observed)
+    # backlog: 64 queued rows / max_batch 8 -> 8 full dispatches ahead
+    depth["n"] = 64
+    assert gauge.value(tenant="t_proj") == pytest.approx(observed * 9.0)
+    assert slo.projected_p99("t_proj", 64, 8) == \
+        pytest.approx(observed * 9.0)
+    # the gauge rides the normal exposition (history sampler's food)
+    labels = dict(
+        next(labels for labels, _ in gauge.samples()
+             if labels.get("tenant") == "t_proj"))
+    assert labels == {"tenant": "t_proj"}
+
+
+def test_frontend_binds_projection_to_live_queue_depth():
+    """Server wires its own queue into the policy at construction, so the
+    exported projection reflects real backlog without any polling."""
+    main, y, scope = _mlp_tenant()
+    slo = SLOPolicy(p99_ms=None, min_samples=1)
+    srv = Server(bucket_edges=(1,), max_wait_ms=0.0, slo=slo)
+    srv.add_tenant("t_bind", main, ["x"], [y], scope)
+    gauge = monitor.default_registry().get("serve.projected_p99_ms")
+    # server NOT started: submits queue up and hold queued rows
+    f1 = srv.submit("t_bind", {"x": np.zeros((1, 8), np.float32)})
+    f2 = srv.submit("t_bind", {"x": np.zeros((1, 8), np.float32)})
+    slo.observe("t_bind", "1", 10.0)
+    backlog = gauge.value(tenant="t_bind")
+    assert backlog == pytest.approx(
+        slo.projected_p99("t_bind", 2, srv.max_batch))
+    assert backlog > slo.observed_p99("t_bind")
+    srv.start()                       # drain; projection falls back to p99
+    assert f1.result(timeout=60) and f2.result(timeout=60)
+    assert gauge.value(tenant="t_bind") == \
+        pytest.approx(slo.observed_p99("t_bind"))
+    srv.close()

@@ -399,3 +399,98 @@ def test_benchdiff_cli_selfcheck_and_verdict_line(tmp_path):
     verdict = json.loads(bad.stdout)
     assert verdict["verdict"] == "fail"
     assert verdict["regressions"][0]["metric"] == "tput"
+
+
+# ---------------------------------------------------------------------------
+# teardown hygiene + concurrent scrapes (the SLO-engine plane rides here)
+# ---------------------------------------------------------------------------
+
+def test_stop_telemetry_resets_providers_and_snapshots():
+    """stop_telemetry is full teardown: a restarted plane must not
+    resurrect the dead session's health providers or snapshots."""
+    srv = telemetry.start_telemetry(port=0)
+    telemetry.register_health_provider(
+        "t_stale", lambda: {"healthy": False, "detail": "stale"})
+    telemetry.publish_snapshot("xprof", {"mfu": 0.1})
+    status, _ = _get(srv.port, "/healthz")
+    assert status == 503
+    telemetry.stop_telemetry()
+    assert telemetry.get_server() is None
+    srv2 = telemetry.start_telemetry(port=0)
+    try:
+        status, doc = _get(srv2.port, "/healthz")
+        assert status == 200 and "t_stale" not in doc
+        status, _ = _get(srv2.port, "/xprof")
+        assert status == 404
+    finally:
+        telemetry.stop_telemetry()
+    telemetry.stop_telemetry()                    # idempotent
+    # per-instance TelemetryServer.stop() deliberately does NOT clear the
+    # process-wide provider registry (embedded servers share it)
+    telemetry.register_health_provider("t_keep", lambda: {"healthy": True})
+    try:
+        telemetry.TelemetryServer(port=0).start().stop()
+        assert "t_keep" in telemetry._health_providers
+    finally:
+        telemetry._health_providers.pop("t_keep", None)
+
+
+def test_concurrent_scrapes_with_live_writer(_server):
+    """Scrape threads hammer /metrics + /alerts + /history while a writer
+    records and the history sampler ticks: every response parses (no torn
+    prometheus text), no non-200, and /history's seq stays monotonic."""
+    import threading
+
+    from paddle_tpu.utils import slo
+
+    slo.reset()
+    try:
+        eng = slo.engine()
+        eng.register(slo.SLO("t-conc", "t.conc_gauge", ">", 1e9))
+        c = monitor.counter("t.conc_ctr", "")
+        g = monitor.gauge("t.conc_gauge", "")
+        h = monitor.histogram("t.conc_hist", "")
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                c.inc()
+                g.set(float(i % 7))
+                h.observe(float(i % 13))
+                eng.tick()
+                time.sleep(0.001)
+
+        def scraper():
+            last_seq = 0
+            while not stop.is_set():
+                try:
+                    st, text = _get(_server.port, "/metrics")
+                    assert st == 200
+                    parsed = monitor.parse_prometheus_text(text)
+                    assert parsed
+                    st, doc = _get(_server.port, "/alerts")
+                    assert st == 200 and doc["firing"] == []
+                    st, doc = _get(_server.port, "/history?max_points=16")
+                    assert st == 200
+                    assert doc["last_seq"] >= last_seq
+                    last_seq = doc["last_seq"]
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=writer)] + \
+            [threading.Thread(target=scraper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        slo.reset()
+        telemetry._health_providers.pop("slo", None)

@@ -20,6 +20,13 @@ into one job-level report:
 * **measured-vs-predicted calibration table** — ``/ledger`` records
   merged per (program x plan x mesh) key with latest + worst drift per
   cost model (utils/ledger.py bands attached),
+* **job-level SLO alert plane** — ``/alerts`` scraped per rank and
+  deduped by (slo, severity): one tenant's TTFT burning its budget on
+  every rank is ONE job alert listing the affected ranks, not N pages.
+  ``/history`` supplies per-rank ``slo.burn_rate`` series rendered as
+  text-mode sparklines, and ``--gate`` makes the exit code non-zero
+  while any job-level alert is firing — CI/benchdiff-style jobs fail on
+  burning SLOs like on any other regression,
 
 in ``--format text`` / ``--format json`` / ``--watch`` modes.  The JSON
 report carries a flat numeric ``record`` block, so it is directly
@@ -49,7 +56,7 @@ from paddle_tpu.utils import monitor as _monitor
 __all__ = ["scrape_rank", "merge", "render_text", "selfcheck", "main"]
 
 _DEF_TIMEOUT = 5.0
-_SCRAPE_PATHS = ("/metrics", "/healthz", "/ledger")
+_SCRAPE_PATHS = ("/metrics", "/healthz", "/ledger", "/alerts", "/history")
 
 # the fleet aggregator instruments itself through the same registry it
 # scrapes from others (tools/metricsdump --lint inventories these)
@@ -78,9 +85,10 @@ def _fetch(url: str, timeout: float) -> Tuple[int, str]:
 
 def scrape_rank(endpoint: str, timeout: float = _DEF_TIMEOUT,
                 since: int = 0) -> Dict[str, Any]:
-    """Scrape one rank's /metrics + /healthz + /ledger.  Legs fail
-    independently: a rank with a dead plane still appears in the merged
-    report (with per-leg errors) instead of sinking the whole job view."""
+    """Scrape one rank's /metrics + /healthz + /ledger + /alerts +
+    /history.  Legs fail independently: a rank with a dead plane still
+    appears in the merged report (with per-leg errors) instead of sinking
+    the whole job view."""
     out: Dict[str, Any] = {"endpoint": endpoint}
     for path in _SCRAPE_PATHS:
         _m_scrapes.inc(path=path)
@@ -88,6 +96,8 @@ def scrape_rank(endpoint: str, timeout: float = _DEF_TIMEOUT,
         url = f"http://{endpoint}{path}"
         if path == "/ledger":
             url += f"?since={int(since)}&n=500"
+        elif path == "/history":
+            url += "?max_points=64"
         try:
             status, body = _fetch(url, timeout)
         except Exception as e:
@@ -308,10 +318,18 @@ def merge(scrapes: List[Dict[str, Any]], straggler_factor: float = 2.0,
     # -- measured-vs-predicted calibration table --------------------------
     report["calibration"] = _calibration_table(scrapes)
 
+    # -- job-level SLO alert dedupe + burn-rate history -------------------
+    report["alerts"] = _alerts_section(scrapes, ranks)
+    report["burn_history"] = _burn_history(scrapes, ranks)
+
     # -- flat numeric verdict for tools/benchdiff -------------------------
     record: Dict[str, Any] = {
         "fleet": {"nranks": len(scrapes), "healthy_ranks": healthy,
                   "stragglers": len(stragglers)},
+        "slo": {"alerts_firing": len(report["alerts"]["firing"]),
+                "pages_firing": sum(
+                    1 for a in report["alerts"]["firing"]
+                    if a["severity"] == "page")},
     }
     if skew is not None:
         record["fleet"]["step_time_skew"] = round(skew, 4)
@@ -378,6 +396,82 @@ def _calibration_table(scrapes: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {"bands": bands, "programs": table, "worst_drift": worst}
 
 
+def _alerts_section(scrapes: List[Dict[str, Any]],
+                    ranks: List[int]) -> Dict[str, Any]:
+    """Per-rank /alerts legs deduped into job-level alerts: one entry per
+    (slo, severity) in a non-ok state, listing which ranks report it and
+    the worst burn rates seen — the job view an operator (or the --gate
+    exit code) acts on."""
+    job: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    reporting = 0
+    for rank, s in zip(ranks, scrapes):
+        al = s.get("alerts")
+        if not _scrape_ok(al):
+            continue
+        reporting += 1
+        for a in al.get("alerts", []):
+            state = a.get("state", "ok")
+            if state in ("ok",):
+                continue
+            key = (str(a.get("slo")), str(a.get("severity")))
+            row = job.setdefault(key, {
+                "slo": key[0], "severity": key[1], "state": state,
+                "metric": a.get("metric"), "ranks": [],
+                "burn_short": 0.0, "burn_long": 0.0})
+            row["ranks"].append(rank)
+            row["burn_short"] = max(row["burn_short"],
+                                    float(a.get("burn_short") or 0.0))
+            row["burn_long"] = max(row["burn_long"],
+                                   float(a.get("burn_long") or 0.0))
+            # firing on ANY rank makes the job alert firing; otherwise
+            # keep the most advanced state seen (pending > resolved)
+            order = {"resolved": 0, "pending": 1, "firing": 2}
+            if order.get(state, 0) > order.get(row["state"], 0):
+                row["state"] = state
+    rows = [job[k] for k in sorted(job)]
+    return {
+        "ranks_reporting": reporting,
+        "alerts": rows,
+        "firing": [r for r in rows if r["state"] == "firing"],
+    }
+
+
+def _burn_history(scrapes: List[Dict[str, Any]], ranks: List[int],
+                  max_points: int = 32) -> Dict[str, Dict[str, List[float]]]:
+    """{burn-rate series: {rank: [values]}} off the /history legs — the
+    sparkline data, also JSON-exported so dashboards can re-render it."""
+    out: Dict[str, Dict[str, List[float]]] = {}
+    for rank, s in zip(ranks, scrapes):
+        hist = s.get("history")
+        if not _scrape_ok(hist):
+            continue
+        for name, doc in (hist.get("series") or {}).items():
+            if not name.startswith("slo.burn_rate{"):
+                continue
+            values = [float(p[2]) for p in (doc.get("samples") or [])]
+            if values:
+                out.setdefault(name, {})[str(rank)] = values[-max_points:]
+    return out
+
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 24) -> str:
+    """Unicode sparkline, normalized to the series max (min pinned at 0 so
+    a burn rate of 0 renders as the baseline glyph)."""
+    if not values:
+        return ""
+    if len(values) > width:
+        stride = len(values) / float(width)
+        values = [values[min(len(values) - 1, int(i * stride))]
+                  for i in range(width)]
+    hi = max(max(values), 1e-12)
+    return "".join(_SPARK_GLYPHS[min(len(_SPARK_GLYPHS) - 1,
+                                     int(v / hi * (len(_SPARK_GLYPHS) - 1)))]
+                   for v in values)
+
+
 # ---------------------------------------------------------------------------
 # Rendering.
 # ---------------------------------------------------------------------------
@@ -409,6 +503,23 @@ def render_text(report: Dict[str, Any]) -> str:
     gp = report["goodput"]
     if gp["mean_pct"] is not None:
         lines.append(f"goodput: min={gp['min_pct']}%  mean={gp['mean_pct']}%")
+    alerts = report.get("alerts") or {}
+    if alerts.get("alerts"):
+        lines.append(f"alerts ({alerts['ranks_reporting']} ranks "
+                     "reporting):")
+        for a in alerts["alerts"]:
+            lines.append(
+                f"  {a['state'].upper():<9} {a['slo']}:{a['severity']}  "
+                f"burn={a['burn_short']:.1f}/{a['burn_long']:.1f}  "
+                f"ranks={a['ranks']}")
+    elif alerts.get("ranks_reporting"):
+        lines.append(f"alerts: none firing "
+                     f"({alerts['ranks_reporting']} ranks reporting)")
+    for name, per_rank in sorted((report.get("burn_history") or {}).items()):
+        for rank in sorted(per_rank, key=int):
+            values = per_rank[rank]
+            lines.append(f"  {name} r{rank} {_sparkline(values)} "
+                         f"{values[-1]:.2f}")
     cal = report["calibration"]
     if cal["programs"]:
         lines.append(f"calibration ({len(cal['programs'])} programs, "
@@ -431,7 +542,7 @@ def render_text(report: Dict[str, Any]) -> str:
 # ---------------------------------------------------------------------------
 _REPORT_KEYS = ("schema", "nranks", "healthy_ranks", "ranks", "skew",
                 "watchdog", "comm_imbalance", "goodput", "calibration",
-                "record")
+                "alerts", "burn_history", "record")
 
 
 def selfcheck(verbose: bool = True) -> int:
@@ -479,6 +590,9 @@ def selfcheck(verbose: bool = True) -> int:
         # both /ledger legs answered (global ledger; possibly empty)
         for rank in ("0", "1"):
             assert "ledger_records" in report["ranks"][rank]
+        # both /alerts legs answered (global engine; possibly not running)
+        assert report["alerts"]["ranks_reporting"] == 2, report["alerts"]
+        assert "alerts_firing" in report["record"]["slo"]
         json.dumps(report)  # the whole report must be JSON-clean
         if verbose:
             print(json.dumps({"selfcheck": "pass",
@@ -526,6 +640,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--selfcheck", action="store_true",
                         help="spin 2 in-process servers, scrape, assert "
                         "the merged report (CI smoke)")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit non-zero (3) while any job-level SLO "
+                        "alert is firing — CI/benchdiff-style jobs fail "
+                        "on burning SLOs")
     args = parser.parse_args(argv)
 
     if args.selfcheck:
@@ -542,6 +660,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=2, sort_keys=True)
+        if args.gate and report["alerts"]["firing"]:
+            names = [f"{a['slo']}:{a['severity']}"
+                     for a in report["alerts"]["firing"]]
+            print(f"fleetview: gate FAILED — firing: {', '.join(names)}",
+                  file=sys.stderr)
+            return 3
         if not args.watch:
             return 0
         try:

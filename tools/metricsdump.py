@@ -117,6 +117,7 @@ _KNOWN_NAMES = frozenset({
     "serve.load_shed",
     "serve.peak_temp_bytes",
     "serve.program_evictions",
+    "serve.projected_p99_ms",
     "serve.queue_depth",
     "serve.request_ms",
     "serve.requests",
@@ -127,6 +128,10 @@ _KNOWN_NAMES = frozenset({
     "serve.ttft_p50_ms",
     "serve.ttft_p99_ms",
     "serve.ttft_queue_ms",
+    # utils/slo.py (the SLO engine's own instruments)
+    "slo.alerts_firing",
+    "slo.burn_rate",
+    "slo.evaluations",
     # utils/telemetry.py (the HTTP exposition plane)
     "telemetry.port",
     "telemetry.requests",
@@ -193,6 +198,7 @@ def _register_instrumented_modules() -> None:
     import paddle_tpu.static.passes  # noqa: F401 — passes.* + quant.*
     import paddle_tpu.utils.debug  # noqa: F401
     import paddle_tpu.utils.ledger  # noqa: F401 — the ledger.* family
+    import paddle_tpu.utils.slo  # noqa: F401 — the slo.* family
     import paddle_tpu.utils.telemetry  # noqa: F401 — the telemetry.* family
     import paddle_tpu.utils.watchdog  # noqa: F401 — watchdog.* + goodput
     import paddle_tpu.utils.xprof  # noqa: F401 — the xprof.* family
@@ -216,6 +222,26 @@ def lint_names(registry) -> list:
     return bad
 
 
+def lint_objectives(path: str) -> list:
+    """(name, problem) pairs for an SLO objective file: parse failures and
+    objectives whose metric is missing from the known-names inventory —
+    an alert rule keying on a metric nothing registers would silently
+    never fire."""
+    from paddle_tpu.utils import slo as _slo
+
+    try:
+        objectives = _slo.load_objectives(path)
+    except (OSError, ValueError) as e:
+        return [(path, f"objective file failed to load: {e}")]
+    bad = []
+    for s in objectives:
+        if s.metric not in _KNOWN_NAMES and not s.metric.startswith("t."):
+            bad.append((s.metric,
+                        f"SLO {s.name!r} references a metric not in the "
+                        "metricsdump known-names inventory"))
+    return bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.metricsdump", description=__doc__,
@@ -232,6 +258,10 @@ def main(argv=None) -> int:
     parser.add_argument("--lint", action="store_true",
                         help="lint registered metric names instead of "
                         "running the workload dump")
+    parser.add_argument("--objectives", default=None, metavar="FILE",
+                        help="with --lint: also validate this SLO objective "
+                        "file (utils/slo.py format) — fails on objectives "
+                        "referencing metrics missing from the inventory")
     args = parser.parse_args(argv)
 
     from paddle_tpu.utils import monitor, profiler
@@ -241,12 +271,16 @@ def main(argv=None) -> int:
 
     if args.lint:
         bad = lint_names(registry)
+        if args.objectives:
+            bad.extend(lint_objectives(args.objectives))
         if bad:
             for name, problem in bad:
                 print(f"metricsdump: bad metric name {name!r}: {problem}",
                       file=sys.stderr)
             return 1
-        print(f"metricsdump: {len(registry.names())} metric names OK")
+        print(f"metricsdump: {len(registry.names())} metric names OK"
+              + (f" (+ objectives {args.objectives} OK)"
+                 if args.objectives else ""))
         return 0
 
     profiler.start_profiler()

@@ -1,0 +1,185 @@
+"""Validate an SLO objective file against the metric inventory.
+
+The SLO engine (paddle_tpu/utils/slo.py) accepts objective files in TOML
+or JSON; a typo'd metric name, a bad comparator or an inverted window
+pair would otherwise ship silently and the alert would simply never fire.
+This tool is the pre-flight check:
+
+* **structural** — the file parses, every SLO/Window field validates
+  (op, objective_pct range, short < long, burn > 0, known severity,
+  unique names): exactly the checks `load_objectives` enforces at engine
+  start, surfaced at review time instead of flight-recorded at run time.
+* **inventory** — every referenced metric exists: against the
+  `tools/metricsdump` known-names inventory by default, against a live
+  telemetry plane with ``--live HOST:PORT`` (scrapes ``/metrics``), or
+  against a dumped Prometheus text file with ``--prom FILE``.
+
+Usage::
+
+    python -m tools.slocheck objectives.toml
+    python -m tools.slocheck objectives.json --live 127.0.0.1:9100
+    python -m tools.slocheck objectives.toml --prom metrics.prom
+    python -m tools.slocheck --selfcheck      # rides tier-1
+
+``--selfcheck`` validates the engine's shipped default objectives against
+the inventory (so a default referencing a renamed metric fails CI) and
+asserts that a deliberately broken file is rejected with a useful
+diagnostic.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import urllib.request
+
+_BAD_FILE = """\
+[[slo]]
+name = "broken"
+metric = "serve.no_such_metric"
+op = "!="
+threshold = 1.0
+objective_pct = 150.0
+windows = [ { short_secs = 3600, long_secs = 300, burn = -1, severity = "sms" } ]
+"""
+
+
+def _prom_base_names(text: str) -> set:
+    """Metric base names present in a Prometheus text exposition, with the
+    histogram _bucket/_sum/_count expansion folded back."""
+    from paddle_tpu.utils.monitor import parse_prometheus_text
+
+    names = set()
+    for (name, _labels) in parse_prometheus_text(text):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        names.add(name)
+    return names
+
+
+def check_file(path: str, prom_names: set = None) -> list:
+    """Problems with one objective file as (subject, problem) pairs.
+    ``prom_names`` switches the inventory to a Prometheus name set (live
+    scrape or dump); default is the metricsdump known-names inventory."""
+    from paddle_tpu.utils import slo as _slo
+
+    try:
+        objectives = _slo.load_objectives(path)
+    except OSError as e:
+        return [(path, f"cannot read: {e}")]
+    except ValueError as e:
+        return [(path, f"invalid: {e}")]
+    problems = []
+    for s in objectives:
+        if prom_names is not None:
+            # prometheus renders dots as underscores
+            if s.metric.replace(".", "_") not in prom_names:
+                problems.append(
+                    (s.metric, f"SLO {s.name!r}: metric not present in the "
+                               "scraped/dumped exposition"))
+        else:
+            from tools.metricsdump import _KNOWN_NAMES
+            if s.metric not in _KNOWN_NAMES and not s.metric.startswith("t."):
+                problems.append(
+                    (s.metric, f"SLO {s.name!r}: metric not in the "
+                               "metricsdump known-names inventory"))
+    return problems
+
+
+def selfcheck() -> int:
+    """Shipped defaults validate clean; a seeded-bad file is rejected."""
+    from paddle_tpu.utils import slo as _slo
+    from tools.metricsdump import _KNOWN_NAMES
+
+    failures = []
+    for s in _slo.default_objectives():
+        if s.metric not in _KNOWN_NAMES:
+            failures.append(f"default objective {s.name!r} references "
+                            f"unknown metric {s.metric!r}")
+    # default windows must be well-formed SRE pairs
+    for w in _slo.DEFAULT_WINDOWS:
+        if not (w.short_secs < w.long_secs and w.burn > 0):
+            failures.append(f"default window {w!r} is malformed")
+    # a deliberately broken file must be rejected at parse/validate time
+    fd, bad_path = tempfile.mkstemp(suffix=".toml", prefix="slocheck_bad_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(_BAD_FILE)
+        if not check_file(bad_path):
+            failures.append("seeded-bad objective file validated clean "
+                            "(the validator is not checking)")
+    finally:
+        os.unlink(bad_path)
+    # and the round trip: defaults serialize -> parse -> same objectives
+    doc = {"slo": [s.to_json() for s in _slo.default_objectives()]}
+    parsed = _slo.parse_objectives(doc)
+    if [s.name for s in parsed] != [s.name
+                                    for s in _slo.default_objectives()]:
+        failures.append("default objectives do not round-trip through "
+                        "parse_objectives")
+    for f_ in failures:
+        print(f"slocheck: FAIL: {f_}", file=sys.stderr)
+    if failures:
+        return 1
+    print(f"slocheck: selfcheck OK ({len(_slo.default_objectives())} "
+          "default objectives)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.slocheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("file", nargs="?", default=None,
+                        help="objective file (TOML or JSON) to validate")
+    parser.add_argument("--live", default=None, metavar="HOST:PORT",
+                        help="validate metric names against a live "
+                        "telemetry plane's /metrics instead of the "
+                        "static inventory")
+    parser.add_argument("--prom", default=None, metavar="FILE",
+                        help="validate metric names against a dumped "
+                        "Prometheus text file (metricsdump --out)")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="validate the shipped default objectives and "
+                        "the validator itself (CI mode)")
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if args.file is None:
+        parser.error("an objective file (or --selfcheck) is required")
+
+    prom_names = None
+    if args.live:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{args.live}/metrics", timeout=5.0) as r:
+                prom_names = _prom_base_names(r.read().decode("utf-8"))
+        except OSError as e:
+            print(f"slocheck: cannot scrape {args.live}: {e}",
+                  file=sys.stderr)
+            return 2
+    elif args.prom:
+        try:
+            with open(args.prom, "r", encoding="utf-8") as f:
+                prom_names = _prom_base_names(f.read())
+        except OSError as e:
+            print(f"slocheck: cannot read {args.prom}: {e}", file=sys.stderr)
+            return 2
+
+    problems = check_file(args.file, prom_names)
+    for subject, problem in problems:
+        print(f"slocheck: {subject}: {problem}", file=sys.stderr)
+    if problems:
+        return 1
+    from paddle_tpu.utils import slo as _slo
+    n = len(_slo.load_objectives(args.file))
+    print(f"slocheck: {args.file}: {n} objectives OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
