@@ -40,7 +40,7 @@ from ..utils import monitor as _monitor
 from ..utils import trace as _trace
 
 __all__ = ["save_table_snapshot", "load_table_snapshot", "SnapshotError",
-           "TableSnapshotter", "StandbyServer"]
+           "TableSnapshotter", "StandbyServer", "replan_for_survivors"]
 
 _MAGIC = b"PDES"
 _SCHEMA = 1
@@ -250,3 +250,26 @@ class StandbyServer:
             self._thread = None
         if self.server is not None:
             self.server.stop()
+
+
+def replan_for_survivors(program, world: int, devices=None,
+                         feed_shapes=None, fetch_names=(),
+                         reason: str = "eviction"):
+    """Re-derive the sharding plan for the post-eviction world — the
+    elastic bridge to the autoplan search (parallel/autoplan.py).
+
+    After ``ElasticMember.detect_and_evict`` shrinks membership, the
+    surviving ranks must agree on a plan for the smaller mesh before the
+    resharding-checkpoint restore (elastic/checkpoint.py) places state.
+    Instead of every call site hand-sizing a plan for the new world, this
+    re-runs the cost-model search over the surviving device set — the
+    search is deterministic, so every survivor independently derives the
+    SAME plan (no coordination round) and the restore lands on the chosen
+    placement.  Records the ``autoplan_replan`` flight event with the
+    eviction reason; returns the PlanChoice (``.best`` is the plan)."""
+    from ..parallel import autoplan as _autoplan
+
+    return _autoplan.replan(program, devices=devices,
+                            feed_shapes=feed_shapes,
+                            fetch_names=fetch_names,
+                            world=world, reason=reason)

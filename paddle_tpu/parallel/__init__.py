@@ -16,7 +16,8 @@ Axes (any subset, any sizes):
   sp — sequence/context parallel (ring attention over sequence shards)
   ep — expert parallel (MoE expert sharding)
 """
-from . import collective, compress, embedding, mesh, metrics, sharding
+from . import autoplan, collective, compress, embedding, mesh, metrics, sharding
+from .autoplan import PlanChoice, resolve_auto
 from .embedding import (
     ShardedEmbedding,
     exchange_bytes,

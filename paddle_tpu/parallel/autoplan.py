@@ -1,0 +1,654 @@
+"""autoplan — cost-model-driven automatic sharding-plan search.
+
+Closes the loop ROADMAP item 2 promised: every pricing ingredient the
+static layer grew — shardcheck's comm estimate (SC001–SC010 validity +
+allreduce/gather/embedding-exchange wire bytes), memcheck's peak-HBM
+estimate (MC001 OOM oracle), xprof's device peak table, and the
+calibration ledger's measured-vs-predicted drift records — becomes the
+objective function of a plan search, so `ShardingPlan`s stop being
+hand-written.
+
+The search (arxiv 2112.02752's adaptive auto-parallel planner, with
+TACCL's sketch-guided pruning posture — enumerate a structured sketch
+space, reject statically, score survivors):
+
+1. **Enumerate** candidates over a mesh description: every (dp, tp)
+   factoring of the device count x zero_stage x state-placement rule set
+   (replicated / Megatron TRANSFORMER_RULES when names match / a derived
+   alternating column-row layout over the program's 2-D matmul weights) x
+   `embedding_shard` coverage of the program's lookup tables x
+   comm/embedding int8 quantization x donation.
+2. **Reject statically**: `shardcheck.verify_plan` errors (SC001–SC010)
+   and `memcheck.estimate_peak_cached` over-capacity predictions (MC001)
+   prune a candidate before anything compiles — pruned plans never trace.
+3. **Score survivors** in milliseconds-per-step:
+
+       score = roofline_ms * c_roof + comm_ms * 1 + headroom_penalty
+       comm_ms = comm_bytes * c_comm / wire_bw
+       headroom_penalty ramps as corrected peak HBM approaches capacity
+
+   where each static estimate is multiplied by the per-model drift
+   correction ``c_leg`` = median(measured / predicted) the calibration
+   ledger (utils/ledger.py) has recorded for this program fingerprint
+   (fleet-wide records as fallback, 1.0 cold) — scores track reality,
+   not the model.
+4. **Return** the best plan plus the full ranked report (CLI:
+   tools/autoplan renders it as a table; `--measure-top K` executes the
+   leaders and fills in measured columns).
+
+`resolve_auto(program, mesh)` memoizes the winner by program-content x
+mesh fingerprints, so `CompiledProgram.with_sharding(plan="auto")`
+resolves through the search exactly once per (program, mesh): the chosen
+plan object (stable `.token`) rides the Executor's hot cache and its
+`fingerprint()` rides the persistent compile-cache key — zero
+steady-state retraces, and a warm disk cache still warm-starts because
+the search is deterministic.  `replan(...)` re-runs the search for a
+shrunk surviving mesh on elastic membership changes (elastic/failover)
+and flight-records the `autoplan_replan` decision.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import mesh as _mesh
+from .sharding import ShardingPlan, TRANSFORMER_RULES
+from ..utils import monitor as _monitor
+from ..utils import trace as _trace
+
+__all__ = [
+    "Candidate", "PlanChoice", "mesh_factorings", "enumerate_candidates",
+    "drift_corrections", "search", "resolve_auto", "replan",
+    "reset_auto_cache",
+]
+
+_m_searches = _monitor.counter(
+    "autoplan.searches", "plan searches run (search/resolve_auto)")
+_m_candidates = _monitor.counter(
+    "autoplan.candidates", "candidate plans considered, by outcome",
+    labelnames=("status",))
+_m_search_ms = _monitor.histogram(
+    "autoplan.search_ms", "wall ms per plan search",
+    buckets=(10, 50, 100, 500, 1000, 5000, 20000))
+_m_replans = _monitor.counter(
+    "autoplan.replans", "elastic re-plans on membership change")
+
+# wire (ICI/network) bandwidth modeled as this fraction of the device's
+# HBM stream rate — a sketch constant the ledger's comm drift corrects
+_WIRE_FRACTION = 0.1
+# headroom: score is flat below this HBM utilization, then ramps
+_HEADROOM_KNEE = 0.8
+_HEADROOM_WEIGHT = 0.5
+# drift corrections clamp here: one absurd ledger record (a 0-byte
+# measurement, a stalled step) must not invert every ranking
+_CORRECTION_BAND = (1.0 / 16.0, 16.0)
+
+_STATUS_OK = "ok"
+_STATUS_SC = "sc_invalid"
+_STATUS_MC = "mc_oom"
+
+
+@dataclass
+class Candidate:
+    """One enumerated plan and everything the search learned about it."""
+
+    plan: ShardingPlan
+    desc: Dict[str, Any]               # dp/tp/zero/placement/emb/quantize...
+    status: str = _STATUS_OK           # ok | sc_invalid | mc_oom
+    pruned_codes: Tuple[str, ...] = ()
+    predicted: Dict[str, float] = field(default_factory=dict)
+    corrected: Dict[str, float] = field(default_factory=dict)
+    score: Optional[float] = None      # corrected ms/step; None when pruned
+    measured: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def label(self) -> str:
+        d = self.desc
+        bits = [f"dp{d.get('dp', '?')}x tp{d.get('tp', '?')}",
+                f"zero{d.get('zero', 0)}", str(d.get("placement", "rep"))]
+        if d.get("embedding"):
+            bits.append(f"emb:{d['embedding']}")
+        if d.get("quantize"):
+            bits.append(f"q:{d['quantize']}")
+        if not d.get("donate", True):
+            bits.append("nodonate")
+        return " ".join(bits)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "label": self.label, "desc": dict(self.desc),
+            "status": self.status, "pruned_codes": list(self.pruned_codes),
+            "predicted": dict(self.predicted),
+            "corrected": dict(self.corrected),
+            "score": self.score, "measured": dict(self.measured),
+            "fingerprint": self.plan.fingerprint(),
+        }
+
+
+@dataclass
+class PlanChoice:
+    """search() output: the winner plus the ranked candidate report."""
+
+    best: Optional[ShardingPlan]
+    candidates: List[Candidate]        # ok (ranked by score) first, pruned last
+    corrections: Dict[str, float] = field(default_factory=dict)
+    program_fp: str = ""
+    mesh_fp: str = ""
+    search_ms: float = 0.0
+
+    @property
+    def ranked(self) -> List[Candidate]:
+        return [c for c in self.candidates if c.status == _STATUS_OK]
+
+    @property
+    def pruned(self) -> List[Candidate]:
+        return [c for c in self.candidates if c.status != _STATUS_OK]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "best": self.best.fingerprint() if self.best is not None else None,
+            "corrections": dict(self.corrections),
+            "program": self.program_fp, "mesh": self.mesh_fp,
+            "search_ms": self.search_ms,
+            "candidates": [c.to_dict() for c in self.candidates],
+        }
+
+    def render(self, top: Optional[int] = None) -> str:
+        """The ranked table (predicted + corrected + measured columns)."""
+        rows = [("rank", "plan", "comm_kb", "peak_mb", "roof_ms",
+                 "score_ms", "meas_ms", "status")]
+        shown = self.candidates if top is None else self.candidates[:top]
+        for i, c in enumerate(shown):
+            rows.append((
+                str(i + 1), c.label,
+                f"{c.predicted.get('comm_bytes', 0) / 1024:.1f}",
+                f"{c.predicted.get('peak_hbm_bytes', 0) / (1 << 20):.1f}",
+                f"{c.corrected.get('roofline_ms', 0):.3f}",
+                f"{c.score:.3f}" if c.score is not None else "-",
+                f"{c.measured['step_time_ms']:.3f}"
+                if "step_time_ms" in c.measured else "-",
+                c.status + (":" + ",".join(c.pruned_codes)
+                            if c.pruned_codes else "")))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        lines = ["  ".join(cell.ljust(w) for cell, w in zip(r, widths))
+                 for r in rows]
+        corr = " ".join(f"{k}={v:.3g}" for k, v in
+                        sorted(self.corrections.items()))
+        lines.append(f"corrections: {corr or '-'}   "
+                     f"search: {self.search_ms:.0f}ms   "
+                     f"ok={len(self.ranked)} pruned={len(self.pruned)}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Candidate enumeration
+# ---------------------------------------------------------------------------
+
+def mesh_factorings(n: int) -> List[Tuple[int, int]]:
+    """Every (dp, tp) factoring of ``n`` devices, dp-major first (the
+    all-data-parallel plan is the baseline every search must contain)."""
+    n = max(1, int(n))
+    out = [(dp, n // dp) for dp in range(n, 0, -1) if n % dp == 0]
+    return out
+
+
+def _devices_of(mesh=None, devices=None) -> List[Any]:
+    if mesh is not None:
+        return list(np.asarray(mesh.devices).reshape(-1))
+    if devices is not None:
+        return list(devices)
+    import jax
+
+    return list(jax.devices())
+
+
+def _mesh_for(devices: Sequence[Any], dp: int, tp: int):
+    """The candidate mesh: 1-axis dp when tp==1 (fingerprint-compatible
+    with hand-written data-parallel plans), (dp, tp) otherwise."""
+    from jax.sharding import Mesh
+
+    arr = np.asarray(devices)
+    if tp <= 1:
+        return Mesh(arr, (_mesh.DP_AXIS,))
+    return Mesh(arr.reshape(dp, tp), (_mesh.DP_AXIS, _mesh.TP_AXIS))
+
+
+def _trainable_mats(program) -> List[Tuple[str, Tuple[int, ...]]]:
+    out = []
+    for p in program.all_parameters():
+        shape = tuple(p.shape)
+        if p.trainable and len(shape) == 2 and all(
+                isinstance(d, (int, np.integer)) and d > 0 for d in shape):
+            out.append((p.name, shape))
+    return out
+
+
+def _lookup_tables(program) -> Dict[str, Tuple[int, ...]]:
+    """{table name: shape} of every state var a lookup op reads."""
+    from ..static.shardcheck import _LOOKUP_OPS, _state_vars
+
+    state = {name: shape for name, shape, _dt, _tr in _state_vars(program)
+             if shape}
+    tables = {}
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type not in _LOOKUP_OPS:
+                continue
+            names = op.inputs.get("W", ())
+            if names and names[0] in state:
+                tables[names[0]] = state[names[0]]
+    return tables
+
+
+def _alt_annotations(program, tp: int,
+                     tables: Dict[str, Tuple[int, ...]]
+                     ) -> Optional[Dict[str, Tuple]]:
+    """Derived Megatron-style layout for programs whose parameter names
+    match no rule table: alternate column-parallel / row-parallel over the
+    2-D trainable weights (declaration order ~ layer order, so pairs of
+    adjacent layers cancel their gathers), skipping embedding tables
+    (embedding_shard owns those) and indivisible dims."""
+    ann: Dict[str, Tuple] = {}
+    col = True
+    for name, shape in _trainable_mats(program):
+        if name in tables:
+            continue
+        if col and shape[1] % tp == 0:
+            ann[name] = (None, _mesh.TP_AXIS)
+            col = False
+        elif not col and shape[0] % tp == 0:
+            ann[name] = (_mesh.TP_AXIS, None)
+            col = True
+    return ann or None
+
+
+def _placement_options(program, tp: int,
+                       tables: Dict[str, Tuple[int, ...]]
+                       ) -> List[Tuple[str, Any, Any]]:
+    """(label, rules, annotations) placement alternatives for one tp size."""
+    opts: List[Tuple[str, Any, Any]] = [("rep", None, None)]
+    if tp <= 1:
+        return opts
+    names = [n for n, _s in _trainable_mats(program)]
+    if any(TRANSFORMER_RULES.match(n, 2) is not None for n in names):
+        opts.append(("megatron", TRANSFORMER_RULES, None))
+    alt = _alt_annotations(program, tp, tables)
+    if alt:
+        opts.append(("altmm", None, alt))
+    return opts
+
+
+def enumerate_candidates(program, devices: Sequence[Any],
+                         zero_stages: Sequence[int] = (0, 1, 2, 3),
+                         quantize_kinds: Sequence[str] = ("", "int8"),
+                         ) -> List[Tuple[ShardingPlan, Dict[str, Any]]]:
+    """The structured sketch space: every (dp, tp) factoring x zero stage x
+    placement rule set x embedding coverage x quantization.  Donation
+    starts True everywhere; `search` retries donation-blocked candidates
+    with donate=False (SC-pruned plans whose only finding is the donation
+    check)."""
+    tables = _lookup_tables(program)
+    out: List[Tuple[ShardingPlan, Dict[str, Any]]] = []
+    n = len(devices)
+    for dp, tp in mesh_factorings(n):
+        mesh = _mesh_for(devices, dp, tp)
+        emb_opts: List[Optional[str]] = [None]
+        if tp > 1 and any(shape[0] % tp == 0 and len(shape) >= 2
+                          for shape in tables.values()):
+            emb_opts.append(_mesh.TP_AXIS)
+        for placement, rules, ann in _placement_options(program, tp, tables):
+            for emb in emb_opts:
+                if placement == "megatron" and emb is not None:
+                    # TRANSFORMER_RULES already vocab-shards embeddings
+                    continue
+                for zero in zero_stages:
+                    if zero and dp <= 1:
+                        continue       # nothing to shard states over
+                    for q in quantize_kinds:
+                        plan = ShardingPlan(
+                            mesh=mesh, rules=rules, annotations=ann,
+                            zero_stage=zero, donate=True,
+                            comm_quantize=q,
+                            embedding_shard=emb,
+                            embedding_quantize=q if emb is not None else "")
+                        out.append((plan, {
+                            "dp": dp, "tp": tp, "zero": zero,
+                            "placement": placement, "embedding": emb,
+                            "quantize": q, "donate": True}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Ledger drift corrections
+# ---------------------------------------------------------------------------
+
+_LEG_KEYS = (("comm", "comm_bytes", "allreduce_bytes"),
+             ("mem", "peak_hbm_bytes", "mem_total_bytes"),
+             ("roofline", "roofline_ms", "step_time_ms"))
+
+
+def drift_corrections(program_fp: Optional[str] = None,
+                      records: Optional[List[Dict[str, Any]]] = None
+                      ) -> Dict[str, float]:
+    """Directional per-leg correction ratios from the calibration ledger:
+    median(measured / predicted) over this program's records (every record
+    as the fleet-level prior when the program has none, 1.0 cold).  The
+    ledger's own ``drift`` field is symmetric — max(p/m, m/p), an alarm
+    signal — so corrections recompute direction from the raw legs."""
+    if records is None:
+        try:
+            from ..utils import ledger as _ledger
+
+            records = _ledger.ledger().records()
+        except Exception:
+            records = []
+    mine = [r for r in records
+            if program_fp and (r.get("key") or {}).get("program")
+            == program_fp]
+    pool = mine or records
+    out = {}
+    lo, hi = _CORRECTION_BAND
+    for leg, pk, mk in _LEG_KEYS:
+        ratios = []
+        for r in pool:
+            p = (r.get("predicted") or {}).get(pk)
+            m = (r.get("measured") or {}).get(mk)
+            if p and m and p > 0 and m > 0:
+                ratios.append(float(m) / float(p))
+        out[leg] = (min(hi, max(lo, float(np.median(ratios))))
+                    if ratios else 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scoring
+# ---------------------------------------------------------------------------
+
+def _batch_size(program, feed_shapes) -> int:
+    for shape in (feed_shapes or {}).values():
+        if shape and isinstance(shape[0], (int, np.integer)) and shape[0] > 0:
+            return int(shape[0])
+    try:
+        for v in program.list_vars():
+            if getattr(v, "is_data", False) and tuple(v.shape):
+                b = tuple(v.shape)[0]
+                if isinstance(b, (int, np.integer)) and b > 0:
+                    return int(b)
+    except Exception:
+        pass
+    return 32
+
+
+def _flops_profile(program, feed_shapes) -> Tuple[List[Tuple[str, int]], int]:
+    """([(weight name, global flops)], backward multiplier): one entry per
+    contraction site, 2 * batch * prod(weight shape) flops each — the
+    plan-independent part of the roofline numerator (per-candidate the
+    divisors apply)."""
+    from ..static.backward import GRAD_SUFFIX
+    from ..static.shardcheck import _CONTRACTION_OPS, _state_vars
+
+    state = {name: shape for name, shape, _dt, _tr in _state_vars(program)
+             if shape}
+    batch = _batch_size(program, feed_shapes)
+    sites: List[Tuple[str, int]] = []
+    for block in program.blocks:
+        for op in block.ops:
+            slot_fn = _CONTRACTION_OPS.get(op.type)
+            if slot_fn is None:
+                continue
+            names = op.inputs.get(slot_fn[0], ())
+            if not names or names[0] not in state:
+                continue
+            wshape = state[names[0]]
+            nelem = int(np.prod(wshape, dtype=np.int64)) if wshape else 1
+            sites.append((names[0], 2 * batch * nelem))
+    has_bwd = any(n.endswith(GRAD_SUFFIX)
+                  for b in program.blocks for n in b.vars)
+    return sites, (3 if has_bwd else 1)
+
+
+def _score_candidate(cand: Candidate, program, mesh, feed_shapes,
+                     flops_sites, bwd_mult, mem_est, comm_est,
+                     corrections, peaks) -> None:
+    """Fill cand.predicted / cand.corrected / cand.score (ms/step)."""
+    from ..static.shardcheck import _state_vars
+
+    plan = cand.plan
+    state = {n: s for n, s, _dt, _tr in _state_vars(program)}
+    batch_div = plan.batch_divisor(mesh)
+    flops = 0.0
+    for wname, site_flops in flops_sites:
+        div = batch_div
+        try:
+            div *= plan.placement_divisor(
+                wname, tuple(state.get(wname, ())), mesh)
+        except Exception:
+            pass
+        flops += site_flops * bwd_mult / max(1, div)
+    flops_ms = flops / max(peaks.flops_per_sec, 1.0) * 1e3
+    traffic = float(mem_est.args_bytes + mem_est.out_bytes
+                    + mem_est.temp_bytes) if mem_est is not None else 0.0
+    bytes_ms = traffic / max(peaks.bytes_per_sec, 1.0) * 1e3
+    roofline_ms = max(flops_ms, bytes_ms)
+
+    comm_bytes = float(comm_est.total_bytes) if comm_est is not None else 0.0
+    peak = float(mem_est.peak_bytes) if mem_est is not None else 0.0
+    capacity = (float(mem_est.capacity_bytes)
+                if mem_est is not None and mem_est.capacity_bytes else 0.0)
+
+    c = corrections
+    corr_comm = comm_bytes * c.get("comm", 1.0)
+    corr_peak = peak * c.get("mem", 1.0)
+    corr_roof = roofline_ms * c.get("roofline", 1.0)
+    wire_bw = max(peaks.bytes_per_sec * _WIRE_FRACTION, 1.0)
+    comm_ms = corr_comm / wire_bw * 1e3
+
+    penalty = 0.0
+    if capacity > 0 and corr_peak > _HEADROOM_KNEE * capacity:
+        util = corr_peak / capacity
+        penalty = ((util - _HEADROOM_KNEE) / (1.0 - _HEADROOM_KNEE)
+                   * _HEADROOM_WEIGHT * (corr_roof + comm_ms))
+
+    cand.predicted = {"comm_bytes": comm_bytes, "peak_hbm_bytes": peak,
+                      "roofline_ms": roofline_ms, "flops_ms": flops_ms,
+                      "bytes_ms": bytes_ms}
+    cand.corrected = {"comm_bytes": corr_comm, "peak_hbm_bytes": corr_peak,
+                      "roofline_ms": corr_roof, "comm_ms": comm_ms,
+                      "headroom_penalty": penalty}
+    cand.score = corr_roof + comm_ms + penalty
+
+
+# ---------------------------------------------------------------------------
+# The search
+# ---------------------------------------------------------------------------
+
+def score_plan(program, plan, feed_shapes=None, fetch_names=(),
+               corrections: Optional[Dict[str, float]] = None,
+               desc: Optional[Dict[str, Any]] = None) -> Candidate:
+    """Statically verify + price ONE plan (the same pipeline `search` runs
+    per candidate) — how a hand-written plan gets a comparable score."""
+    from ..static import memcheck as _memcheck
+    from ..static import shardcheck as _shardcheck
+    from ..utils import xprof as _xprof
+
+    mesh = plan.resolve_mesh()
+    if desc is None:
+        dp = plan.batch_divisor(mesh)
+        desc = {"dp": dp, "tp": int(mesh.devices.size) // max(1, dp),
+                "zero": plan.zero_stage, "placement": "hand",
+                "embedding": plan.embedding_shard,
+                "quantize": (plan.comm.quantize if plan.comm else ""),
+                "donate": plan.donate}
+    cand = Candidate(plan=plan, desc=desc)
+    corrections = corrections if corrections is not None else \
+        drift_corrections()
+    try:
+        report = _shardcheck.verify_plan(program, plan,
+                                         feed_shapes=feed_shapes)
+    except Exception:
+        cand.status = _STATUS_SC
+        cand.pruned_codes = ("SC000",)
+        return cand
+    errs = report.errors
+    if errs:
+        cand.status = _STATUS_SC
+        cand.pruned_codes = tuple(sorted({d.code for d in errs}))
+        return cand
+    mem = _memcheck.estimate_peak_cached(program, plan,
+                                         feed_arrays=feed_shapes,
+                                         fetch_names=tuple(fetch_names or ()))
+    if (mem is not None and mem.capacity_bytes
+            and mem.peak_bytes * corrections.get("mem", 1.0)
+            > mem.capacity_bytes):
+        cand.status = _STATUS_MC
+        cand.pruned_codes = ("MC001",)
+        cand.predicted = {"peak_hbm_bytes": float(mem.peak_bytes)}
+        return cand
+    peaks = _xprof.resolve_peaks()
+    flops_sites, bwd_mult = _flops_profile(program, feed_shapes)
+    _score_candidate(cand, program, mesh, feed_shapes, flops_sites,
+                     bwd_mult, mem, report.comm, corrections, peaks)
+    return cand
+
+
+def search(program, mesh=None, devices=None, feed_shapes=None,
+           fetch_names=(), corrections: Optional[Dict[str, float]] = None,
+           zero_stages: Sequence[int] = (0, 1, 2, 3),
+           quantize_kinds: Sequence[str] = ("", "int8")) -> PlanChoice:
+    """Enumerate, statically prune, and score candidate plans for
+    ``program`` over the given mesh/device description; return the best
+    plan plus the ranked report.  Nothing compiles or traces — the search
+    is pure static analysis, deterministic for a given (program, devices,
+    ledger state)."""
+    from ..static import compile_cache as _ccache
+
+    t0 = time.perf_counter()
+    devs = _devices_of(mesh, devices)
+    program_fp = _ccache.program_fingerprint(program)
+    if corrections is None:
+        corrections = drift_corrections(program_fp)
+    cands: List[Candidate] = []
+    for plan, desc in enumerate_candidates(program, devs,
+                                           zero_stages=zero_stages,
+                                           quantize_kinds=quantize_kinds):
+        cand = score_plan(program, plan, feed_shapes, fetch_names,
+                          corrections, desc)
+        if (cand.status == _STATUS_SC
+                and set(cand.pruned_codes) == {"SC004"}):
+            # only the donation check failed: the donate=False variant is
+            # the same plan minus buffer reuse — re-enter it
+            retry = ShardingPlan(
+                mesh=plan.resolve_mesh(), rules=plan.rules,
+                annotations=plan.annotations, zero_stage=plan.zero_stage,
+                donate=False,
+                comm_quantize=plan.comm.quantize if plan.comm else "",
+                embedding_shard=plan.embedding_shard,
+                embedding_quantize=plan.embedding_quantize)
+            cand = score_plan(program, retry, feed_shapes, fetch_names,
+                              corrections, dict(desc, donate=False))
+        cands.append(cand)
+        _m_candidates.inc(status=cand.status)
+    ok = sorted((c for c in cands if c.status == _STATUS_OK),
+                key=lambda c: (c.score, c.plan.fingerprint()))
+    pruned = [c for c in cands if c.status != _STATUS_OK]
+    choice = PlanChoice(
+        best=ok[0].plan if ok else None,
+        candidates=ok + pruned,
+        corrections=dict(corrections),
+        program_fp=program_fp,
+        mesh_fp=_mesh.mesh_fingerprint(_mesh_for(
+            devs, len(devs), 1)) if mesh is None
+        else _mesh.mesh_fingerprint(mesh),
+        search_ms=(time.perf_counter() - t0) * 1e3)
+    _m_searches.inc()
+    _m_search_ms.observe(choice.search_ms)
+    _trace.flight_recorder().record(
+        "autoplan_search", name=program_fp[:12],
+        candidates=len(cands), ok=len(ok), pruned=len(pruned),
+        chosen=ok[0].plan.fingerprint() if ok else None,
+        chosen_label=ok[0].label if ok else None,
+        score=ok[0].score if ok else None,
+        search_ms=choice.search_ms)
+    return choice
+
+
+# ---------------------------------------------------------------------------
+# plan="auto" resolution (CompiledProgram / DistributedStrategy)
+# ---------------------------------------------------------------------------
+
+_auto_lock = threading.Lock()
+_auto_memo: Dict[Tuple[str, str], ShardingPlan] = {}
+_AUTO_MEMO_CAP = 256
+
+
+def resolve_auto(program, mesh=None, feed=None, fetch_names=()) -> ShardingPlan:
+    """The `with_sharding(plan="auto")` entry point: run `search` once per
+    (program content, mesh) and pin the winner.  The memo returns the SAME
+    ShardingPlan object on every later resolution, so the Executor's hot
+    cache keys (plan.token) never churn — zero steady-state retraces — and
+    `plan.fingerprint()` rides the persistent compile-cache key, so a
+    second process searching deterministically warm-starts from disk."""
+    from ..static import compile_cache as _ccache
+    from ..static import memcheck as _memcheck
+
+    if mesh is None:
+        mesh = _mesh.get_mesh()
+    program_fp = _ccache.program_fingerprint(program)
+    mesh_fp = (_mesh.mesh_fingerprint(mesh) if mesh is not None
+               else f"devs:{len(_devices_of())}")
+    key = (program_fp, mesh_fp)
+    with _auto_lock:
+        hit = _auto_memo.get(key)
+    if hit is not None:
+        return hit
+    feed_shapes = _memcheck._feed_shape_dict(feed) if feed else None
+    choice = search(program, mesh=mesh, feed_shapes=feed_shapes,
+                    fetch_names=fetch_names)
+    if choice.best is None:
+        codes = sorted({c for cand in choice.candidates
+                        for c in cand.pruned_codes})
+        raise ValueError(
+            "autoplan: every candidate plan was statically rejected "
+            f"(codes: {', '.join(codes) or 'none'}) — fix the program or "
+            "relax the mesh/capacity constraints")
+    with _auto_lock:
+        while len(_auto_memo) >= _AUTO_MEMO_CAP:
+            _auto_memo.pop(next(iter(_auto_memo)))
+        _auto_memo[key] = choice.best
+    return choice.best
+
+
+def reset_auto_cache() -> None:
+    """Forget memoized plan choices (tests; ledger-state changes)."""
+    with _auto_lock:
+        _auto_memo.clear()
+
+
+# ---------------------------------------------------------------------------
+# Elastic re-planning (elastic/failover on membership change)
+# ---------------------------------------------------------------------------
+
+def replan(program, devices=None, feed_shapes=None, fetch_names=(),
+           world: Optional[int] = None, reason: str = "membership_change"
+           ) -> PlanChoice:
+    """Re-score the plan space for a surviving mesh after an elastic
+    membership change and flight-record the decision — the resharding
+    restore (elastic/checkpoint.py) should land on the *chosen* plan, not
+    a hand-me-down sized for the old world."""
+    devs = _devices_of(None, devices)
+    if world is not None:
+        devs = devs[:max(1, int(world))]
+    choice = search(program, devices=devs, feed_shapes=feed_shapes,
+                    fetch_names=fetch_names)
+    _m_replans.inc()
+    best = choice.ranked[0] if choice.ranked else None
+    _trace.flight_recorder().record(
+        "autoplan_replan", name=reason, world=len(devs),
+        chosen=choice.best.fingerprint() if choice.best else None,
+        chosen_label=best.label if best else None,
+        score=best.score if best else None)
+    return choice

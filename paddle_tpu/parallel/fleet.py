@@ -170,6 +170,12 @@ class DistributedStrategy:
         # mapped to the mesh: vocab-shard every lookup-op table
         self.sharded_embedding = False
         self.embedding_configs = EmbeddingConfig()
+        # cost-model-driven plan search (parallel/autoplan.py): the
+        # static-graph path resolves the whole ShardingPlan — mesh
+        # factoring, placement rules, zero stage, embedding coverage,
+        # quantization — at first run instead of honoring hand knobs;
+        # compose via auto_shard_plan(program, strategy)
+        self.auto_shard = False
         self.find_unused_parameters = False  # parity no-op
         self.fuse_all_reduce_ops = True      # parity no-op (XLA fuses)
         self.nccl_comm_num = 1               # parity no-op (ICI)
@@ -193,6 +199,28 @@ def embedding_plan_kwargs(strategy: DistributedStrategy) -> Dict[str, Any]:
     return {"embedding_shard": cfg.axis,
             "embedding_capacity": cfg.capacity_factor,
             "embedding_quantize": cfg.quantize}
+
+
+def auto_shard_plan(program, strategy: Optional[DistributedStrategy] = None,
+                    mesh=None, feed=None, fetch_names=()):
+    """Resolve a ``ShardingPlan`` for ``program`` through the autoplan
+    cost-model search (parallel/autoplan.py) — the static-graph face of
+    ``DistributedStrategy.auto_shard``::
+
+        strategy.auto_shard = True
+        plan = fleet.auto_shard_plan(main, strategy)
+        compiled = static.CompiledProgram(main).with_sharding(plan=plan)
+
+    Memoized by program-content x mesh fingerprints (resolve_auto), so
+    every rank of a job derives the same plan and the chosen fingerprint
+    rides the persistent compile-cache key.  With ``strategy.auto_shard``
+    off this returns None — callers fall through to hand-written knobs."""
+    if strategy is not None and not getattr(strategy, "auto_shard", False):
+        return None
+    from . import autoplan as _autoplan
+
+    return _autoplan.resolve_auto(program, mesh=mesh, feed=feed,
+                                  fetch_names=fetch_names)
 
 
 class _RoleMaker:

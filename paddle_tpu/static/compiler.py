@@ -73,6 +73,8 @@ class CompiledProgram:
         self._loss_name: Optional[str] = None
         self._places: Optional[Sequence] = None
         self._plan = None  # parallel.sharding.ShardingPlan, built lazily
+        self._auto_shard = False   # plan="auto": resolve via autoplan
+        self._auto_mesh = None
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -95,7 +97,8 @@ class CompiledProgram:
                       comm_hierarchy="auto",
                       embedding_shard=None,
                       embedding_capacity=None,
-                      embedding_quantize: str = "") -> "CompiledProgram":
+                      embedding_quantize: str = "",
+                      plan=None) -> "CompiledProgram":
         """Run this program's compiled step under NamedShardings on a mesh —
         the full hybrid-parallel face of the Executor fast path.
 
@@ -123,10 +126,30 @@ class CompiledProgram:
         axis and routes its lookups through the dedup + all_to_all
         exchange (parallel/embedding.py); ``embedding_capacity`` /
         ``embedding_quantize`` tune the exchange buffers and the backward
-        wire payload."""
+        wire payload.
+
+        ``plan`` short-circuits all of the above: a ready
+        ``ShardingPlan`` instance runs as-is, and the string ``"auto"``
+        defers to the cost-model search (parallel/autoplan.py) — the plan
+        is chosen at first run (memoized by program x mesh fingerprints,
+        so repeat programs and restarted processes re-derive the same
+        choice and keep their compile-cache warm starts); ``mesh`` then
+        names the device set to search over (default: the process
+        mesh/every local device)."""
         from ..parallel import mesh as _pmesh
         from ..parallel.sharding import ShardingPlan
 
+        if plan is not None:
+            if isinstance(plan, ShardingPlan):
+                self._plan = plan
+                return self
+            if plan == "auto":
+                self._plan = None
+                self._auto_shard = True
+                self._auto_mesh = mesh
+                return self
+            raise ValueError(
+                f"plan={plan!r}: expected a ShardingPlan or 'auto'")
         self._plan = ShardingPlan(
             mesh=mesh, rules=rules, annotations=annotations,
             zero_stage=zero_stage,
@@ -138,10 +161,22 @@ class CompiledProgram:
             embedding_quantize=embedding_quantize)
         return self
 
-    def _sharding_plan(self):
+    def _sharding_plan(self, feed=None, fetch_list=None):
         """The plan the Executor runs under (lazy: with_data_parallel only
         commits to a device list at first run, like the reference's deferred
-        ParallelExecutor construction).  None = single-device path."""
+        ParallelExecutor construction; plan="auto" commits at first run so
+        the search prices the real feed shapes).  None = single-device
+        path."""
+        if self._plan is None and self._auto_shard:
+            from ..parallel import autoplan as _autoplan
+            from .framework import Variable
+
+            fetch_names = tuple(
+                v.name if isinstance(v, Variable) else str(v)
+                for v in (fetch_list or ()))
+            self._plan = _autoplan.resolve_auto(
+                self._program, mesh=self._auto_mesh, feed=feed,
+                fetch_names=fetch_names)
         if self._plan is None and self._data_parallel:
             devices = self._devices()
             if len(devices) > 1:

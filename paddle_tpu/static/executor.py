@@ -558,7 +558,9 @@ class Executor:
 
         plan = None
         if isinstance(program, CompiledProgram):
-            plan = program._sharding_plan()
+            # feed/fetch ride along so a plan="auto" resolution (the first
+            # run only — the choice is memoized) prices real batch shapes
+            plan = program._sharding_plan(feed=feed, fetch_list=fetch_list)
             program = program._program
         program = program or default_main_program()
         feed = feed or {}

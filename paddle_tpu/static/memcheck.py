@@ -807,6 +807,7 @@ def check_memory_cached(program: Program, plan=None,
 
 
 _EST_MEMO: Dict[tuple, Optional[MemEstimate]] = {}
+_EST_MEMO_CAP = 4096
 
 
 def estimate_peak_cached(program: Program, plan=None,
@@ -814,12 +815,17 @@ def estimate_peak_cached(program: Program, plan=None,
                          fetch_names: Optional[Sequence[str]] = None
                          ) -> Optional[MemEstimate]:
     """Never-raising, memoized ``estimate_peak`` for the calibration ledger
-    (utils/ledger.py): the ledger prices *every* compile event, including
-    runs where the check_memory flag (and its MC001 abort) is off, and a
-    broken estimate there must degrade to an unpriced record, never a
-    failed compile.  Same memo key shape as ``check_memory_cached`` (minus
-    the capacity — no gate is enforced here), sharing its lock and
-    clear-on-cap policy."""
+    (utils/ledger.py) and the autoplan candidate search
+    (parallel/autoplan.py): the ledger prices *every* compile event,
+    including runs where the check_memory flag (and its MC001 abort) is
+    off, and a broken estimate there must degrade to an unpriced record,
+    never a failed compile.  Same memo key shape as ``check_memory_cached``
+    (minus the capacity — no gate is enforced here), sharing its lock but
+    with bounded-ring eviction rather than clear-on-cap: autoplan prices
+    hundreds of short-lived candidate plans per search, and a full clear
+    would also evict the handful of hot ledger keys riding alongside them.
+    Recently-inserted keys survive; the oldest insertion is evicted (dicts
+    iterate in insertion order, so the ring is free)."""
     try:
         feed_shapes = _feed_shape_dict(feed_arrays)
         sig = tuple(sorted(feed_shapes.items()))
@@ -827,12 +833,15 @@ def estimate_peak_cached(program: Program, plan=None,
                program._version, sig, tuple(fetch_names or ()))
         with _memo_lock:
             if key in _EST_MEMO:
-                return _EST_MEMO[key]
+                # refresh recency so repeat lookups aren't next in line
+                est = _EST_MEMO.pop(key)
+                _EST_MEMO[key] = est
+                return est
         est = estimate_peak(program, plan, feeds=feed_shapes,
                             fetch_list=list(fetch_names or ()))
         with _memo_lock:
-            if len(_EST_MEMO) >= _MEMO_CAP:
-                _EST_MEMO.clear()
+            while len(_EST_MEMO) >= _EST_MEMO_CAP:
+                _EST_MEMO.pop(next(iter(_EST_MEMO)))
             _EST_MEMO[key] = est
         return est
     except Exception:

@@ -129,10 +129,16 @@ class CommEstimate:
     gather_sites: List[Tuple[str, str, Tuple[str, ...], int]] = \
         field(default_factory=list)
     gather_bytes: int = 0
+    # [(op site, table name, local ids priced, estimated bytes)] — the
+    # vocab-sharded embedding all_to_all exchange (parallel/embedding.py),
+    # same dedup-capacity x row-bytes x quantize-ratio math the traced
+    # emb.exchange_bytes histogram observes
+    exchange_sites: List[Tuple[str, str, int, int]] = field(default_factory=list)
+    exchange_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
-        return self.allreduce_bytes + self.gather_bytes
+        return self.allreduce_bytes + self.gather_bytes + self.exchange_bytes
 
     def measured_bytes(self, axis: Optional[str] = None) -> float:
         """Sum of the ``comm.allreduce_bytes`` histogram (recorded at trace
@@ -148,6 +154,7 @@ class CommEstimate:
             "buffer_mb": self.buffer_mb,
             "allreduce_bytes": self.allreduce_bytes,
             "gather_bytes": self.gather_bytes,
+            "exchange_bytes": self.exchange_bytes,
             "total_bytes": self.total_bytes,
             "buckets": [{"leaves": list(names), "nelem": nelem,
                          "wire_bytes": wire}
@@ -155,6 +162,9 @@ class CommEstimate:
             "gather_sites": [{"site": site, "weight": w,
                               "axes": list(axes), "bytes": b}
                              for site, w, axes, b in self.gather_sites],
+            "exchange_sites": [{"site": site, "table": w,
+                                "n_local": n, "bytes": b}
+                               for site, w, n, b in self.exchange_sites],
         }
 
 
@@ -188,13 +198,16 @@ class PlanReport:
                 f"comm estimate: world={c.world} payload={c.payload or 'fp32'}"
                 f" buckets={len(c.buckets)}"
                 f" allreduce={c.allreduce_bytes}B gather={c.gather_bytes}B"
-                f" total={c.total_bytes}B")
+                f" exchange={c.exchange_bytes}B total={c.total_bytes}B")
             for names, nelem, wire in c.buckets:
                 head = ", ".join(names[:3]) + (", ..." if len(names) > 3
                                                else "")
                 lines.append(f"  bucket [{head}] nelem={nelem} wire={wire}B")
             for site, w, axes, b in c.gather_sites:
                 lines.append(f"  gather @{site} weight={w} axes={axes} "
+                             f"~{b}B")
+            for site, w, n, b in c.exchange_sites:
+                lines.append(f"  exchange @{site} table={w} n_local={n} "
                              f"~{b}B")
         if self.mem is not None:
             lines.append(self.mem.render())
@@ -706,12 +719,71 @@ def measured_comm_bytes(axis: Optional[str] = None) -> float:
     return total
 
 
-def estimate_comm(program: Program, plan, mesh=None) -> CommEstimate:
+def _estimate_exchange(program, plan, mesh, feed_shapes,
+                       est: CommEstimate) -> None:
+    """Price the vocab-sharded embedding all_to_all exchange per lookup
+    site with the exact math ``embedding.exchange_bytes`` observes at trace
+    time (``emb.exchange_bytes`` histogram): dedup capacity x row bytes x
+    quantize ratio, for the batch-local id count.  Sites whose id batch is
+    unknowable statically (no feed shape and a dynamic declared shape) are
+    skipped — underpricing honestly beats inventing a batch."""
+    if getattr(plan, "embedding_shard", None) is None:
+        return
+    from ..parallel.embedding import exchange_bytes as _exchange_bytes
+
+    shapes = dict(feed_shapes or {})
+    state = {name: shape
+             for name, shape, _dtype, _tr in _state_vars(program) if shape}
+    dp = plan.batch_divisor(mesh)
+    for block in program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type not in _LOOKUP_OPS:
+                continue
+            wnames = op.inputs.get("W", ())
+            inames = op.inputs.get("Ids", ())
+            if not wnames or not inames or wnames[0] not in state:
+                continue
+            wname = wnames[0]
+            wshape = state[wname]
+            if len(wshape) < 2:
+                continue
+            axis = plan.embedding_axis_for(wname, lookup=True)
+            if axis is None or axis not in mesh.axis_names:
+                continue
+            k = int(mesh.shape[axis])
+            if k <= 1 or wshape[0] % k or axis in plan.batch_axes:
+                continue               # degenerate/SC010-invalid: no exchange
+            ishape = shapes.get(inames[0])
+            if ishape is None:
+                v = block.vars.get(inames[0])
+                ishape = tuple(getattr(v, "shape", ()) or ()) if v else ()
+            ishape = tuple(ishape or ())
+            if not ishape or any(not isinstance(d, (int, np.integer)) or d < 0
+                                 for d in ishape):
+                continue
+            # lower_lookup flattens ids before the exchange; the id batch is
+            # dp-sharded when it divides (sharded_lookup's fallback rule)
+            n_global = int(np.prod(ishape, dtype=np.int64))
+            n_local = n_global // dp if dp > 1 and n_global % dp == 0 \
+                else n_global
+            wire = int(_exchange_bytes(
+                n_local, int(wshape[1]), k,
+                getattr(plan, "embedding_capacity", None),
+                getattr(plan, "embedding_quantize", "") or None))
+            est.exchange_sites.append(
+                (f"block {block.idx} op {op_idx}", wname, n_local, wire))
+            est.exchange_bytes += wire
+
+
+def estimate_comm(program: Program, plan, mesh=None,
+                  feed_shapes=None) -> CommEstimate:
     """Static per-bucket allreduce wire-byte estimate for the plan's
     data-parallel gradient sync — same bucketing and wire math as
     ``compress.sync_gradients`` (bucket_assignment + wire_bytes), so on the
     fleet/collbench path the estimate matches the traced
-    ``comm.allreduce_bytes`` records."""
+    ``comm.allreduce_bytes`` records — plus the per-site vocab-sharded
+    embedding exchange bytes (mirroring the traced ``emb.exchange_bytes``)
+    so recommender plans score their dominant collective honestly."""
     from ..parallel.compress import bucket_assignment, wire_bytes
 
     mesh = mesh or plan.resolve_mesh()
@@ -724,6 +796,7 @@ def estimate_comm(program: Program, plan, mesh=None) -> CommEstimate:
     buffer_mb = comm.buffer_mb if comm is not None else 25.0
     est = CommEstimate(world=world, payload=payload, block_size=block_size,
                        buffer_mb=max(buffer_mb, 1e-9))
+    _estimate_exchange(program, plan, mesh, feed_shapes, est)
     leaves = _grad_leaves(program)
     if not leaves:
         return est
@@ -763,7 +836,7 @@ def verify_plan(program: Program, plan,
     _diags, engine = infer_program(program, feed_names=feed_names or (
         None if feed_shapes is None else set(feed_shapes)))
     out.extend(engine.subblock_findings)
-    est = estimate_comm(program, plan, mesh)
+    est = estimate_comm(program, plan, mesh, feed_shapes=feed_shapes)
     _check_contractions(program, plan, mesh, out, est)
     _check_embedding(program, plan, mesh, out)
     # the memory dimension (static/memcheck.py): the same call that prices

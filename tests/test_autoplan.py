@@ -1,0 +1,467 @@
+"""autoplan — cost-model-driven auto-sharding (the ISSUE-19 suite).
+
+Covers the search end to end:
+  * candidate enumeration (mesh factorings, placement families) and the
+    deterministic ranking contract;
+  * pruning happens BEFORE any compile: with a tiny forced HBM capacity
+    every candidate dies as MC001 and ``executor.traces`` stays flat —
+    OOM-doomed plans provably never reach XLA; hand-invalid plans die as
+    sc_invalid with their SC codes attached;
+  * ``plan="auto"`` wiring: one trace total across steady state, the
+    resolve memo returns the SAME plan object for repeat programs (no
+    re-search), a fresh Executor warm-starts from the persistent compile
+    cache under the auto plan, and a memo-reset re-search lands on the
+    identical fingerprint (the cross-process determinism the disk cache
+    keys on);
+  * ledger drift corrections: median(measured/predicted) per leg from raw
+    records, clamped to the correction band — and a pinned fixture where
+    applying a comm-leg correction flips which plan wins the search;
+  * satellite 1: ``shardcheck.estimate_comm`` prices the embedding
+    all_to_all exchange with the same math as ``emb.exchange_bytes`` and
+    lands within a 2x band of the traced observation;
+  * satellite 2: the ``estimate_peak_cached`` memo is a bounded ring with
+    recency refresh — hot keys survive the cap, the oldest insertion is
+    evicted (regression: the old clear-on-cap dropped everything);
+  * elastic replan: ``failover.replan_for_survivors`` searches the
+    truncated world and flight-records ``autoplan_replan``;
+  * fleet strategy plumbing and the CLI selfcheck (subprocess rider:
+    reproduce-or-beat hand plans + execution parity on the 8-device CPU
+    mesh).
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu.static as static
+import paddle_tpu.static.shardcheck as sc
+from paddle_tpu.core import flags
+from paddle_tpu.elastic import failover
+from paddle_tpu.parallel import autoplan
+from paddle_tpu.parallel import embedding as pemb
+from paddle_tpu.parallel import fleet
+from paddle_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from paddle_tpu.parallel.sharding import ShardingPlan
+from paddle_tpu.static import layers as L
+from paddle_tpu.static import memcheck
+from paddle_tpu.utils import monitor
+from paddle_tpu.utils import trace as trace_mod
+
+_REPO = Path(__file__).resolve().parents[1]
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _mesh(dp: int, tp: int) -> Mesh:
+    devs = np.asarray(jax.devices()[:dp * tp]).reshape(dp, tp)
+    return Mesh(devs, (DP_AXIS, TP_AXIS))
+
+
+def _fc_tower(hidden=16, batch=16):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with static.program_guard(main, startup):
+        x = L.data("x", [hidden])
+        y = L.data("y", [1])
+        h = L.fc(x, hidden, act="relu")
+        pred = L.fc(h, 1)
+        loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+        static.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.normal(size=(batch, hidden)).astype(np.float32),
+            "y": rng.normal(size=(batch, 1)).astype(np.float32)}
+    return main, startup, loss, feed
+
+
+def _ctr(vocab=64, dim=8):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = L.data("ids", [], dtype="int64")
+        y = L.data("y", [1])
+        emb = L.embedding(ids, size=[vocab, dim], name="xch_emb")
+        pred = L.fc(emb, 1)
+        loss = L.mean(L.square_error_cost(pred, y))
+        static.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# enumeration + deterministic ranking
+# ---------------------------------------------------------------------------
+
+def test_mesh_factorings():
+    assert autoplan.mesh_factorings(8) == [(8, 1), (4, 2), (2, 4), (1, 8)]
+    assert autoplan.mesh_factorings(1) == [(1, 1)]
+    assert autoplan.mesh_factorings(6) == [(6, 1), (3, 2), (2, 3), (1, 6)]
+
+
+@needs_devices
+def test_search_is_deterministic_and_ranked():
+    main, _startup, loss, feed = _fc_tower()
+    shapes = {k: v.shape for k, v in feed.items()}
+    neutral = {"comm": 1.0, "mem": 1.0, "roofline": 1.0}
+    a = autoplan.search(main, devices=jax.devices()[:8], feed_shapes=shapes,
+                        fetch_names=(loss.name,), corrections=neutral)
+    b = autoplan.search(main, devices=jax.devices()[:8], feed_shapes=shapes,
+                        fetch_names=(loss.name,), corrections=neutral)
+    assert a.ranked, "no viable candidate on an 8-device mesh"
+    assert a.best.fingerprint() == b.best.fingerprint()
+    assert [c.plan.fingerprint() for c in a.ranked] \
+        == [c.plan.fingerprint() for c in b.ranked]
+    scores = [c.score for c in a.ranked]
+    assert scores == sorted(scores)
+    # the ranked report renders and round-trips
+    assert "rank" in a.render(top=5)
+    doc = a.to_dict()
+    assert doc["candidates"] and doc["program"] == a.program_fp
+
+
+# ---------------------------------------------------------------------------
+# pruning happens BEFORE any compile
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_mc001_oom_doomed_candidates_never_compile():
+    main, _startup, loss, feed = _fc_tower()
+    shapes = {k: v.shape for k, v in feed.items()}
+    reg = monitor.default_registry()
+    traces = reg.get("executor.traces")
+    cand_counter = reg.get("autoplan.candidates")
+    t0 = traces.value()
+    oom0 = cand_counter.value(status="mc_oom")
+    saved = flags.get_flags(["memcheck_capacity_gb"])
+    try:
+        # ~10 bytes of HBM: even fully zero-3-sharded state cannot fit
+        flags.set_flags({"memcheck_capacity_gb": 1e-8})
+        choice = autoplan.search(
+            main, devices=jax.devices()[:8], feed_shapes=shapes,
+            fetch_names=(loss.name,),
+            corrections={"comm": 1.0, "mem": 1.0, "roofline": 1.0})
+    finally:
+        flags.set_flags(saved)
+    assert choice.best is None and not choice.ranked
+    assert choice.pruned
+    assert all(c.status == "mc_oom" and c.pruned_codes == ("MC001",)
+               for c in choice.pruned)
+    assert traces.value() == t0, "a pruned candidate reached the tracer"
+    assert cand_counter.value(status="mc_oom") - oom0 == len(choice.pruned)
+    # and resolve_auto surfaces the dead end instead of compiling anyway
+    saved = flags.get_flags(["memcheck_capacity_gb"])
+    try:
+        flags.set_flags({"memcheck_capacity_gb": 1e-8})
+        autoplan.reset_auto_cache()
+        with pytest.raises(ValueError, match="MC001"):
+            autoplan.resolve_auto(main, mesh=_mesh(1, 8), feed=feed,
+                                  fetch_names=(loss.name,))
+    finally:
+        flags.set_flags(saved)
+        autoplan.reset_auto_cache()
+    assert traces.value() == t0
+
+
+@needs_devices
+def test_sc_invalid_plan_pruned_with_codes():
+    main, _startup, loss = _ctr()
+    # embedding sharded over the batch axis: SC010 by construction
+    bad = ShardingPlan(mesh=_mesh(8, 1), embedding_shard=DP_AXIS,
+                       batch_axes=(DP_AXIS,))
+    reg = monitor.default_registry()
+    traces = reg.get("executor.traces")
+    t0 = traces.value()
+    cand = autoplan.score_plan(main, bad, feed_shapes={"ids": (16,),
+                                                       "y": (16, 1)},
+                               fetch_names=(loss.name,),
+                               corrections={"comm": 1.0, "mem": 1.0,
+                                            "roofline": 1.0})
+    assert cand.status == "sc_invalid"
+    assert "SC010" in cand.pruned_codes
+    assert cand.score is None
+    assert traces.value() == t0
+
+
+# ---------------------------------------------------------------------------
+# plan="auto": zero steady-state retraces, memoized resolution, warm starts
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_plan_auto_zero_steady_state_retraces_and_memo(tmp_path):
+    main, startup, loss, feed = _fc_tower()
+    reg = monitor.default_registry()
+    traces = reg.get("executor.traces")
+    searches = reg.get("autoplan.searches")
+    autoplan.reset_auto_cache()
+    saved = flags.get_flags(["compile_cache_dir"])
+    try:
+        flags.set_flags({"compile_cache_dir": str(tmp_path)})
+
+        def one_run(steps=5):
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                exe = static.Executor()
+                exe.run(startup)
+                comp = static.CompiledProgram(main).with_sharding(plan="auto")
+                out = [float(np.asarray(exe.run(comp, feed=feed,
+                                                fetch_list=[loss])[0]))
+                       for _ in range(steps)]
+            return out, comp._plan
+
+        t0, s0 = traces.value(), searches.value()
+        losses, plan = one_run()
+        assert plan is not None and searches.value() - s0 == 1
+        # exactly two traces: the startup program + the auto-planned step;
+        # 4 more steady-state steps add nothing
+        assert traces.value() - t0 == 2, "steady state under plan='auto' " \
+            "retraced"
+        assert losses[-1] < losses[0]  # it actually trains
+
+        # a second CompiledProgram over the same program: the resolve memo
+        # returns the SAME plan object (token-stable, no new search), and
+        # the fresh Executor warm-starts from the persistent cache
+        hits = reg.get("executor.compile_cache_hit")
+        h0, t1, s1 = hits.value(), traces.value(), searches.value()
+        losses2, plan2 = one_run()
+        assert plan2 is plan
+        assert searches.value() == s1, "memoized resolution re-searched"
+        assert traces.value() == t1, "warm start re-traced python"
+        assert hits.value() > h0, "warm start missed the persistent cache"
+        assert losses2 == losses
+
+        # memo reset -> the search re-runs but lands on the identical
+        # fingerprint: what a restarted process keys the disk cache with
+        autoplan.reset_auto_cache()
+        _losses3, plan3 = one_run(steps=1)
+        assert searches.value() - s1 == 1
+        assert plan3.fingerprint() == plan.fingerprint()
+    finally:
+        flags.set_flags(saved)
+        autoplan.reset_auto_cache()
+
+
+@needs_devices
+def test_fleet_auto_shard_strategy():
+    main, _startup, loss, feed = _fc_tower()
+    strategy = fleet.DistributedStrategy()
+    assert fleet.auto_shard_plan(main, strategy) is None  # off by default
+    strategy.auto_shard = True
+    autoplan.reset_auto_cache()
+    try:
+        plan = fleet.auto_shard_plan(main, strategy, mesh=_mesh(1, 8),
+                                     feed=feed, fetch_names=(loss.name,))
+        assert isinstance(plan, ShardingPlan)
+        # same resolution path as CompiledProgram(plan="auto"): memo hit
+        comp = static.CompiledProgram(main).with_sharding(plan="auto",
+                                                          mesh=_mesh(1, 8))
+        assert comp._sharding_plan(feed=feed, fetch_list=[loss]) is plan
+    finally:
+        autoplan.reset_auto_cache()
+
+
+# ---------------------------------------------------------------------------
+# ledger drift corrections
+# ---------------------------------------------------------------------------
+
+def test_drift_corrections_median_and_clamp():
+    def rec(program, comm_p, comm_m, mem_p, mem_m, ms_p, ms_m):
+        return {"key": {"program": program},
+                "predicted": {"comm_bytes": comm_p, "peak_hbm_bytes": mem_p,
+                              "roofline_ms": ms_p},
+                "measured": {"allreduce_bytes": comm_m,
+                             "mem_total_bytes": mem_m,
+                             "step_time_ms": ms_m}}
+
+    recs = [rec("p1", 100, 50, 1000, 2000, 1.0, 4.0),
+            rec("p1", 100, 150, 1000, 2000, 1.0, 6.0),
+            rec("p1", 100, 100, 1000, 2000, 1.0, 5.0)]
+    corr = autoplan.drift_corrections(records=recs)
+    assert corr["comm"] == pytest.approx(1.0)     # median of 0.5/1.5/1.0
+    assert corr["mem"] == pytest.approx(2.0)
+    assert corr["roofline"] == pytest.approx(5.0)
+
+    # program-filtered: p2's records dominate when asked for p2, the full
+    # pool is the fallback prior for an unseen program
+    recs.append(rec("p2", 100, 800, 1000, 1000, 1.0, 1.0))
+    assert autoplan.drift_corrections("p2", records=recs)["comm"] \
+        == pytest.approx(8.0)
+    assert autoplan.drift_corrections("unseen", records=recs)["roofline"] \
+        == pytest.approx(4.5)
+
+    # clamped to the correction band; cold start is 1.0
+    wild = [rec("p1", 1, 1e9, 1, 1e-9 + 1, 1.0, 1.0)]
+    c = autoplan.drift_corrections(records=wild)
+    assert c["comm"] == 16.0
+    assert autoplan.drift_corrections(records=[]) \
+        == {"comm": 1.0, "mem": 1.0, "roofline": 1.0}
+
+
+@needs_devices
+def test_drift_correction_flips_the_ranking():
+    """The pinned fixture: on the fc tower over 8 devices the neutral
+    search favors a tp-style plan (no gradient all-reduce on the wire);
+    a ledger that has measured communication far cheaper than predicted
+    (comm leg at the band floor) hands the win to a dp plan whose batch
+    division pays off once its all-reduce is discounted."""
+    main, _startup, loss, feed = _fc_tower(hidden=16, batch=16)
+    shapes = {k: v.shape for k, v in feed.items()}
+    neutral = autoplan.drift_corrections(records=[])
+    cheap_comm = autoplan.drift_corrections(records=[
+        {"key": {"program": "x"},
+         "predicted": {"comm_bytes": 1e9},
+         "measured": {"allreduce_bytes": 1.0}}])
+    assert cheap_comm["comm"] == 1.0 / 16.0
+
+    a = autoplan.search(main, devices=jax.devices()[:8], feed_shapes=shapes,
+                        fetch_names=(loss.name,), corrections=neutral)
+    b = autoplan.search(main, devices=jax.devices()[:8], feed_shapes=shapes,
+                        fetch_names=(loss.name,), corrections=cheap_comm)
+    assert a.ranked and b.ranked
+    assert a.best.fingerprint() != b.best.fingerprint(), \
+        "comm-leg correction did not change the winner"
+    assert b.ranked[0].desc["dp"] > a.ranked[0].desc["dp"], \
+        "discounted comm should push the win toward deeper batch division"
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the exchange-bytes leg of estimate_comm
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_estimate_comm_prices_embedding_exchange_within_2x():
+    main, startup, loss = _ctr(vocab=64, dim=8)
+    plan = ShardingPlan(mesh=_mesh(1, 8), embedding_shard=TP_AXIS,
+                        donate=False)
+    est = sc.estimate_comm(main, plan, feed_shapes={"ids": (16,),
+                                                    "y": (16, 1)})
+    # same math as the embedding module's own accounting (dp=1: all 16
+    # ids are local)
+    assert est.exchange_bytes == pemb.exchange_bytes(16, 8, 8)
+    assert est.exchange_bytes > 0
+    assert len(est.exchange_sites) == 1
+    _site, table, n_local, nbytes = est.exchange_sites[0]
+    assert table == "xch_emb.w" and n_local == 16
+    assert nbytes == est.exchange_bytes
+    assert est.total_bytes >= est.exchange_bytes
+    assert est.to_dict()["exchange_bytes"] == est.exchange_bytes
+
+    # the traced run observes the same wire bytes (2x band pins the
+    # estimate to reality, not just to its own formula)
+    rng = np.random.default_rng(0)
+    feed = {"ids": rng.integers(0, 64, size=(16,)).astype(np.int64),
+            "y": rng.normal(size=(16, 1)).astype(np.float32)}
+    hist = monitor.default_registry().get("emb.exchange_bytes")
+    s0, c0 = hist.sum(), hist.count()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        comp = static.CompiledProgram(main).with_sharding(plan=plan)
+        exe.run(comp, feed=feed, fetch_list=[loss])
+    observed_n = hist.count() - c0
+    assert observed_n >= 1, "the sharded lookup never observed its wire"
+    observed = (hist.sum() - s0) / observed_n
+    assert observed / 2 <= est.exchange_bytes <= observed * 2
+
+    # quantized backward wire shrinks the estimate too
+    qplan = ShardingPlan(mesh=_mesh(1, 8), embedding_shard=TP_AXIS,
+                         embedding_quantize="int8", donate=False)
+    qest = sc.estimate_comm(main, qplan, feed_shapes={"ids": (16,),
+                                                      "y": (16, 1)})
+    assert 0 < qest.exchange_bytes < est.exchange_bytes
+
+
+def test_estimate_comm_no_exchange_without_embedding_shard():
+    main, _startup, _loss = _ctr()
+    est = sc.estimate_comm(main, ShardingPlan(), feed_shapes={"ids": (16,)})
+    assert est.exchange_bytes == 0 and est.exchange_sites == []
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the bounded-ring estimate memo
+# ---------------------------------------------------------------------------
+
+def test_estimate_peak_memo_bounded_ring_with_recency(monkeypatch):
+    main, _startup, _loss, _feed = _fc_tower(hidden=8, batch=4)
+    monkeypatch.setattr(memcheck, "_EST_MEMO", {})
+    monkeypatch.setattr(memcheck, "_EST_MEMO_CAP", 3)
+    checks = monitor.default_registry().get("analysis.mem_checks")
+
+    def est(n):
+        r = memcheck.estimate_peak_cached(main, None,
+                                          feed_arrays={"x": (n, 8),
+                                                       "y": (n, 1)})
+        assert r is not None and r.peak_bytes > 0
+        return r
+
+    base = checks.value()
+    for n in (2, 4, 6):
+        est(n)
+    assert checks.value() - base == 3        # three misses fill the ring
+    est(2)                                   # hit + recency refresh
+    assert checks.value() - base == 3
+    est(8)                                   # at cap: evicts oldest (n=4)
+    assert checks.value() - base == 4
+    est(2)                                   # the refreshed key SURVIVED
+    assert checks.value() - base == 4        # (old clear-on-cap dropped it)
+    est(4)                                   # the evicted key re-misses
+    assert checks.value() - base == 5
+    assert len(memcheck._EST_MEMO) <= 3
+
+
+# ---------------------------------------------------------------------------
+# elastic replan
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_replan_for_survivors_truncates_world_and_records():
+    main, _startup, loss, feed = _fc_tower()
+    reg = monitor.default_registry()
+    replans = reg.get("autoplan.replans")
+    r0 = replans.value()
+    choice = failover.replan_for_survivors(
+        main, world=4,
+        feed_shapes={k: v.shape for k, v in feed.items()},
+        fetch_names=(loss.name,))
+    assert choice.best is not None
+    assert choice.best.resolve_mesh().devices.size == 4
+    assert replans.value() - r0 == 1
+    ev = [e for e in trace_mod.flight_recorder().events()
+          if e["kind"] == "autoplan_replan"]
+    assert ev and ev[-1]["world"] == 4 and ev[-1]["name"] == "eviction"
+    assert ev[-1]["chosen"] == choice.best.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# the CLI selfcheck rides tier-1
+# ---------------------------------------------------------------------------
+
+@needs_devices
+def test_cli_selfcheck():
+    """Subprocess probe: search reproduces-or-beats the hand plans on all
+    demo models, prices without compiling, and executes the winner with
+    loss parity + zero steady-state retraces (see tools/autoplan.py)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.autoplan", "--selfcheck"],
+        cwd=_REPO, capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "autoplan selfcheck: OK" in r.stdout
+
+
+@needs_devices
+def test_cli_json_report():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.autoplan", "--model", "fc",
+         "--format", "json", "--top", "3"],
+        cwd=_REPO, capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json as _json
+
+    doc = _json.loads(r.stdout)
+    assert doc["best"] and doc["candidates"]
+    assert doc["hand"]["desc"]["placement"] == "hand"
+    statuses = {c["status"] for c in doc["candidates"]}
+    assert "ok" in statuses

@@ -15,11 +15,14 @@ Covers the PR-12 recovery contract:
     path, and a dead rank's flight-dump path is printed;
   * chaos: three workers train against a shared membership dir; the
     parent SIGKILLs one mid-run; survivors detect the silence, evict,
-    rebuild their mesh at the smaller world, restore the latest elastic
-    checkpoint, and finish ALL steps with a loss curve that stays on the
-    single-process reference trajectory — and their flight dumps pin the
-    worker_dead -> worker_evicted (exactly one winner) -> elastic_resume
-    chain.
+    re-derive their plan for the smaller world through the autoplan
+    cost-model search (elastic/failover.replan_for_survivors — every
+    survivor runs the same deterministic search, no coordination round),
+    restore the latest elastic checkpoint ONTO the chosen plan, and finish
+    ALL steps with a loss curve that stays on the single-process reference
+    trajectory — and their flight dumps pin the worker_dead ->
+    worker_evicted (exactly one winner) -> autoplan_replan ->
+    elastic_restore -> elastic_resume chain.
 """
 import json
 import os
@@ -282,9 +285,9 @@ from jax.sharding import Mesh
 import paddle_tpu.static as static
 from paddle_tpu.core import flags
 from paddle_tpu.elastic import checkpoint as eckpt
+from paddle_tpu.elastic import failover
 from paddle_tpu.elastic.membership import ElasticMember
 from paddle_tpu.parallel.mesh import DP_AXIS
-from paddle_tpu.parallel.sharding import ShardingPlan
 from paddle_tpu.static import layers as L
 from paddle_tpu.utils import trace as trace_mod
 
@@ -333,11 +336,18 @@ while step < STEPS:
                                   step, keep_last=6)
     newly = member.detect_and_evict()
     if newly:
-        # detect -> record -> evict done; now: rebuild mesh at the smaller
-        # world, restore the latest checkpoint, resume
+        # detect -> record -> evict done; now: re-derive the plan for the
+        # smaller world through the cost-model search (every survivor runs
+        # the same deterministic search, so no coordination round), restore
+        # the latest checkpoint ONTO the chosen plan, resume
         new_world = member.world_size()
-        mesh, compiled = compiled_for(new_world)
-        plan = ShardingPlan(mesh=mesh, donate=False)
+        choice = failover.replan_for_survivors(
+            main, world=new_world,
+            feed_shapes={k: v.shape for k, v in feed.items()},
+            fetch_names=(loss.name,))
+        plan = choice.best
+        assert plan is not None, "replan produced no viable plan"
+        compiled = static.CompiledProgram(main).with_sharding(plan=plan)
         state = meta = None
         for _ in range(40):   # ride out save/GC races with the leader
             try:
@@ -390,9 +400,9 @@ def _reference_losses(steps: int):
 
 def test_chaos_kill_worker_midrun_survivors_recover(tmp_path):
     """SIGKILL a worker mid-run; the survivors must complete every step on
-    a rebuilt (smaller) mesh with the loss curve still on the reference
-    trajectory, and their flight dumps must pin the full
-    detect -> record -> evict -> resume chain."""
+    an autoplan-chosen plan for the smaller world with the loss curve
+    still on the reference trajectory, and their flight dumps must pin the
+    full detect -> record -> evict -> replan -> restore -> resume chain."""
     steps = 18
     script = tmp_path / "worker.py"
     script.write_text(_CHAOS_WORKER)
@@ -461,11 +471,21 @@ def test_chaos_kill_worker_midrun_survivors_recover(tmp_path):
     # that raced in later sees only the marker, not the staleness itself
     evict_winners = 0
     saw_dead = 0
+    chosen_fps = set()
     for rank, events in dumps.items():
         kinds = [e["kind"] for e in events]
         assert "elastic_resume" in kinds, rank
         assert "elastic_restore" in kinds, rank
         assert kinds.index("elastic_restore") < kinds.index("elastic_resume")
+        # every survivor re-planned through the cost-model search, BEFORE
+        # the restore, for the shrunken world — and the deterministic
+        # search means both landed on the same plan
+        assert "autoplan_replan" in kinds, rank
+        assert kinds.index("autoplan_replan") < kinds.index("elastic_restore")
+        replan_ev = next(e for e in events if e["kind"] == "autoplan_replan")
+        assert replan_ev["world"] == 2
+        assert replan_ev["chosen"], rank
+        chosen_fps.add(replan_ev["chosen"])
         if "worker_dead" in kinds:
             saw_dead += 1
             dead_ev = next(e for e in events if e["kind"] == "worker_dead")
@@ -476,6 +496,7 @@ def test_chaos_kill_worker_midrun_survivors_recover(tmp_path):
             assert "worker_dead" in kinds, rank  # winner must have detected
     assert saw_dead >= 1                       # someone observed the death
     assert evict_winners == 1                  # O_EXCL marker: one winner
+    assert len(chosen_fps) == 1                # survivors agreed on the plan
     assert (edir / "evicted.1").exists()
     # the leader's checkpoints drove the recovery
     kinds0 = [e["kind"] for e in dumps[0]]

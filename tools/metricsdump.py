@@ -42,6 +42,11 @@ _KNOWN_NAMES = frozenset({
     "analysis.plans_checked",
     "analysis.programs_checked",
     "analysis.violations",
+    # parallel/autoplan.py (plan-search telemetry)
+    "autoplan.candidates",
+    "autoplan.replans",
+    "autoplan.search_ms",
+    "autoplan.searches",
     "debug.nan_events",
     # parallel/collective.py + parallel/compress.py
     "comm.allreduce_bytes",
@@ -188,6 +193,7 @@ def _register_instrumented_modules() -> None:
     when the workload doesn't exercise it (PS server, hapi loop)."""
     import paddle_tpu.distributed.ps_server  # noqa: F401
     import paddle_tpu.elastic  # noqa: F401 — the elastic.* family
+    import paddle_tpu.parallel.autoplan  # noqa: F401 — the autoplan.* family
     import paddle_tpu.parallel.embedding  # noqa: F401 — the emb.* family
     import paddle_tpu.serving  # noqa: F401 — the serve.* family
     import paddle_tpu.static.analysis  # noqa: F401 — analysis.* counters

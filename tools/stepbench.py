@@ -253,6 +253,87 @@ def _cache_bench(steps: int, batch: int, hidden: int, cache_dir: str) -> dict:
     }
 
 
+def _run_autoplan(steps: int, batch: int, hidden: int, n_dev: int) -> dict:
+    """Cost-model plan search over the bench program (parallel/autoplan.py):
+    searches an N-device mesh, then measures the chosen plan's steady-state
+    host step time next to the hand dp baseline.  Returned as flat numeric
+    scalars so ``record.autoplan.*`` flows straight through benchdiff."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu.static as static
+    from paddle_tpu.core import flags
+    from paddle_tpu.parallel import autoplan
+    from paddle_tpu.parallel.sharding import ShardingPlan
+    from paddle_tpu.static import layers as L
+
+    devs = list(jax.devices()[:n_dev])
+    if len(devs) < n_dev:
+        raise SystemExit(
+            f"--autoplan over {n_dev} devices: only {len(devs)} visible "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+            "before python starts)")
+
+    main, startup = static.Program(), static.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with static.program_guard(main, startup):
+        x = L.data("x", [hidden])
+        y = L.data("y", [1])
+        h = L.fc(x, hidden, act="relu")
+        pred = L.fc(h, 1)
+        loss = L.mean(L.square(L.elementwise_sub(pred, y)))
+        static.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.normal(0, 1, (batch, hidden)).astype(np.float32),
+            "y": rng.normal(0, 1, (batch, 1)).astype(np.float32)}
+
+    choice = autoplan.search(
+        main, devices=devs,
+        feed_shapes={k: v.shape for k, v in feed.items()},
+        fetch_names=(loss.name,))
+    best = choice.ranked[0]
+
+    def run_plan(plan):
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe = static.Executor()
+            exe.run(startup)
+            compiled = static.CompiledProgram(main).with_sharding(plan=plan)
+            for _ in range(3):
+                out = exe.run(compiled, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+            np.asarray(out[0])
+            host_ms = []
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                out = exe.run(compiled, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+                host_ms.append((time.perf_counter() - t0) * 1000.0)
+            final = float(np.asarray(out[0]))
+        return statistics.median(host_ms), final
+
+    saved = flags.get_flags(["donate_state", "metrics"])
+    try:
+        flags.set_flags({"donate_state": True, "metrics": False})
+        auto_ms, _ = run_plan(best.plan)
+        dp_ms, _ = run_plan(ShardingPlan(devices=devs, donate=False))
+    finally:
+        flags.set_flags(saved)
+
+    return {
+        "search_ms": round(choice.search_ms, 2),
+        "candidates_ok": len(choice.ranked),
+        "candidates_pruned": len(choice.pruned),
+        "best_score_ms": round(best.score, 6),
+        "best_comm_kb": round(
+            best.corrected.get("comm_bytes", 0.0) / 1024.0, 3),
+        "step_ms_auto": round(auto_ms, 4),
+        "step_ms_dp": round(dp_ms, 4),
+    }
+
+
 def _run_profile(steps: int, batch: int, hidden: int) -> dict:
     """xprof roofline block for the bench program: a separate short run
     with metrics ON (the timed modes force metrics off, so this pass owns
@@ -294,7 +375,8 @@ def _run_profile(steps: int, batch: int, hidden: int) -> dict:
 
 
 def run_bench(steps: int = 50, batch: int = 64, hidden: int = 256,
-              mesh: int = 0, cache_dir=None, profile: bool = False) -> dict:
+              mesh: int = 0, cache_dir=None, profile: bool = False,
+              autoplan: int = 0) -> dict:
     import jax
 
     fast_ms, fast_losses = _run_mode(donate=True, async_dispatch=True,
@@ -328,6 +410,11 @@ def run_bench(steps: int = 50, batch: int = 64, hidden: int = 256,
     if profile:
         result["roofline"] = _run_profile(steps=steps, batch=batch,
                                           hidden=hidden)
+    if autoplan and autoplan > 1:
+        # under "record" so benchdiff's nested-scalar extractor picks the
+        # block up as autoplan.* metrics (see tools/benchdiff.py)
+        result["record"] = {"autoplan": _run_autoplan(
+            steps=steps, batch=batch, hidden=hidden, n_dev=autoplan)}
     return result
 
 
@@ -337,8 +424,13 @@ def selfcheck() -> int:
     _ensure_cpu_devices(2)
     with tempfile.TemporaryDirectory(prefix="pdtpu_stepbench_cc_") as cc:
         r = run_bench(steps=8, batch=8, hidden=32, mesh=2, cache_dir=cc,
-                      profile=True)
+                      profile=True, autoplan=2)
     ok = True
+    ap = (r.get("record") or {}).get("autoplan") or {}
+    if not (ap.get("candidates_ok", 0) > 0 and ap.get("step_ms_auto", 0) > 0
+            and ap.get("search_ms", 0) > 0):
+        print(f"selfcheck: bad autoplan block {ap!r}", file=sys.stderr)
+        ok = False
     roof = r.get("roofline") or {}
     if not (roof.get("attribution_coverage", 0) >= 0.9):
         print(f"selfcheck: roofline attribution coverage "
@@ -405,22 +497,26 @@ def main(argv=None) -> int:
     parser.add_argument("--profile", action="store_true",
                         help="also attach an xprof roofline block (coverage, "
                              "MFU, top regions; see tools/xprof.py)")
+    parser.add_argument("--autoplan", type=int, default=0, metavar="N",
+                        help="also run the cost-model plan search over an "
+                             "N-device mesh and measure the chosen plan "
+                             "(benchdiff-consumable record.autoplan block)")
     parser.add_argument("--selfcheck", action="store_true",
                         help="tiny smoke run with field/parity checks")
     args = parser.parse_args(argv)
     if args.selfcheck:
         return selfcheck()
-    if args.mesh and args.mesh > 1:
-        _ensure_cpu_devices(args.mesh)
+    if max(args.mesh, args.autoplan) > 1:
+        _ensure_cpu_devices(max(args.mesh, args.autoplan))
     if args.cache == "":
         with tempfile.TemporaryDirectory(prefix="pdtpu_stepbench_cc_") as cc:
             r = run_bench(steps=args.steps, batch=args.batch,
                           hidden=args.hidden, mesh=args.mesh, cache_dir=cc,
-                          profile=args.profile)
+                          profile=args.profile, autoplan=args.autoplan)
     else:
         r = run_bench(steps=args.steps, batch=args.batch, hidden=args.hidden,
                       mesh=args.mesh, cache_dir=args.cache,
-                      profile=args.profile)
+                      profile=args.profile, autoplan=args.autoplan)
     print(json.dumps(r))
     return 0
 
