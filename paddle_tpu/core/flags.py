@@ -107,6 +107,13 @@ define_flag("use_pallas_int8", True, "Use the int8 Pallas conv/matmul "
             "minted by the quant_infer pass from slim PTQ scales.  Off or "
             "unsupported: the simulate fallback (dequantize + float op) "
             "runs — bitwise identical to the pre-rewrite fake-quant graph.")
+define_flag("use_paged_attention", True, "Use the Pallas paged-attention "
+            "decode kernel (ops/pallas/paged_attention.py): single-token "
+            "decode attention gathered block-by-block through a per-sequence "
+            "block table via scalar prefetch, online softmax, optional "
+            "in-kernel int8 KV dequant.  Off or unsupported: the jnp "
+            "gather+softmax reference runs — same tokens, one fused XLA "
+            "gather (the production CPU path).")
 define_flag("profiler_dir", "", "Directory for jax.profiler traces when the "
             "profiler is enabled (ref: platform/profiler.h:208).")
 define_flag("eager_log_level", 0, "VLOG-style verbosity for framework logging "

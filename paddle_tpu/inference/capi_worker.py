@@ -17,6 +17,8 @@ Wire format (little-endian):
   tensor:   i32 name_len | name | i32 dtype | i32 ndim | i64 dims[] | data
   response: [b"PDID" | u64 id]  b"PDRS" | i32 n_outputs | n x tensor
   error:    [b"PDID" | u64 id]  b"PDER" | i32 len | utf-8 message
+  decode:    b"PDID" | u64 id   b"PDGN" | i32 n | i64 tokens[n] | i32 max_new
+  partial:   b"PDID" | u64 id   b"PDTK" | i32 n | i64 tokens[n]
   dtype codes: 0=f32 1=i32 2=i64 3=f64 4=u8 5=bool
 
 The ``PDID`` frame is optional and opts a request into PIPELINING: the
@@ -31,6 +33,20 @@ Id-less requests are byte-identical to the legacy protocol: strict
 request->response ordering on the direct Executor path, and each one acts
 as a drain barrier — it is answered only after every in-flight id'd
 request has completed.
+
+``PDGN`` opens a STREAMING decode (always id'd — streams multiplex): the
+prompt joins the worker's paged decoder (``serving/paged.py``, enabled by
+``PDTPU_CAPI_DECODE=1``) and tokens come back incrementally as decode
+steps complete — ``PDTK`` frames each carrying the tokens generated since
+the last frame, terminated by a standard ``PDRS`` whose single ``tokens``
+tensor is the full generation (or ``PDER``: admission refusal, eviction,
+bad frame).  Multiple streams decode in ONE iteration-level batch, so
+frames from different ids interleave.  The id-less drain barrier covers
+decode streams too: a legacy request is answered only after every open
+stream has terminated.  Knobs (env): ``PDTPU_CAPI_DECODE_BLOCKS`` (pool
+blocks, default 64), ``_BLOCK_SIZE`` (8), ``_SEQS`` (4), ``_SEQ_BLOCKS``
+(table width, 8), ``_CHUNK`` (prefill chunk, 8), ``_KV_DTYPE``
+(float32|int8).
 """
 from __future__ import annotations
 
@@ -181,6 +197,106 @@ class _Pipeline:
         self.server.close()
 
 
+class _DecodeStreams:
+    """The worker's paged-decode face: PDGN prompts join one
+    iteration-level batch (``serving.PagedDecoder``) and a stepper thread
+    pushes PDID-tagged PDTK deltas as tokens land, then the terminating
+    PDRS.  ``drain`` is the legacy-request barrier, same contract as
+    ``_Pipeline.drain``."""
+
+    def __init__(self, respond):
+        from ..serving import PagedDecoder, PagedKVCache, make_paged_toy_lm
+
+        env = os.environ.get
+        blocks = int(env("PDTPU_CAPI_DECODE_BLOCKS", "64"))
+        block_size = int(env("PDTPU_CAPI_DECODE_BLOCK_SIZE", "8"))
+        seqs = int(env("PDTPU_CAPI_DECODE_SEQS", "4"))
+        seq_blocks = int(env("PDTPU_CAPI_DECODE_SEQ_BLOCKS", "8"))
+        chunk = int(env("PDTPU_CAPI_DECODE_CHUNK", "8"))
+        kv_dtype = env("PDTPU_CAPI_DECODE_KV_DTYPE", "float32")
+        model = make_paged_toy_lm(
+            max_positions=max(256, seq_blocks * block_size))
+        cache = PagedKVCache(model, blocks, block_size, kv_dtype=kv_dtype)
+        self.decoder = PagedDecoder(model, cache, seqs, seq_blocks,
+                                    prefill_chunk=chunk, tenant="capi")
+        self._respond = respond
+        self._streams = {}           # req_id -> (handle, n_tokens_emitted)
+        self._dec_lock = threading.Lock()   # joins vs the stepper thread
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._step_loop, name="pdtpu-capi-decode", daemon=True)
+        self._thread.start()
+
+    def submit(self, req_id: int, prompt, max_new: int) -> None:
+        from ..serving import AdmissionError
+
+        with self._cond:
+            if req_id in self._streams:
+                self._respond(req_id, _encode_error(ValueError(
+                    f"duplicate in-flight stream id {req_id}")))
+                return
+            try:
+                with self._dec_lock:
+                    h = self.decoder.join([int(t) for t in prompt], max_new)
+            except (AdmissionError, ValueError) as e:
+                self._respond(req_id, _encode_error(e))
+                return
+            self._streams[req_id] = [h, 0]
+            self._cond.notify_all()
+
+    def _step_loop(self):
+        while True:
+            with self._cond:
+                while not self._streams and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._streams:
+                    return
+            with self._dec_lock:
+                self.decoder.step()
+            with self._cond:
+                done = []
+                for req_id, ent in self._streams.items():
+                    h, emitted = ent
+                    if len(h.tokens) > emitted:
+                        delta = h.tokens[emitted:]
+                        self._respond(req_id, b"PDTK" + struct.pack(
+                            "<i", len(delta)) + struct.pack(
+                            f"<{len(delta)}q", *delta))
+                        ent[1] = len(h.tokens)
+                    if h.done:
+                        done.append(req_id)
+                for req_id in done:
+                    h, _ = self._streams.pop(req_id)
+                    if h.evicted:
+                        self._respond(req_id, _encode_error(RuntimeError(
+                            "stream evicted mid-decode (KV pool "
+                            f"pressure); {len(h.tokens)} tokens emitted")))
+                    else:
+                        self._respond(req_id, _encode_results(
+                            ["tokens"], [np.asarray(h.tokens, np.int64)]))
+                if done:
+                    self._cond.notify_all()
+
+    def drain(self):
+        with self._cond:
+            while self._streams:
+                self._cond.wait()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+
+def _read_pdgn(inp):
+    (n,) = struct.unpack("<i", _read_exact(inp, 4))
+    prompt = struct.unpack(f"<{n}q", _read_exact(inp, 8 * n)) if n else ()
+    (max_new,) = struct.unpack("<i", _read_exact(inp, 4))
+    return list(prompt), max_new
+
+
 def main():
     model_path = sys.argv[1]
     import jax
@@ -211,6 +327,7 @@ def main():
             out.flush()
 
     pipeline = None
+    streams = None
     out.write(b"PDOK")
     out.flush()
     while True:
@@ -225,6 +342,27 @@ def main():
                 magic = _read_exact(inp, 4)
             except EOFError:
                 break
+        if magic == b"PDGN":
+            # streaming decode: always id'd (frames multiplex over the pipe)
+            try:
+                prompt, max_new = _read_pdgn(inp)
+            except EOFError:
+                break
+            if req_id is None:
+                break  # id-less streams are a protocol violation
+            if streams is None:
+                if os.environ.get("PDTPU_CAPI_DECODE") != "1":
+                    respond(req_id, _encode_error(RuntimeError(
+                        "decode streaming disabled (set "
+                        "PDTPU_CAPI_DECODE=1)")))
+                    continue
+                try:
+                    streams = _DecodeStreams(respond)
+                except Exception as e:  # noqa: BLE001 — report on the wire
+                    respond(req_id, _encode_error(e))
+                    continue
+            streams.submit(req_id, prompt, max_new)
+            continue
         if magic != b"PDRQ":
             break
         if req_id is not None:
@@ -253,13 +391,18 @@ def main():
             except Exception as e:  # noqa: BLE001 — report over the wire
                 respond(req_id, _encode_error(e))
         else:
-            # legacy path: drain the pipeline (ordering barrier), then the
-            # byte-identical strict request->response protocol
+            # legacy path: drain the pipeline AND open decode streams
+            # (ordering barrier), then the byte-identical strict
+            # request->response protocol
             if pipeline:
                 pipeline.drain()
+            if streams:
+                streams.drain()
             respond(None, handle_request(inp, exe, program, fetches))
     if pipeline:
         pipeline.close()
+    if streams:
+        streams.close()
 
 
 if __name__ == "__main__":

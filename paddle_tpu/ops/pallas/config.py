@@ -42,6 +42,7 @@ _KERNEL_FLAGS: Tuple[Tuple[str, str], ...] = (
     ("fa", "use_flash_attention"),
     ("int8", "use_pallas_int8"),
     ("ln", "use_fused_layer_norm"),
+    ("pgat", "use_paged_attention"),
     ("pool", "use_pallas_pool"),
 )
 

@@ -9,6 +9,12 @@ Layers (each its own module, composable):
 * :mod:`~paddle_tpu.serving.continuous` — iteration-level batching for
   autoregressive decode over a fixed device slot pool (join/evict between
   steps, zero retraces).
+* :mod:`~paddle_tpu.serving.paged` — paged KV-cache decode: refcounted
+  block pool + per-sequence block tables (HBM follows live tokens, not
+  max_len), chunked prefill interleaved with the decode batch, and
+  cross-tenant prefix caching over content-hashed full blocks.  Same
+  join/evict surface as the continuous path, which stays as the
+  parity/fallback reference.
 * :mod:`~paddle_tpu.serving.tenancy` — per-tenant program isolation, a
   bounded LRU of live executables, per-tenant quotas.
 * :mod:`~paddle_tpu.serving.slo` — SLO-aware admission (projected-p99 load
@@ -21,11 +27,16 @@ section for the ancestry mapping.
 """
 from .continuous import ContinuousBatcher, DecodeHandle, make_toy_lm
 from .frontend import DEFAULT_BUCKET_EDGES, Server
+from .paged import (BlockPool, PagedDecoder, PagedKVCache, PrefixCache,
+                    dense_reference_decode, kv_pool_bytes,
+                    make_paged_toy_lm)
 from .slo import AdmissionError, QuotaExceededError, SLOPolicy
 from .tenancy import Tenant, TenantManager
 
 __all__ = [
-    "AdmissionError", "ContinuousBatcher", "DEFAULT_BUCKET_EDGES",
-    "DecodeHandle", "QuotaExceededError", "SLOPolicy", "Server", "Tenant",
-    "TenantManager", "make_toy_lm",
+    "AdmissionError", "BlockPool", "ContinuousBatcher",
+    "DEFAULT_BUCKET_EDGES", "DecodeHandle", "PagedDecoder", "PagedKVCache",
+    "PrefixCache", "QuotaExceededError", "SLOPolicy", "Server", "Tenant",
+    "TenantManager", "dense_reference_decode", "kv_pool_bytes",
+    "make_paged_toy_lm", "make_toy_lm",
 ]

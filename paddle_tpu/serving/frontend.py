@@ -228,6 +228,31 @@ class Server:
         return self.add_tenant(name, main, ["ids"], [rows], scope,
                                quota=quota, dedup_feed="ids")
 
+    def add_decode_tenant(self, name: str, model, num_blocks: int,
+                          block_size: int, max_seqs: int,
+                          max_blocks_per_seq: int,
+                          kv_dtype: str = "float32",
+                          prefill_chunk: int = 8,
+                          cache=None):
+        """Register a paged-decode tenant: MC008-price the KV pool through
+        ``TenantManager.admit_kv_pool`` (an over-capacity config is
+        rejected BEFORE the block arrays allocate or anything compiles),
+        then build the ``PagedKVCache`` + ``PagedDecoder`` pair.  Pass an
+        existing ``cache`` to attach a second tenant to the same pool —
+        the cross-tenant prefix-sharing configuration (the pool is priced
+        once, by the tenant that created it).  Returns the decoder; the
+        caller drives its join/step surface directly (decode streams do
+        not ride the padded-bucket request queue)."""
+        from .paged import PagedDecoder, PagedKVCache
+
+        if cache is None:
+            self.tenants.admit_kv_pool(name, num_blocks, block_size,
+                                       model.hidden, kv_dtype)
+            cache = PagedKVCache(model, num_blocks, block_size,
+                                 kv_dtype=kv_dtype)
+        return PagedDecoder(model, cache, max_seqs, max_blocks_per_seq,
+                            prefill_chunk=prefill_chunk, tenant=name)
+
     def start(self) -> "Server":
         with self._cond:
             if self._closed:

@@ -95,6 +95,7 @@ class TenantManager:
         self._live: "OrderedDict[str, None]" = OrderedDict()  # LRU, MRU last
         self._lock = threading.Lock()
         self._peak_temp = 0  # high-water mark of live executables' temp bytes
+        self._kv_pools: Dict[str, int] = {}  # admitted KV pool bytes by name
 
     # -- registry ------------------------------------------------------------
     def register(self, tenant: Tenant) -> Tenant:
@@ -118,6 +119,51 @@ class TenantManager:
     def live(self) -> List[str]:
         with self._lock:
             return list(self._live)
+
+    # -- paged KV pool admission ---------------------------------------------
+    def admit_kv_pool(self, name: str, num_blocks: int, block_size: int,
+                      hidden: int, kv_dtype: str = "float32",
+                      capacity_bytes: Optional[int] = None) -> int:
+        """Price a paged KV pool config (memcheck MC008) BEFORE its arrays
+        allocate: the pool's bytes are stacked on every pool this manager
+        already admitted, and an over-capacity config raises
+        ``ProgramVerificationError`` (``serve.load_shed{reason="kv_pool"}``)
+        instead of OOMing mid-flight.  Returns the admitted pool's bytes;
+        ``release_kv_pool`` returns the budget on teardown."""
+        from ..core import errors as _errors
+        from ..static.memcheck import check_kv_pool
+
+        with self._lock:
+            if name in self._kv_pools:
+                raise ValueError(f"KV pool {name!r} already admitted")
+            existing = sum(self._kv_pools.values())
+        diags = check_kv_pool(num_blocks, block_size, hidden, kv_dtype,
+                              existing_bytes=existing,
+                              capacity_bytes=capacity_bytes)
+        for d in diags:
+            _trace.flight_recorder().record(
+                "memcheck_violation", tenant=name, code=d.code,
+                severity=d.severity, message=d.message)
+        errs = [d for d in diags if d.severity == "error"]
+        if errs:
+            LOAD_SHED.inc(reason="kv_pool")
+            raise _errors.ProgramVerificationError(
+                f"KV pool {name!r} rejected at admission:\n"
+                + _errors.render_diagnostics(errs), diagnostics=errs)
+        from .paged import kv_pool_bytes
+
+        nbytes = kv_pool_bytes(num_blocks, block_size, hidden, kv_dtype)
+        with self._lock:
+            self._kv_pools[name] = nbytes
+        return nbytes
+
+    def release_kv_pool(self, name: str) -> None:
+        with self._lock:
+            self._kv_pools.pop(name, None)
+
+    def kv_pool_bytes_admitted(self) -> int:
+        with self._lock:
+            return sum(self._kv_pools.values())
 
     # -- quota (submitter side) ----------------------------------------------
     def begin_request(self, name: str) -> Tenant:

@@ -1,5 +1,5 @@
 """Static peak-HBM verifier: prices a Program × ShardingPlan in bytes-resident
-before anything compiles (MC001–MC007).
+before anything compiles (MC001–MC008).
 
 The third tier of the static-analysis stack.  Tier one
 (``static/analysis.py``, PV001–PV011) checks a Program in isolation; tier
@@ -40,6 +40,11 @@ Diagnostic codes (severity ``error`` aborts ``Executor.run`` under flag
 - ``MC007`` embedding exchange capacity: a ``capacity``-factored exchange
   buffer smaller than the uniform lower bound ``ceil(n_local / k)`` —
   guaranteed id drops for *any* batch, not just skewed ones.
+- ``MC008`` KV block pool overflow: a paged-serving KV pool
+  (``num_blocks × block_bytes``, ``serving/paged.py``) that would exceed
+  HBM capacity on its own or stacked on pools already admitted —
+  ``TenantManager.admit_kv_pool`` rejects the config before any arrays
+  allocate or anything compiles (``check_kv_pool``).
 
 Entry points: ``estimate_peak`` (the public costing API),
 ``verify_memory``/``check_memory`` (the PV/SC-shaped report/raise pair),
@@ -80,7 +85,7 @@ _m_mem_checks = _monitor.counter(
     "check_memory_cached plus direct estimate_peak/verify_memory calls).")
 _m_mem_violations = _monitor.counter(
     "analysis.mem_violations",
-    "Memory-verifier findings by diagnostic code (MC001-MC007).",
+    "Memory-verifier findings by diagnostic code (MC001-MC008).",
     labelnames=("code",))
 
 # advisory thresholds: below these, MC002/MC003/MC004 stay silent — tiny
@@ -703,6 +708,55 @@ def _check_embedding_capacity(program, plan, sizer, feed_shapes,
                     hint=f"raise embedding_capacity to at least "
                          f"{k * floor / n_local:.2f} (1.0 = uniform-exact; "
                          "None = skew-proof)"))
+
+
+def check_kv_pool(num_blocks: int, block_size: int, hidden: int,
+                  kv_dtype: str = "float32",
+                  existing_bytes: int = 0,
+                  capacity_bytes: Optional[int] = None) -> List[Diagnostic]:
+    """MC008: price a paged-serving KV block pool before it allocates.
+
+    The pool is resident state outside any Program (``serving/paged.py``
+    holds it across requests), so the ladder walk in MC006 never sees it —
+    this check prices ``num_blocks × block_bytes`` (plus the null block
+    and per-block scales, the same formula ``PagedKVCache`` allocates by)
+    against HBM capacity, stacked on ``existing_bytes`` of pools already
+    admitted.  Error when the working set cannot fit (the caller must
+    reject the config); warning above 80% of capacity (nothing is left
+    for executables and transients).  Capacity resolves like MC001:
+    explicit arg > ``memcheck_capacity_gb`` flag > the per-device-kind
+    peaks table (None on CPU — the check stays quiet)."""
+    from ..serving.paged import kv_pool_bytes
+
+    _m_mem_checks.inc()
+    pool = kv_pool_bytes(num_blocks, block_size, hidden, kv_dtype)
+    capacity, kind = _hbm_capacity(capacity_bytes)
+    out: List[Diagnostic] = []
+    if capacity is None:
+        return out
+    total = pool + int(existing_bytes)
+    if total > capacity:
+        out.append(Diagnostic(
+            "MC008", "error",
+            f"paged KV pool of {num_blocks} x {block_size}-token blocks "
+            f"(hidden={hidden}, {kv_dtype}) costs {pool}B; with "
+            f"{existing_bytes}B of pools already admitted that is "
+            f"{total}B — over the {capacity}B HBM capacity ({kind}), so "
+            "the pool would OOM at allocation or starve every executable",
+            hint="shrink num_blocks/block_size, switch kv_dtype to int8 "
+                 "(4x fewer bytes per block), or raise "
+                 "memcheck_capacity_gb if the device table is wrong"))
+    elif total > 0.8 * capacity:
+        out.append(Diagnostic(
+            "MC008", "warning",
+            f"paged KV pool ({pool}B; {total}B with already-admitted "
+            f"pools) uses over 80% of the {capacity}B HBM capacity "
+            f"({kind}) — executables and transients get the remainder",
+            hint="leave headroom for compiled programs: shrink the pool "
+                 "or quantize blocks to int8"))
+    for d in out:
+        _m_mem_violations.inc(code=d.code)
+    return out
 
 
 # ---------------------------------------------------------------------------

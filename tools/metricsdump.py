@@ -113,10 +113,14 @@ _KNOWN_NAMES = frozenset({
     "ps.rpc_errors",
     "ps.rpc_latency_ms",
     "registry.lowering_calls",
-    # serving/ (slo.py, tenancy.py, continuous.py)
+    # serving/ (slo.py, tenancy.py, continuous.py, paged.py)
     "serve.batch_occupancy",
     "serve.batch_size",
     "serve.decode_active_slots",
+    "serve.kv_blocks_free",
+    "serve.kv_cache_bytes",
+    "serve.kv_prefill_chunks",
+    "serve.kv_prefix_hits",
     "serve.live_programs",
     "serve.live_temp_bytes",
     "serve.load_shed",

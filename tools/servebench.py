@@ -24,6 +24,18 @@ What it measures:
   sequence's tokens).
 * ``occupancy_hist`` — the ``serve.batch_size`` histogram observed during
   the batched phase: how full the dispatched buckets actually were.
+* ``paged`` (``--paged``) — the paged-KV serving blocks
+  (``serving/paged.py``): ``capacity`` measures max concurrent short
+  sequences admitted at a FIXED KV-pool HBM budget vs the dense
+  slot-reservation equivalent (every slot provisioned for ``max_len``);
+  ``decode`` races paged decode against a ``ContinuousBatcher`` given the
+  SAME HBM (the dense pool affords only ``pool_bytes / max_len-row``
+  slots) with per-sequence token parity vs a straight-line dense
+  reference decode; ``ttft_mix`` joins a long prompt and measures how
+  much short-request first-token latency moves when chunked prefill
+  interleaves it (steps and wall ms, alone vs mixed); ``prefix_cache``
+  replays a shared-system-prompt workload and reports the block hit rate
+  plus prefill chunks cold vs warm.
 
 Latency percentiles come from the SAME ``Histogram.percentile`` estimator
 the SLO admission uses (one quantile implementation everywhere).
@@ -33,13 +45,14 @@ Usage:
                                [--buckets 1,2,4,8,16,32] [--max-wait-ms W]
                                [--qps-ramp 50,100,200] [--slo-p99-ms MS]
                                [--seqs N] [--slots N] [--new-tokens N]
-                               [--out FILE]
+                               [--paged] [--out FILE]
     python -m tools.servebench --selfcheck     # smoke: rides tier-1
 """
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import threading
 import time
@@ -184,6 +197,151 @@ def _continuous(seqs: int, slots: int, new_tokens: int):
     }
 
 
+def _paged(seqs: int, new_tokens: int):
+    """The paged-KV serving blocks: fixed-HBM concurrency, decode tok/s at
+    equal HBM vs the continuous path, chunked-prefill TTFT isolation, and
+    prefix-cache hit rate."""
+    import numpy as np
+
+    from paddle_tpu.serving import ContinuousBatcher, make_toy_lm
+    from paddle_tpu.serving import paged as P
+
+    hidden, bs, nb, maxb = 32, 8, 64, 32
+    max_len = maxb * bs                      # the provisioned capability
+    model = P.make_paged_toy_lm(vocab=64, hidden=hidden, max_positions=512,
+                                seed=3)
+    rec = {"block_size": bs, "num_blocks": nb, "max_blocks_per_seq": maxb,
+           "hidden": hidden}
+
+    # -- capacity: short requests admitted at fixed pool HBM ------------------
+    # 9 prompt + 7 new = 16 tokens = exactly 2 blocks per sequence, so the
+    # admission count is pure allocator physics (no decode-time growth).
+    # The dense equivalent reserves max_blocks_per_seq per slot (every
+    # sequence provisioned for max_len — the ContinuousBatcher model).
+    cache = P.PagedKVCache(model, nb, bs)
+    dec = P.PagedDecoder(model, cache, max_seqs=nb,
+                         max_blocks_per_seq=maxb)
+    rng = np.random.default_rng(5)
+    handles = []
+    while True:
+        h = dec.try_join([int(t) for t in rng.integers(0, 64, 9)], 7)
+        if h is None:
+            break
+        handles.append(h)
+    paged_cap = len(handles)
+    for h in handles:
+        dec.evict(h)
+    dense_slots_cap = max(1, nb // maxb)
+    rec["capacity"] = {
+        "pool_bytes": cache.bytes, "paged_concurrent": paged_cap,
+        "dense_slots": dense_slots_cap,
+        "concurrent_speedup": round(paged_cap / dense_slots_cap, 2)}
+
+    # -- decode tok/s at equal HBM vs the continuous path ---------------------
+    cache = P.PagedKVCache(model, nb, bs)
+    dec = P.PagedDecoder(model, cache, max_seqs=16,
+                         max_blocks_per_seq=maxb)
+    prompts = [[int(t) for t in rng.integers(0, 64, 4)] for _ in range(seqs)]
+    dec.decode(prompts[:1], max_new_tokens=new_tokens)  # compile, off-clock
+    t_paged = math.inf
+    for _ in range(3):                       # best-of-3 rides out host noise
+        t0 = time.perf_counter()
+        paged_out = dec.decode(prompts, max_new_tokens=new_tokens)
+        t_paged = min(t_paged, time.perf_counter() - t0)
+    parity = all(
+        paged_out[i] == P.dense_reference_decode(model, prompts[i],
+                                                 new_tokens)
+        for i in range(min(4, seqs)))
+
+    # the dense pool gets the SAME bytes: rows provisioned at max_len
+    dense_row = max_len * hidden * 4
+    cont_slots = max(1, int(cache.bytes // dense_row))
+    step_fn, init_fn = make_toy_lm(vocab=64, hidden=hidden, max_len=max_len,
+                                   seed=3)
+    cb = ContinuousBatcher(step_fn, init_fn, num_slots=cont_slots,
+                           max_len=max_len)
+    cb.decode(prompts[:1], max_new_tokens=new_tokens)
+    t_cont = math.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cb.decode(prompts, max_new_tokens=new_tokens)
+        t_cont = min(t_cont, time.perf_counter() - t0)
+    toks = sum(len(t) for t in paged_out)
+    rec["decode"] = {
+        "sequences": seqs, "max_seqs": 16,
+        "dense_slots_equal_hbm": cont_slots,
+        "tok_s_paged": round(toks / t_paged, 1) if t_paged else None,
+        "tok_s_continuous": round(toks / t_cont, 1) if t_cont else None,
+        "decode_speedup": round(t_cont / t_paged, 2) if t_paged else None,
+        "parity": parity}
+
+    # -- chunked prefill: short-request TTFT, alone vs long-prompt mix --------
+    chunk = 4
+    long_tokens, short_tokens, n_short = 64, 6, 4
+
+    def _ttft(with_long: bool):
+        c = P.PagedKVCache(model, nb, bs)
+        d = P.PagedDecoder(model, c, max_seqs=8, max_blocks_per_seq=16,
+                           prefill_chunk=chunk)
+        # compile off-clock across the gather-width ladder both runs will
+        # touch (the step width tracks the longest live table, so the long
+        # prompt and the shorts hit different compiled shapes)
+        d.decode([[1, 2, 3]], 2)
+        d.decode([[int(t) for t in rng.integers(0, 64, short_tokens)]],
+                 short_tokens)
+        d.decode([[int(t) for t in rng.integers(0, 64, long_tokens)]], 4)
+        if with_long:
+            d.join([int(t) for t in rng.integers(0, 64, long_tokens)], 4)
+        shorts = [d.join([int(t) for t in rng.integers(0, 64,
+                                                       short_tokens)], 4)
+                  for _ in range(n_short)]
+        ttft_ms, ttft_steps = {}, {}
+        steps = 0
+        while d.active_count:
+            d.step()
+            steps += 1
+            now = time.perf_counter()
+            for i, h in enumerate(shorts):
+                if h.tokens and i not in ttft_ms:
+                    ttft_ms[i] = (now - h._t_submit) * 1e3
+                    ttft_steps[i] = steps
+        return list(ttft_ms.values()), max(ttft_steps.values())
+
+    alone_ms, alone_steps = _ttft(with_long=False)
+    mixed_ms, mixed_steps = _ttft(with_long=True)
+    rec["ttft_mix"] = {
+        "long_tokens": long_tokens, "short_tokens": short_tokens,
+        "prefill_chunk": chunk,
+        "short_ttft_alone_p99_ms": _percentiles(alone_ms)["p99_ms"],
+        "short_ttft_mixed_p99_ms": _percentiles(mixed_ms)["p99_ms"],
+        "short_ttft_alone_steps": alone_steps,
+        "short_ttft_mixed_steps": mixed_steps}
+
+    # -- prefix cache: shared system prompt, unique suffixes ------------------
+    cache = P.PagedKVCache(model, nb, bs)
+    dec = P.PagedDecoder(model, cache, max_seqs=4, max_blocks_per_seq=16)
+    sys_prompt = [int(t) for t in rng.integers(0, 64, 32)]
+    n_req = 8
+    lookups_per_req = (len(sys_prompt) + 3 - 1) // bs   # full blocks probed
+    h0 = P.KV_PREFIX_HITS.value()
+    c0 = P.KV_PREFILL_CHUNKS.value()
+    dec.decode([sys_prompt + [int(t) for t in rng.integers(0, 64, 3)]], 4)
+    cold_chunks = P.KV_PREFILL_CHUNKS.value() - c0
+    c1 = P.KV_PREFILL_CHUNKS.value()
+    for _ in range(n_req - 1):
+        dec.decode([sys_prompt + [int(t) for t in rng.integers(0, 64, 3)]],
+                   4)
+    warm_chunks = (P.KV_PREFILL_CHUNKS.value() - c1) / (n_req - 1)
+    hits = P.KV_PREFIX_HITS.value() - h0
+    rec["prefix_cache"] = {
+        "requests": n_req, "system_prompt_tokens": len(sys_prompt),
+        "prefix_hits": int(hits),
+        "hit_rate": round(hits / (n_req * lookups_per_req), 3),
+        "prefill_chunks_cold": int(cold_chunks),
+        "prefill_chunks_warm_mean": round(warm_chunks, 2)}
+    return rec
+
+
 def _occupancy_hist():
     """The serve.batch_size histogram (cumulative bucket counts) from the
     metrics registry — how full dispatched batches were."""
@@ -250,6 +408,8 @@ def run_bench(args) -> dict:
 
     record["continuous"] = _continuous(args.seqs, args.slots,
                                        args.new_tokens)
+    if args.paged:
+        record["paged"] = _paged(args.seqs, args.new_tokens)
     return record
 
 
@@ -257,13 +417,19 @@ def _selfcheck() -> int:
     ns = _parser().parse_args(
         ["--duration", "0.8", "--clients", "8", "--buckets", "1,2,4,8",
          "--qps-ramp", "40", "--seqs", "6", "--slots", "4",
-         "--new-tokens", "5", "--hidden", "16"])
+         "--new-tokens", "5", "--hidden", "16", "--paged"])
     rec = run_bench(ns)
     assert rec["baseline"]["qps"] > 0 and rec["batched"]["qps"] > 0
     assert rec["baseline"]["p99_ms"] is not None
     assert rec["continuous"]["parity"] is True, "decode parity broken"
     assert rec["occupancy_hist"] is not None
     assert rec["open_loop"][0]["achieved_qps"] > 0
+    pg = rec["paged"]
+    assert pg["decode"]["parity"] is True, "paged decode parity broken"
+    assert pg["capacity"]["concurrent_speedup"] > 1
+    assert pg["prefix_cache"]["prefix_hits"] > 0
+    assert pg["prefix_cache"]["prefill_chunks_warm_mean"] < \
+        pg["prefix_cache"]["prefill_chunks_cold"]
     print(json.dumps(rec))
     print("servebench selfcheck: OK")
     return 0
@@ -284,6 +450,9 @@ def _parser():
     ap.add_argument("--seqs", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--paged", action="store_true",
+                    help="run the paged-KV serving blocks (capacity, "
+                         "decode vs continuous, TTFT mix, prefix cache)")
     ap.add_argument("--out", default="",
                     help="also write the BENCH_SERVE.json document here")
     ap.add_argument("--selfcheck", action="store_true")
